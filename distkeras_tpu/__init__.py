@@ -1,0 +1,25 @@
+"""distkeras_tpu — a TPU-native distributed training framework with the
+capability surface of dist-keras (Spark/parameter-server distributed Keras),
+rebuilt on JAX/XLA: SPMD over a device mesh with ICI collectives instead of a
+socket parameter-server star.  See SURVEY.md for the reference analysis and
+README.md for the architecture.
+"""
+
+__version__ = "0.1.0"
+
+from . import core, data, parallel
+from .core import (Sequential, Dense, Conv2D, MaxPooling2D, Flatten, Reshape,
+                   Activation, Dropout, BatchNormalization,
+                   SGD, Adam, Adagrad, Adadelta, RMSprop)
+from .core.model import FittedModel, serialize_model, deserialize_model
+from .data import (Dataset, MinMaxTransformer, DenseTransformer,
+                   ReshapeTransformer, OneHotTransformer,
+                   LabelIndexTransformer)
+from .trainers import (Trainer, SingleTrainer, AveragingTrainer,
+                       EnsembleTrainer, DistributedTrainer,
+                       AsynchronousDistributedTrainer,
+                       SynchronousDistributedTrainer,
+                       ADAG, DOWNPOUR, AEASGD, EAMSGD, DynSGD)
+from .predictors import Predictor, ModelPredictor
+from .evaluators import Evaluator, AccuracyEvaluator, LossEvaluator
+from . import utils

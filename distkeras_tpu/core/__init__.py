@@ -1,0 +1,19 @@
+from .layers import (Layer, Dense, Conv2D, MaxPooling2D, AveragePooling2D,
+                     GlobalAveragePooling2D, Flatten, Reshape, Activation,
+                     Dropout, BatchNormalization, Embedding, get_activation)
+from .model import Sequential, serialize_model, deserialize_model
+from .losses import get_loss
+from .optimizers import (Optimizer, SGD, Adam, Adagrad, Adadelta, RMSprop,
+                         get_optimizer)
+from .train import TrainState, make_train_step, make_epoch_runner, init_state
+
+__all__ = [
+    "Layer", "Dense", "Conv2D", "MaxPooling2D", "AveragePooling2D",
+    "GlobalAveragePooling2D", "Flatten", "Reshape", "Activation", "Dropout",
+    "BatchNormalization", "Embedding", "get_activation",
+    "Sequential", "serialize_model", "deserialize_model",
+    "get_loss",
+    "Optimizer", "SGD", "Adam", "Adagrad", "Adadelta", "RMSprop",
+    "get_optimizer",
+    "TrainState", "make_train_step", "make_epoch_runner", "init_state",
+]

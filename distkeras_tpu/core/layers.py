@@ -1,0 +1,413 @@
+"""Layer system for distkeras_tpu.
+
+TPU-first design notes
+----------------------
+Layers are *declarative specs*: lightweight Python objects holding only static
+configuration (shapes, strides, activation names).  Parameters live outside the
+layer in a pytree, so the whole forward pass is a pure function
+``apply(params, x)`` that JAX can trace once and XLA can fuse aggressively.
+
+This replaces the reference's reliance on Keras layer objects with mutable
+weights (reference: ``distkeras/utils.py :: serialize_keras_model`` pickles a
+Keras model's config + weights; here the spec *is* the config and the params
+pytree *is* the weights).
+
+All matmuls/convs run in a configurable ``compute_dtype`` (default bfloat16 on
+TPU) with float32 parameters and float32 accumulation via
+``preferred_element_type`` — this keeps the MXU fed without fp32 conversion
+costs on the HBM side.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any  # per-layer params: dict of arrays (possibly empty)
+
+_ACTIVATIONS = {
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "log_softmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "elu": jax.nn.elu,
+    "leaky_relu": jax.nn.leaky_relu,
+    "softplus": jax.nn.softplus,
+}
+
+
+def get_activation(name: Optional[str]):
+    if name is None:
+        return _ACTIVATIONS["linear"]
+    if callable(name):
+        return name
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation {name!r}; known: {sorted(_ACTIVATIONS)}"
+        ) from None
+
+
+def _apply_activation(name, x):
+    # softmax-family must run in f32 for numerical stability under bf16 compute.
+    if name in ("softmax", "log_softmax", "sigmoid"):
+        return get_activation(name)(x.astype(jnp.float32))
+    return get_activation(name)(x)
+
+
+# ---------------------------------------------------------------------------
+# initializers (Keras-compatible names so serialized configs round-trip)
+# ---------------------------------------------------------------------------
+
+def _fans(shape: Sequence[int]) -> Tuple[int, int]:
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: (kh, kw, cin, cout)
+    receptive = int(np.prod(shape[:-2]))
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def init_weight(rng, shape, scheme: str = "glorot_uniform", dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    if scheme == "glorot_uniform":
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -limit, limit)
+    if scheme == "glorot_normal":
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(rng, shape, dtype)
+    if scheme == "he_uniform":
+        limit = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -limit, limit)
+    if scheme == "he_normal":
+        std = math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(rng, shape, dtype)
+    if scheme == "zeros":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ones":
+        return jnp.ones(shape, dtype)
+    raise ValueError(f"Unknown initializer {scheme!r}")
+
+
+# ---------------------------------------------------------------------------
+# Layer base
+# ---------------------------------------------------------------------------
+
+class Layer:
+    """Base layer spec.
+
+    Subclasses implement:
+      - ``init(rng, in_shape) -> (params, out_shape)`` where shapes exclude the
+        leading batch dim;
+      - ``apply(params, x, *, compute_dtype, train, rng) -> y``.
+    """
+
+    #: class-level registry name (set via __init_subclass__)
+    kind: str = "Layer"
+
+    _REGISTRY: Dict[str, type] = {}
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        cls.kind = cls.__name__
+        Layer._REGISTRY[cls.__name__] = cls
+
+    # -- config (serialization) --------------------------------------------
+    def get_config(self) -> Dict[str, Any]:
+        cfg = {k: v for k, v in self.__dict__.items() if not k.startswith("_")}
+        cfg["kind"] = self.kind
+        return cfg
+
+    @staticmethod
+    def from_config(cfg: Dict[str, Any]) -> "Layer":
+        cfg = dict(cfg)
+        kind = cfg.pop("kind")
+        cls = Layer._REGISTRY[kind]
+        obj = cls.__new__(cls)
+        # JSON round-trips tuples (kernel_size, strides, target_shape, ...)
+        # to lists; shape fields must come back as tuples.
+        obj.__dict__.update({k: tuple(v) if isinstance(v, list) else v
+                             for k, v in cfg.items()})
+        return obj
+
+    # -- shape/params -------------------------------------------------------
+    def init(self, rng, in_shape):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __repr__(self):
+        cfg = {k: v for k, v in self.get_config().items() if k != "kind"}
+        args = ", ".join(f"{k}={v!r}" for k, v in cfg.items())
+        return f"{self.kind}({args})"
+
+
+# ---------------------------------------------------------------------------
+# Core layers
+# ---------------------------------------------------------------------------
+
+class Dense(Layer):
+    """Fully connected layer (reference models are MLP-heavy:
+    SURVEY.md §2.1 row 23 — MNIST MLP, ATLAS Higgs tabular)."""
+
+    def __init__(self, units: int, activation: Optional[str] = None,
+                 use_bias: bool = True, kernel_init: str = "glorot_uniform"):
+        self.units = int(units)
+        self.activation = activation
+        self.use_bias = use_bias
+        self.kernel_init = kernel_init
+
+    def init(self, rng, in_shape):
+        (d,) = in_shape[-1:]
+        params = {"kernel": init_weight(rng, (d, self.units), self.kernel_init)}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.units,), jnp.float32)
+        return params, tuple(in_shape[:-1]) + (self.units,)
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        k = params["kernel"].astype(compute_dtype)
+        y = jax.lax.dot_general(
+            x.astype(compute_dtype), k,
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if self.use_bias:
+            y = y + params["bias"]
+        return _apply_activation(self.activation, y)
+
+
+class Conv2D(Layer):
+    """2-D convolution, NHWC layout (TPU-native; XLA tiles it onto the MXU)."""
+
+    def __init__(self, filters: int, kernel_size=3, strides=1,
+                 padding: str = "SAME", activation: Optional[str] = None,
+                 use_bias: bool = True, kernel_init: str = "he_normal"):
+        self.filters = int(filters)
+        self.kernel_size = tuple(np.broadcast_to(kernel_size, (2,)).tolist())
+        self.strides = tuple(np.broadcast_to(strides, (2,)).tolist())
+        self.padding = padding.upper()
+        self.activation = activation
+        self.use_bias = use_bias
+        self.kernel_init = kernel_init
+
+    def init(self, rng, in_shape):
+        h, w, cin = in_shape
+        kh, kw = self.kernel_size
+        params = {
+            "kernel": init_weight(rng, (kh, kw, cin, self.filters),
+                                  self.kernel_init)
+        }
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), jnp.float32)
+        out = jax.eval_shape(
+            lambda x, k: jax.lax.conv_general_dilated(
+                x, k, self.strides, self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC")),
+            jax.ShapeDtypeStruct((1, h, w, cin), jnp.float32),
+            jax.ShapeDtypeStruct((kh, kw, cin, self.filters), jnp.float32),
+        )
+        return params, tuple(out.shape[1:])
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        y = jax.lax.conv_general_dilated(
+            x.astype(compute_dtype),
+            params["kernel"].astype(compute_dtype),
+            self.strides, self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        )
+        if self.use_bias:
+            y = y + params["bias"]
+        return _apply_activation(self.activation, y)
+
+
+class MaxPooling2D(Layer):
+    def __init__(self, pool_size=2, strides=None, padding: str = "VALID"):
+        self.pool_size = tuple(np.broadcast_to(pool_size, (2,)).tolist())
+        self.strides = (tuple(np.broadcast_to(strides, (2,)).tolist())
+                        if strides is not None else self.pool_size)
+        self.padding = padding.upper()
+
+    def init(self, rng, in_shape):
+        h, w, c = in_shape
+        out = jax.eval_shape(
+            lambda x: self.apply({}, x, compute_dtype=jnp.float32),
+            jax.ShapeDtypeStruct((1, h, w, c), jnp.float32))
+        return {}, tuple(out.shape[1:])
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        dims = (1,) + self.pool_size + (1,)
+        strides = (1,) + self.strides + (1,)
+        return jax.lax.reduce_window(
+            x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else
+            jnp.iinfo(x.dtype).min,
+            jax.lax.max, dims, strides, self.padding)
+
+
+class AveragePooling2D(Layer):
+    def __init__(self, pool_size=2, strides=None, padding: str = "VALID"):
+        self.pool_size = tuple(np.broadcast_to(pool_size, (2,)).tolist())
+        self.strides = (tuple(np.broadcast_to(strides, (2,)).tolist())
+                        if strides is not None else self.pool_size)
+        self.padding = padding.upper()
+
+    def init(self, rng, in_shape):
+        h, w, c = in_shape
+        out = jax.eval_shape(
+            lambda x: self.apply({}, x, compute_dtype=jnp.float32),
+            jax.ShapeDtypeStruct((1, h, w, c), jnp.float32))
+        return {}, tuple(out.shape[1:])
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        dims = (1,) + self.pool_size + (1,)
+        strides = (1,) + self.strides + (1,)
+        summed = jax.lax.reduce_window(
+            x, jnp.zeros((), x.dtype), jax.lax.add, dims, strides,
+            self.padding)
+        return summed / float(np.prod(self.pool_size))
+
+
+class GlobalAveragePooling2D(Layer):
+    def __init__(self):
+        pass
+
+    def init(self, rng, in_shape):
+        return {}, (in_shape[-1],)
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        return jnp.mean(x, axis=(1, 2))
+
+
+class Flatten(Layer):
+    def __init__(self):
+        pass
+
+    def init(self, rng, in_shape):
+        return {}, (int(np.prod(in_shape)),)
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        return x.reshape(x.shape[0], -1)
+
+
+class Reshape(Layer):
+    def __init__(self, target_shape: Sequence[int]):
+        self.target_shape = tuple(int(d) for d in target_shape)
+
+    def init(self, rng, in_shape):
+        if int(np.prod(in_shape)) != int(np.prod(self.target_shape)):
+            raise ValueError(
+                f"Cannot reshape {in_shape} to {self.target_shape}")
+        return {}, self.target_shape
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        return x.reshape((x.shape[0],) + self.target_shape)
+
+
+class Activation(Layer):
+    def __init__(self, activation: str):
+        self.activation = activation
+
+    def init(self, rng, in_shape):
+        return {}, tuple(in_shape)
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        return _apply_activation(self.activation, x)
+
+
+class Dropout(Layer):
+    """Inverted dropout; identity at inference. Uses the functional rng threaded
+    through ``Model.apply`` (no global RNG state — jit/scan friendly)."""
+
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+
+    def init(self, rng, in_shape):
+        return {}, tuple(in_shape)
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        if not train or self.rate <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError("Dropout in train mode requires an rng")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+class BatchNormalization(Layer):
+    """Batch norm with functional running stats.
+
+    The running (mean, var) live in the params pytree under ``"stats"`` and are
+    updated *outside* apply by the train step (returned as aux) so apply stays
+    pure.  For simplicity in v1 the train path normalizes with batch statistics
+    and the eval path with stored stats.
+    """
+
+    def __init__(self, momentum: float = 0.99, epsilon: float = 1e-3):
+        self.momentum = float(momentum)
+        self.epsilon = float(epsilon)
+
+    def init(self, rng, in_shape):
+        c = in_shape[-1]
+        params = {
+            "scale": jnp.ones((c,), jnp.float32),
+            "offset": jnp.zeros((c,), jnp.float32),
+            # stats are non-trained; optimizer masks them out (see Model)
+            "stats": {
+                "mean": jnp.zeros((c,), jnp.float32),
+                "var": jnp.ones((c,), jnp.float32),
+            },
+        }
+        return params, tuple(in_shape)
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        x32 = x.astype(jnp.float32)
+        if train:
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(x32, axis=axes)
+            var = jnp.var(x32, axis=axes)
+        else:
+            mean = params["stats"]["mean"]
+            var = params["stats"]["var"]
+        y = (x32 - mean) * jax.lax.rsqrt(var + self.epsilon)
+        y = y * params["scale"] + params["offset"]
+        return y.astype(x.dtype)
+
+
+class Embedding(Layer):
+    def __init__(self, input_dim: int, output_dim: int):
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+
+    def init(self, rng, in_shape):
+        params = {"embedding": 0.02 * jax.random.normal(
+            rng, (self.input_dim, self.output_dim), jnp.float32)}
+        return params, tuple(in_shape) + (self.output_dim,)
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        return params["embedding"].astype(compute_dtype)[x]

@@ -1,0 +1,79 @@
+"""Loss functions (Keras-name parity).
+
+The reference passes Keras loss *names* into trainers (reference:
+``distkeras/trainers.py :: Trainer.__init__(..., loss)`` compiled in
+``workers.py :: SequentialWorker.prepare_model``).  We accept the same string
+names and resolve them to pure jnp functions.  All losses reduce to a scalar
+mean over the batch and compute in float32 regardless of model compute dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def categorical_crossentropy(y_true, y_pred, from_logits: bool = False):
+    y_true = y_true.astype(jnp.float32)
+    y_pred = y_pred.astype(jnp.float32)
+    if from_logits:
+        logp = jax.nn.log_softmax(y_pred, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(y_pred, _EPS, 1.0))
+    return -jnp.mean(jnp.sum(y_true * logp, axis=-1))
+
+
+def sparse_categorical_crossentropy(y_true, y_pred, from_logits: bool = False):
+    y_pred = y_pred.astype(jnp.float32)
+    if from_logits:
+        logp = jax.nn.log_softmax(y_pred, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(y_pred, _EPS, 1.0))
+    idx = y_true.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def binary_crossentropy(y_true, y_pred, from_logits: bool = False):
+    y_true = y_true.astype(jnp.float32)
+    y_pred = y_pred.astype(jnp.float32)
+    if from_logits:
+        # numerically stable sigmoid BCE
+        return jnp.mean(jnp.maximum(y_pred, 0) - y_pred * y_true +
+                        jnp.log1p(jnp.exp(-jnp.abs(y_pred))))
+    p = jnp.clip(y_pred, _EPS, 1.0 - _EPS)
+    return -jnp.mean(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
+
+
+def mean_squared_error(y_true, y_pred):
+    d = y_true.astype(jnp.float32) - y_pred.astype(jnp.float32)
+    return jnp.mean(jnp.square(d))
+
+
+def mean_absolute_error(y_true, y_pred):
+    return jnp.mean(jnp.abs(
+        y_true.astype(jnp.float32) - y_pred.astype(jnp.float32)))
+
+
+_LOSSES = {
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "mean_squared_error": mean_squared_error,
+    "mse": mean_squared_error,
+    "mean_absolute_error": mean_absolute_error,
+    "mae": mean_absolute_error,
+}
+
+
+def get_loss(name):
+    """Resolve a Keras-style loss name (or pass through a callable)."""
+    if callable(name):
+        return name
+    try:
+        return _LOSSES[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown loss {name!r}; known: {sorted(_LOSSES)}") from None

@@ -1,0 +1,192 @@
+"""Sequential model: a list of layer specs + a params pytree.
+
+Replaces the reference's Keras-model handling (reference:
+``distkeras/utils.py :: serialize_keras_model / deserialize_keras_model``,
+which pickle ``model.to_json()`` + ``model.get_weights()``).  Here the model
+*spec* is JSON-able layer configs and the *weights* are a pytree, so the whole
+forward/backward is a pure jittable function — the shape XLA wants.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import Layer
+
+Params = Any
+
+
+class Sequential:
+    """A stack of layer specs with a functional (init/apply) interface.
+
+    Unlike Keras, the model object holds no weights: ``init`` returns the
+    params pytree and ``apply`` consumes it.  ``compute_dtype`` defaults to
+    bfloat16 — matmuls/convs run on the MXU in bf16 with f32 accumulation.
+    """
+
+    def __init__(self, layers: Optional[Sequence[Layer]] = None,
+                 input_shape: Optional[Sequence[int]] = None,
+                 compute_dtype: str = "bfloat16", name: str = "sequential"):
+        self.layers: List[Layer] = list(layers) if layers else []
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.compute_dtype = compute_dtype
+        self.name = name
+
+    # -- construction -------------------------------------------------------
+    def add(self, layer: Layer) -> "Sequential":
+        self.layers.append(layer)
+        return self
+
+    @property
+    def _cdtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+    # -- functional core ----------------------------------------------------
+    def init(self, rng, input_shape: Optional[Sequence[int]] = None) -> Params:
+        """Initialize params. ``input_shape`` excludes the batch dim."""
+        shape = tuple(input_shape) if input_shape else self.input_shape
+        if shape is None:
+            raise ValueError("input_shape required (constructor or init())")
+        self.input_shape = shape
+        params = []
+        for layer in self.layers:
+            rng, sub = jax.random.split(rng)
+            p, shape = layer.init(sub, shape)
+            params.append(p)
+        self.output_shape = shape
+        return params
+
+    def apply(self, params: Params, x, *, train: bool = False, rng=None):
+        """Pure forward pass. Safe to jit / grad / vmap / shard_map."""
+        cdtype = self._cdtype
+        for i, layer in enumerate(self.layers):
+            sub = None
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            x = layer.apply(params[i], x, compute_dtype=cdtype, train=train,
+                            rng=sub)
+        return x
+
+    def __call__(self, params, x, **kw):
+        return self.apply(params, x, **kw)
+
+    # -- keras-parity conveniences ------------------------------------------
+    def predict(self, params, x, batch_size: int = 512):
+        """Batched host-side inference (used by predictors.ModelPredictor)."""
+        fn = jax.jit(lambda p, b: self.apply(p, b, train=False))
+        outs = []
+        x = np.asarray(x)
+        for i in range(0, len(x), batch_size):
+            outs.append(np.asarray(fn(params, x[i:i + batch_size])))
+        return np.concatenate(outs, axis=0)
+
+    def count_params(self, params) -> int:
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+
+    # -- (de)serialization ---------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps({
+            "name": self.name,
+            "compute_dtype": self.compute_dtype,
+            "input_shape": list(self.input_shape) if self.input_shape else None,
+            "layers": [layer.get_config() for layer in self.layers],
+        })
+
+    @staticmethod
+    def from_json(spec: str) -> "Sequential":
+        cfg = json.loads(spec)
+        model = Sequential(
+            [Layer.from_config(c) for c in cfg["layers"]],
+            input_shape=cfg.get("input_shape"),
+            compute_dtype=cfg.get("compute_dtype", "bfloat16"),
+            name=cfg.get("name", "sequential"),
+        )
+        return model
+
+    def get_weights(self, params) -> List[np.ndarray]:
+        """Flat list of np arrays in deterministic (pytree) order —
+        the wire/storage format, mirroring Keras ``model.get_weights()``."""
+        return [np.asarray(w) for w in jax.tree_util.tree_leaves(params)]
+
+    def set_weights(self, params: Params, weights: Sequence[np.ndarray]):
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        if len(leaves) != len(weights):
+            raise ValueError(
+                f"weight count mismatch: {len(leaves)} vs {len(weights)}")
+        new = [jnp.asarray(w, dtype=l.dtype) for l, w in zip(leaves, weights)]
+        return jax.tree_util.tree_unflatten(treedef, new)
+
+
+class FittedModel:
+    """A (spec, params) pair — what ``Trainer.train`` returns.
+
+    Plays the role of the trained ``keras.Model`` the reference hands back
+    (reference: ``trainers.py :: DistributedTrainer.train`` returns the PS
+    center model).  Carries enough surface (predict / get_weights / save) for
+    the predictor+evaluator pipeline.
+    """
+
+    def __init__(self, model: Sequential, params: Params):
+        self.model = model
+        self.params = params
+
+    def predict(self, x, batch_size: int = 512):
+        return self.model.predict(self.params, x, batch_size=batch_size)
+
+    def get_weights(self):
+        return self.model.get_weights(self.params)
+
+    def set_weights(self, weights):
+        self.params = self.model.set_weights(self.params, weights)
+        return self
+
+    def count_params(self):
+        return self.model.count_params(self.params)
+
+    def serialize(self) -> dict:
+        return serialize_model(self.model, self.params)
+
+    @staticmethod
+    def deserialize(blob: dict) -> "FittedModel":
+        model, params = deserialize_model(blob)
+        return FittedModel(model, params)
+
+    def save(self, path: str):
+        """Persist spec+weights as .npz (final-model persistence; the
+        reference's only persistence was ``model.save`` on the returned
+        Keras model)."""
+        import io
+        weights = {f"w{i}": w for i, w in enumerate(self.get_weights())}
+        np.savez(path, spec=np.frombuffer(
+            self.model.to_json().encode(), dtype=np.uint8), **weights)
+
+    @staticmethod
+    def load(path: str) -> "FittedModel":
+        with np.load(path) as z:
+            spec = bytes(z["spec"]).decode()
+            model = Sequential.from_json(spec)
+            weights = [z[f"w{i}"] for i in range(len(z.files) - 1)]
+        params = model.init(jax.random.PRNGKey(0), model.input_shape)
+        return FittedModel(model, model.set_weights(params, weights))
+
+
+def serialize_model(model: Sequential, params: Params) -> dict:
+    """Parity with reference ``serialize_keras_model`` (utils.py):
+    returns a picklable dict {'model': json_spec, 'weights': [ndarray...]}."""
+    return {"model": model.to_json(), "weights": model.get_weights(params)}
+
+
+def deserialize_model(blob: dict) -> Tuple[Sequential, Params]:
+    """Parity with reference ``deserialize_keras_model`` (utils.py)."""
+    model = Sequential.from_json(blob["model"])
+    if model.input_shape is None:
+        raise ValueError("serialized model missing input_shape")
+    params = model.init(jax.random.PRNGKey(0), model.input_shape)
+    params = model.set_weights(params, blob["weights"])
+    return model, params

@@ -1,0 +1,133 @@
+"""Optimizers: Keras-style names/constructors backed by optax.
+
+The reference hands trainers a Keras *worker optimizer* by name or object
+(reference: ``distkeras/trainers.py :: Trainer.__init__(..., worker_optimizer)``
+compiled per worker in ``workers.py``).  We accept the same spelling —
+``'adagrad'``, ``'adam'``, ``'sgd'``, ... or an ``Optimizer`` instance — and
+back each with the corresponding optax gradient transformation, which jit/scan
+cleanly and shard trivially under SPMD.
+
+BatchNormalization running stats live in the params pytree under a ``"stats"``
+key; ``build()`` masks them out of the optimizer update so they are carried,
+not trained.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import optax
+
+
+class Optimizer:
+    """Thin named wrapper over an optax transformation factory."""
+
+    def __init__(self, name: str, **hyper):
+        self.name = name
+        self.hyper = hyper
+
+    def to_optax(self) -> optax.GradientTransformation:
+        h = self.hyper
+        lr = h.get("learning_rate", _DEFAULT_LR.get(self.name, 0.01))
+        if self.name == "sgd":
+            return optax.sgd(lr, momentum=h.get("momentum", 0.0),
+                             nesterov=h.get("nesterov", False))
+        if self.name == "adam":
+            return optax.adam(lr, b1=h.get("beta_1", 0.9),
+                              b2=h.get("beta_2", 0.999),
+                              eps=h.get("epsilon", 1e-7))
+        if self.name == "adamw":
+            return optax.adamw(lr, b1=h.get("beta_1", 0.9),
+                               b2=h.get("beta_2", 0.999),
+                               eps=h.get("epsilon", 1e-7),
+                               weight_decay=h.get("weight_decay", 1e-4))
+        if self.name == "adagrad":
+            return optax.adagrad(lr, eps=h.get("epsilon", 1e-7))
+        if self.name == "adadelta":
+            return optax.adadelta(lr, rho=h.get("rho", 0.95),
+                                  eps=h.get("epsilon", 1e-7))
+        if self.name == "rmsprop":
+            return optax.rmsprop(lr, decay=h.get("rho", 0.9),
+                                 eps=h.get("epsilon", 1e-7),
+                                 momentum=h.get("momentum", 0.0))
+        if self.name == "lamb":
+            return optax.lamb(lr)
+        raise ValueError(f"Unknown optimizer {self.name!r}")
+
+    def get_config(self):
+        return {"name": self.name, **self.hyper}
+
+    def __repr__(self):
+        return f"Optimizer({self.name!r}, {self.hyper})"
+
+
+_DEFAULT_LR = {
+    "sgd": 0.01,
+    "adam": 0.001,
+    "adamw": 0.001,
+    "adagrad": 0.01,
+    "adadelta": 1.0,
+    "rmsprop": 0.001,
+    "lamb": 0.001,
+}
+
+_ALIASES = {"nadam": "adam", "adamax": "adam"}
+
+
+def SGD(learning_rate=0.01, momentum=0.0, nesterov=False):
+    return Optimizer("sgd", learning_rate=learning_rate, momentum=momentum,
+                     nesterov=nesterov)
+
+
+def Adam(learning_rate=0.001, beta_1=0.9, beta_2=0.999, epsilon=1e-7):
+    return Optimizer("adam", learning_rate=learning_rate, beta_1=beta_1,
+                     beta_2=beta_2, epsilon=epsilon)
+
+
+def Adagrad(learning_rate=0.01, epsilon=1e-7):
+    return Optimizer("adagrad", learning_rate=learning_rate, epsilon=epsilon)
+
+
+def Adadelta(learning_rate=1.0, rho=0.95, epsilon=1e-7):
+    return Optimizer("adadelta", learning_rate=learning_rate, rho=rho,
+                     epsilon=epsilon)
+
+
+def RMSprop(learning_rate=0.001, rho=0.9, epsilon=1e-7, momentum=0.0):
+    return Optimizer("rmsprop", learning_rate=learning_rate, rho=rho,
+                     epsilon=epsilon, momentum=momentum)
+
+
+def get_optimizer(spec: Any, learning_rate: Optional[float] = None) -> Optimizer:
+    """Resolve a Keras-style optimizer spec: name string or Optimizer."""
+    if isinstance(spec, Optimizer):
+        return spec
+    if isinstance(spec, str):
+        name = _ALIASES.get(spec.lower(), spec.lower())
+        hyper = {}
+        if learning_rate is not None:
+            hyper["learning_rate"] = learning_rate
+        return Optimizer(name, **hyper)
+    raise TypeError(f"Cannot interpret optimizer spec {spec!r}")
+
+
+def _trainable_mask(params):
+    """Pytree mask: False for BatchNorm running ``stats`` subtrees."""
+    def mask_layer(p):
+        if isinstance(p, dict):
+            return {k: (False if k == "stats"
+                        else jax.tree_util.tree_map(lambda _: True, v))
+                    for k, v in p.items()}
+        return jax.tree_util.tree_map(lambda _: True, p)
+    return [mask_layer(p) for p in params]
+
+
+def build(spec: Any, params, learning_rate: Optional[float] = None):
+    """Build (optax_tx, opt_state) for a params pytree, masking non-trainables.
+
+    Returns the transformation and its initialized state.
+    """
+    opt = get_optimizer(spec, learning_rate)
+    tx = optax.masked(opt.to_optax(), _trainable_mask(params))
+    return tx, tx.init(params)

@@ -1,0 +1,13 @@
+from .dataset import Dataset
+from .transformers import (Transformer, MinMaxTransformer,
+                           StandardScaleTransformer, DenseTransformer,
+                           ReshapeTransformer, OneHotTransformer,
+                           LabelIndexTransformer, LabelVectorTransformerUDF)
+from .datasets import load_mnist, load_cifar10, load_atlas_higgs
+
+__all__ = [
+    "Dataset", "Transformer", "MinMaxTransformer", "StandardScaleTransformer",
+    "DenseTransformer", "ReshapeTransformer", "OneHotTransformer",
+    "LabelIndexTransformer", "LabelVectorTransformerUDF",
+    "load_mnist", "load_cifar10", "load_atlas_higgs",
+]

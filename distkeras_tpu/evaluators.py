@@ -1,0 +1,52 @@
+"""Metric evaluation over predicted datasets (reference:
+``distkeras/evaluators.py`` — SURVEY.md §2.1 row 20).
+
+``AccuracyEvaluator.evaluate(dataset)`` computes the fraction of rows where
+the predicted class index equals the label — same contract as the reference's
+Spark aggregation, executed as one vectorized numpy pass.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .data.dataset import Dataset
+
+
+class Evaluator:
+    def evaluate(self, dataset: Dataset) -> float:  # pragma: no cover
+        raise NotImplementedError
+
+
+class AccuracyEvaluator(Evaluator):
+    def __init__(self, prediction_col: str = "prediction_index",
+                 label_col: str = "label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataset: Dataset) -> float:
+        pred = np.asarray(dataset[self.prediction_col]).reshape(-1)
+        label = np.asarray(dataset[self.label_col])
+        if label.ndim > 1 and label.shape[-1] > 1:  # one-hot labels
+            label = np.argmax(label, axis=-1)
+        label = label.reshape(-1)
+        return float(np.mean(pred == label))
+
+
+class LossEvaluator(Evaluator):
+    """Mean loss over a predicted dataset (extra over reference — cheap and
+    useful for parity tests)."""
+
+    def __init__(self, loss: str = "categorical_crossentropy",
+                 prediction_col: str = "prediction",
+                 label_col: str = "label_encoded"):
+        from .core.losses import get_loss
+        self.loss_fn = get_loss(loss)
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataset: Dataset) -> float:
+        import jax.numpy as jnp
+        pred = jnp.asarray(dataset[self.prediction_col])
+        label = jnp.asarray(dataset[self.label_col])
+        return float(self.loss_fn(label, pred))

@@ -1,0 +1,3 @@
+from .zoo import mnist_mlp, mnist_convnet, cifar10_convnet, higgs_mlp
+
+__all__ = ["mnist_mlp", "mnist_convnet", "cifar10_convnet", "higgs_mlp"]

@@ -1,0 +1,64 @@
+"""Model zoo matching the reference example workloads (SURVEY.md §2.1 row 23,
+``BASELINE.json.configs``): MNIST MLP, MNIST ConvNet, CIFAR-10 ConvNet, and
+the ATLAS Higgs tabular MLP.  Architectures follow the reference notebooks'
+shapes (Dense-500/Conv-32 scale models); exact layer dims are ours.
+"""
+
+from __future__ import annotations
+
+from ..core import (Sequential, Dense, Conv2D, MaxPooling2D, Flatten, Reshape,
+                    Dropout)
+
+
+def mnist_mlp(compute_dtype: str = "bfloat16") -> Sequential:
+    """MLP on flat 784-dim MNIST rows (reference ``examples/mnist.ipynb``
+    MLP variant / workflow.ipynb-style two-hidden-layer net)."""
+    return Sequential([
+        Dense(500, activation="relu"),
+        Dense(500, activation="relu"),
+        Dense(10, activation="softmax"),
+    ], input_shape=(784,), compute_dtype=compute_dtype, name="mnist_mlp")
+
+
+def mnist_convnet(compute_dtype: str = "bfloat16") -> Sequential:
+    """ConvNet on 28x28x1 MNIST (the ADAG north-star benchmark model;
+    reference ``examples/mnist.ipynb`` ConvNet)."""
+    return Sequential([
+        Reshape((28, 28, 1)),
+        Conv2D(32, 3, activation="relu"),
+        Conv2D(32, 3, activation="relu"),
+        MaxPooling2D(2),
+        Conv2D(64, 3, activation="relu"),
+        MaxPooling2D(2),
+        Flatten(),
+        Dense(128, activation="relu"),
+        Dense(10, activation="softmax"),
+    ], input_shape=(784,), compute_dtype=compute_dtype, name="mnist_convnet")
+
+
+def cifar10_convnet(compute_dtype: str = "bfloat16") -> Sequential:
+    """Small ConvNet on 32x32x3 CIFAR-10 (reference DOWNPOUR config)."""
+    return Sequential([
+        Reshape((32, 32, 3)),
+        Conv2D(32, 3, activation="relu"),
+        Conv2D(32, 3, activation="relu"),
+        MaxPooling2D(2),
+        Conv2D(64, 3, activation="relu"),
+        Conv2D(64, 3, activation="relu"),
+        MaxPooling2D(2),
+        Flatten(),
+        Dense(256, activation="relu"),
+        Dropout(0.5),
+        Dense(10, activation="softmax"),
+    ], input_shape=(3072,), compute_dtype=compute_dtype,
+        name="cifar10_convnet")
+
+
+def higgs_mlp(compute_dtype: str = "bfloat16") -> Sequential:
+    """Tabular MLP for ATLAS Higgs signal/background (reference
+    ``examples/workflow.ipynb``: Dense-500/relu stack, 2-way softmax)."""
+    return Sequential([
+        Dense(500, activation="relu"),
+        Dense(500, activation="relu"),
+        Dense(2, activation="softmax"),
+    ], input_shape=(28,), compute_dtype=compute_dtype, name="higgs_mlp")

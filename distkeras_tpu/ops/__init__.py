@@ -1,0 +1,3 @@
+# Pallas/XLA custom ops live here (populated as profiling identifies
+# fusion gaps; the v1 compute path is pure XLA which already fuses the
+# reference workloads' Dense/Conv+activation chains).

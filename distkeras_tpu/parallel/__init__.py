@@ -1,0 +1,10 @@
+from .mesh import (WORKER_AXIS, get_mesh, initialize, replicated,
+                   worker_sharded, put_replicated, put_worker_sharded)
+from .spmd import SPMDEngine, DistState, shape_epoch_data
+from . import rules
+
+__all__ = [
+    "WORKER_AXIS", "get_mesh", "initialize", "replicated", "worker_sharded",
+    "put_replicated", "put_worker_sharded",
+    "SPMDEngine", "DistState", "shape_epoch_data", "rules",
+]

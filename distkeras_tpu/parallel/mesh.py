@@ -1,0 +1,76 @@
+"""Device mesh helpers.
+
+The reference's "cluster" is Spark executors + a driver PS (SURVEY.md §1).
+Here the cluster is a ``jax.sharding.Mesh`` over TPU chips: the ``'workers'``
+axis replaces Spark partitions, ICI collectives replace the PS socket star.
+Multi-host runs initialize via ``jax.distributed`` (see ``initialize()``);
+single-host and CPU-simulated runs (``--xla_force_host_platform_device_count``)
+use the same code path — the mesh abstracts over both.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORKER_AXIS = "workers"
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (replaces Spark job submission + PS bind;
+    reference: ``distkeras/trainers.py :: DistributedTrainer.service``).
+
+    No-op on single-process runs; on pods call once per host before building
+    a mesh so ``jax.devices()`` is the global device set.
+    """
+    if coordinator_address is None:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def get_mesh(num_workers: Optional[int] = None,
+             axis_name: str = WORKER_AXIS,
+             devices: Optional[Sequence] = None) -> Mesh:
+    """1-D data-parallel mesh over ``num_workers`` devices.
+
+    ``num_workers`` defaults to every visible device. Using fewer devices than
+    available is allowed (benchmark sweeps); more is an error — one worker per
+    chip is the TPU-native analogue of one Spark worker per partition.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = num_workers or len(devs)
+    if n > len(devs):
+        raise ValueError(
+            f"num_workers={n} exceeds visible devices ({len(devs)}). "
+            "For CPU simulation set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=N")
+    return Mesh(np.array(devs[:n]), (axis_name,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def worker_sharded(mesh: Mesh, axis: int = 0) -> NamedSharding:
+    spec = [None] * (axis + 1)
+    spec[axis] = WORKER_AXIS
+    return NamedSharding(mesh, P(*spec))
+
+
+def put_replicated(tree, mesh: Mesh):
+    """Place a pytree replicated across the mesh."""
+    s = replicated(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, s), tree)
+
+
+def put_worker_sharded(tree, mesh: Mesh):
+    """Place a pytree whose leaves have a leading 'workers' axis."""
+    s = worker_sharded(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, s), tree)

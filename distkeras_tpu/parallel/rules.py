@@ -1,0 +1,81 @@
+"""Per-algorithm update rules as pure pytree functions.
+
+These are the *semantic core* of each distributed optimization algorithm,
+factored out of execution so they can be (a) unit-tested against closed-form
+cases (SURVEY.md §4's "test the update rule as a pure function"), and (b)
+shared verbatim between the SPMD collective path (``spmd.py``) and the
+host-side async parameter-server path (``parameter_servers.py``) — both
+execution engines apply exactly these rules.
+
+Reference semantics being preserved:
+ - delta commit:      ``parameter_servers.py :: DeltaParameterServer``
+                      (center += delta)
+ - ADAG normalize:    ``parameter_servers.py :: ADAGParameterServer``
+                      (accumulated deltas normalized before apply)
+ - elastic term:      ``workers.py :: AEASGDWorker`` (ρ-scaled difference,
+                      subtracted locally and committed to the center)
+ - staleness scaling: ``parameter_servers.py :: DynSGDParameterServer``
+                      (center += delta / (staleness + 1))
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+tmap = jax.tree_util.tree_map
+
+
+def tree_sub(a, b):
+    return tmap(jnp.subtract, a, b)
+
+
+def tree_add(a, b):
+    return tmap(jnp.add, a, b)
+
+
+def tree_scale(a, s):
+    return tmap(lambda x: x * s, a)
+
+
+def delta_commit(center, delta):
+    """DOWNPOUR-style raw delta apply: center += delta."""
+    return tree_add(center, delta)
+
+
+def adag_commit(center, summed_delta, num_commits):
+    """ADAG: deltas accumulated across workers, normalized by commit count
+    before applying — the bulk-synchronous form is an all-reduce *mean* of
+    window deltas."""
+    return tree_add(center, tree_scale(summed_delta, 1.0 / num_commits))
+
+
+def elastic_difference(local, center, alpha):
+    """EASGD elastic force α·(x − x̃). ``alpha`` is the elastic coefficient
+    (paper: α = η·ρ; the reference exposes ``rho`` and ``learning_rate``)."""
+    return tmap(lambda x, c: alpha * (x - c), local, center)
+
+
+def easgd_worker_update(local, elastic):
+    """Worker side of the elastic exchange: x ← x − e."""
+    return tree_sub(local, elastic)
+
+
+def easgd_center_update(center, summed_elastic):
+    """Center side: x̃ ← x̃ + Σᵢ eᵢ (sum over workers' elastic terms)."""
+    return tree_add(center, summed_elastic)
+
+
+def dynsgd_commit(center, delta, staleness):
+    """DynSGD staleness-aware apply: center += delta / (staleness + 1)."""
+    return tmap(lambda c, d: c + d / (staleness + 1.0), center, delta)
+
+
+def average_trees(trees):
+    """Average a list of pytrees (AveragingTrainer's one-shot model average;
+    reference: ``trainers.py :: AveragingTrainer.average_models``)."""
+    n = len(trees)
+    out = trees[0]
+    for t in trees[1:]:
+        out = tree_add(out, t)
+    return tree_scale(out, 1.0 / n)

@@ -1,0 +1,261 @@
+"""SPMD execution engine for the distributed trainers.
+
+Reference architecture being replaced (SURVEY.md §2.4, §3.1): N Spark workers
+train locally and exchange full weight deltas with a driver parameter server
+over TCP/pickle every ``communication_window`` minibatches.  Here the same
+algorithm semantics execute as a bulk-synchronous SPMD program over a
+``Mesh(('workers',))``:
+
+ - "pull center"      → read the replicated center params (no transfer at all)
+ - "commit delta"     → ``lax.psum`` of window deltas over the ICI ring
+ - "PS apply rule"    → the pure functions in ``rules.py`` applied in-graph
+ - per-worker state   → pytrees with a leading 'workers' axis, sharded
+                        ``P('workers')`` so each chip owns exactly its worker
+
+One *round* = ``communication_window`` local minibatch steps (an in-graph
+``lax.scan``) + one collective exchange.  A whole epoch of rounds is itself a
+``lax.scan``, so an epoch is a single XLA program: zero Python dispatch, zero
+host↔device traffic between rounds (vs. the reference's per-window pickle of
+the full weight vector through the driver).
+
+Async-semantics note: XLA is bulk-synchronous, so true hogwild interleaving is
+not representable on the ICI path.  Each algorithm keeps its *update rule*
+exactly (ADAG normalization, elastic term, staleness scaling) while commits
+within a round are emulated as a deterministic serialized order (DynSGD's
+staleness = position in a per-round rotation).  The semantically-exact
+thread-async execution lives in ``distkeras_tpu.parameter_servers`` (host/DCN
+path); both engines share ``rules.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.model import Sequential
+from ..core.losses import get_loss
+from ..core import optimizers as opt_lib
+from . import rules
+from .mesh import WORKER_AXIS, replicated, worker_sharded
+
+tmap = jax.tree_util.tree_map
+
+class DistState(NamedTuple):
+    """Distributed training state.
+
+    center:    replicated params pytree (the PS "center" model)
+    local:     per-worker params, leaves stacked on a leading 'workers' axis
+    opt_state: per-worker optimizer state, same stacking
+    round_idx: int32 scalar — the PS clock (reference:
+               ``ParameterServer.next_update`` counter)
+    """
+    center: Any
+    local: Any
+    opt_state: Any
+    round_idx: jnp.ndarray
+
+
+class SPMDEngine:
+    """Builds and runs the jitted per-epoch program for one algorithm."""
+
+    def __init__(self, model: Sequential, loss, worker_optimizer,
+                 mesh: Mesh, algorithm: str,
+                 communication_window: int = 5,
+                 learning_rate: Optional[float] = None,
+                 alpha: Optional[float] = None):
+        self.model = model
+        self.loss_fn = get_loss(loss)
+        self.mesh = mesh
+        self.algorithm = algorithm
+        self.window = int(communication_window)
+        self.num_workers = int(mesh.devices.size)
+        self.alpha = alpha
+        self.optimizer = opt_lib.get_optimizer(worker_optimizer, learning_rate)
+        self.tx = None  # built in init_state (needs params for masking)
+        self._epoch_fn = None
+
+    # -- state --------------------------------------------------------------
+    def init_state(self, rng, input_shape, initial_params=None) -> DistState:
+        params = self.model.init(rng, input_shape)
+        if initial_params is not None:
+            params = initial_params
+        self.tx = optax.masked(self.optimizer.to_optax(),
+                               opt_lib._trainable_mask(params))
+        n = self.num_workers
+        # every worker starts from the same center (reference: initial pull)
+        local = tmap(lambda x: jnp.broadcast_to(x, (n,) + x.shape), params)
+        opt_state = jax.vmap(self.tx.init)(local)
+        center = jax.device_put(params, replicated(self.mesh))
+        local = tmap(lambda x: jax.device_put(x, worker_sharded(self.mesh)),
+                     local)
+        opt_state = tmap(
+            lambda x: jax.device_put(x, worker_sharded(self.mesh)), opt_state)
+        return DistState(center, local, opt_state,
+                         jnp.zeros((), jnp.int32))
+
+    # -- the per-round SPMD body ---------------------------------------------
+    def _local_window(self, params, opt_state, xw, yw, rng):
+        """Run ``window`` minibatch steps on one worker's shard (in-graph)."""
+
+        def loss_of(p, x, y, key):
+            pred = self.model.apply(p, x, train=True, rng=key)
+            return self.loss_fn(y, pred)
+
+        def body(carry, inp):
+            p, s, key = carry
+            x, y = inp
+            key, sub = jax.random.split(key)
+            l, g = jax.value_and_grad(loss_of)(p, x, y, sub)
+            upd, s = self.tx.update(g, s, p)
+            p = optax.apply_updates(p, upd)
+            return (p, s, key), l
+
+        (params, opt_state, _), losses = jax.lax.scan(
+            body, (params, opt_state, rng), (xw, yw))
+        return params, opt_state, jnp.mean(losses)
+
+    def _make_round_fn(self) -> Callable:
+        n = self.num_workers
+        algo = self.algorithm
+        alpha = self.alpha
+
+        def round_fn(center, local, opt_state, round_idx, xw, yw, rngs):
+            # Block shapes inside shard_map: local/opt_state leaves and the
+            # batch data carry a leading worker axis of size 1 — squeeze it.
+            squeeze = lambda t: tmap(lambda v: v[0], t)
+            local_p = squeeze(local)
+            opt_s = squeeze(opt_state)
+            x = xw[0]
+            y = yw[0]
+            rng = rngs[0]
+
+            if algo in ("adag", "downpour", "dynsgd"):
+                # "pull": start from the replicated center; mark it
+                # device-varying so the per-worker scan carry typechecks.
+                start = tmap(
+                    lambda v: jax.lax.pcast(v, WORKER_AXIS, to="varying"),
+                    center)
+            else:  # EASGD family + 'local' keep persistent local params
+                start = local_p
+            new_p, new_s, loss = self._local_window(start, opt_s, x, y, rng)
+
+            if algo == "adag":
+                delta = rules.tree_sub(new_p, center)
+                summed = tmap(lambda d: jax.lax.psum(d, WORKER_AXIS), delta)
+                center = rules.adag_commit(center, summed, n)
+            elif algo == "downpour":
+                delta = rules.tree_sub(new_p, center)
+                summed = tmap(lambda d: jax.lax.psum(d, WORKER_AXIS), delta)
+                center = rules.delta_commit(center, summed)
+            elif algo == "dynsgd":
+                # Serialized-commit emulation: within a round, worker w's
+                # commit lands after ``order`` earlier commits, where the
+                # order rotates every round — its delta is scaled by
+                # 1/(staleness+1) exactly as DynSGDParameterServer does.
+                w = jax.lax.axis_index(WORKER_AXIS)
+                order = jnp.mod(w + round_idx, n).astype(jnp.float32)
+                delta = rules.tree_sub(new_p, center)
+                scaled = rules.dynsgd_commit(
+                    tmap(jnp.zeros_like, center), delta, order)
+                summed = tmap(lambda d: jax.lax.psum(d, WORKER_AXIS), scaled)
+                center = rules.tree_add(center, summed)
+            elif algo == "local":
+                # Independent per-worker training (AveragingTrainer /
+                # EnsembleTrainer): no exchange; center untouched.
+                pass
+            elif algo in ("aeasgd", "eamsgd"):
+                e = rules.elastic_difference(new_p, center, alpha)
+                new_p = rules.easgd_worker_update(new_p, e)
+                summed = tmap(lambda d: jax.lax.psum(d, WORKER_AXIS), e)
+                center = rules.easgd_center_update(center, summed)
+            else:
+                raise ValueError(f"unknown algorithm {algo!r}")
+
+            mean_loss = jax.lax.psum(loss, WORKER_AXIS) / n
+            unsqueeze = lambda t: tmap(lambda v: v[None], t)
+            return (center, unsqueeze(new_p), unsqueeze(new_s), mean_loss)
+
+        return round_fn
+
+    # -- epoch program -------------------------------------------------------
+    def _build_epoch_fn(self) -> Callable:
+        round_fn = self._make_round_fn()
+        mesh = self.mesh
+        shmapped = jax.shard_map(
+            round_fn,
+            mesh=mesh,
+            in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(),
+                      P(None, WORKER_AXIS), P(None, WORKER_AXIS),
+                      P(WORKER_AXIS)),
+            out_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
+        )
+
+        def epoch(state: DistState, xb, yb, rngs):
+            # xb, yb: (rounds, window, workers, batch, ...) sharded on axis 2
+            def body(carry, inp):
+                center, local, opt_state, ridx, keys = carry
+                x, y = inp
+                next_keys = jax.vmap(
+                    lambda k: jax.random.fold_in(k, ridx))(keys)
+                center, local, opt_state, loss = shmapped(
+                    center, local, opt_state, ridx, x, y, next_keys)
+                return (center, local, opt_state, ridx + 1, keys), loss
+
+            (center, local, opt_state, ridx, _), losses = jax.lax.scan(
+                body, (state.center, state.local, state.opt_state,
+                       state.round_idx, rngs), (xb, yb))
+            return DistState(center, local, opt_state, ridx), losses
+
+        return jax.jit(epoch, donate_argnums=(0,))
+
+    def run_epoch(self, state: DistState, xb, yb, rngs
+                  ) -> Tuple[DistState, np.ndarray]:
+        """xb/yb: np arrays shaped (rounds, window, workers, batch, ...)."""
+        if self._epoch_fn is None:
+            self._epoch_fn = self._build_epoch_fn()
+        sh = NamedSharding(self.mesh, P(None, None, WORKER_AXIS))
+        xb = jax.device_put(xb, sh)
+        yb = jax.device_put(yb, sh)
+        state, losses = self._epoch_fn(state, xb, yb, rngs)
+        return state, losses
+
+    def worker_rngs(self, seed: int):
+        keys = jax.random.split(jax.random.PRNGKey(seed), self.num_workers)
+        return jax.device_put(keys, worker_sharded(self.mesh))
+
+
+def shape_epoch_data(columns_x: np.ndarray, columns_y: np.ndarray,
+                     num_workers: int, window: int, batch_size: int):
+    """Reshape flat (rows, ...) arrays into (rounds, window, workers, batch, ...).
+
+    The worker axis is placed *inside* the scan axes so the arrays can be
+    device_put with a single ``P(None, None, 'workers')`` sharding and scanned
+    over rounds/window without any transposition inside the program.
+    Rows are truncated to fill an integer number of rounds (Spark's
+    repartition drops nothing, but SPMD static shapes require it; at MNIST
+    scale the truncation is < one round of data).
+    """
+    n, w, b = num_workers, window, batch_size
+    per_round = n * w * b
+    rounds = len(columns_x) // per_round
+    if rounds == 0:
+        raise ValueError(
+            f"dataset of {len(columns_x)} rows is smaller than one round "
+            f"(workers({n}) * window({w}) * batch({b}) = {per_round})")
+    rows = rounds * per_round
+
+    def reshape(a):
+        a = a[:rows]
+        # rows laid out worker-major so each worker sees a contiguous shard:
+        # (workers, rounds, window, batch, ...) then moved to
+        # (rounds, window, workers, batch, ...)
+        a = a.reshape((n, rounds, w, b) + a.shape[1:])
+        return np.moveaxis(a, 0, 2)
+
+    return reshape(columns_x), reshape(columns_y), rounds

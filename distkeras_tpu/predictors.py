@@ -1,0 +1,77 @@
+"""Distributed inference (reference: ``distkeras/predictors.py``).
+
+``ModelPredictor.predict(dataset)`` appends a ``prediction`` column holding
+the model's dense output vector for every row — parity with the reference's
+``ModelPredictor.predict(df)`` (SURVEY.md §3.3), but instead of deserializing
+the model once per Spark partition and looping rows through ``model.predict``,
+the forward pass is jitted once and run as large sharded batches across the
+device mesh (batch-dim data parallelism over ICI).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .core.model import Sequential, FittedModel
+from .data.dataset import Dataset
+from .parallel import mesh as mesh_lib
+
+
+class Predictor:
+    """Base class (reference: ``predictors.py :: Predictor``)."""
+
+    def predict(self, dataset: Dataset) -> Dataset:  # pragma: no cover
+        raise NotImplementedError
+
+
+class ModelPredictor(Predictor):
+    def __init__(self, keras_model: Union[FittedModel, Sequential],
+                 features_col: str = "features",
+                 output_col: str = "prediction",
+                 batch_size: int = 1024, mesh=None):
+        if isinstance(keras_model, FittedModel):
+            self.model = keras_model.model
+            self.params = keras_model.params
+        else:
+            raise TypeError(
+                "ModelPredictor needs a FittedModel (a trained model with "
+                "weights); got a bare Sequential spec")
+        self.features_col = features_col
+        self.output_col = output_col
+        self.batch_size = int(batch_size)
+        self.mesh = mesh
+
+    def predict(self, dataset: Dataset) -> Dataset:
+        x = np.asarray(dataset[self.features_col])
+        mesh = self.mesh
+        if mesh is None and len(jax.devices()) > 1:
+            mesh = mesh_lib.get_mesh()
+        if mesh is not None:
+            preds = self._predict_sharded(x, mesh)
+        else:
+            preds = self.model.predict(self.params, x,
+                                       batch_size=self.batch_size)
+        return dataset.with_column(self.output_col, preds)
+
+    def _predict_sharded(self, x: np.ndarray, mesh) -> np.ndarray:
+        """Batch-parallel forward over the mesh: pad rows to a multiple of the
+        worker count, shard the batch dim, run one jitted apply per chunk."""
+        n_dev = mesh.devices.size
+        chunk = self.batch_size * n_dev
+        sharding = NamedSharding(mesh, P(mesh_lib.WORKER_AXIS))
+        fn = jax.jit(lambda p, b: self.model.apply(p, b, train=False),
+                     out_shardings=sharding)
+        outs = []
+        for i in range(0, len(x), chunk):
+            block = x[i:i + chunk]
+            pad = (-len(block)) % n_dev
+            if pad:
+                block = np.concatenate([block, block[-1:].repeat(pad, 0)])
+            blk = jax.device_put(block, sharding)
+            out = np.asarray(fn(self.params, blk))
+            outs.append(out[:len(out) - pad] if pad else out)
+        return np.concatenate(outs, axis=0)

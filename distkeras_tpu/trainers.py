@@ -1,0 +1,357 @@
+"""Trainer hierarchy — the public API of the framework.
+
+API parity with the reference trainer set (reference:
+``distkeras/trainers.py`` — SURVEY.md §2.1 rows 1–11): ``SingleTrainer``,
+``AveragingTrainer``, ``EnsembleTrainer``, and the parameter-server algorithms
+``DOWNPOUR``, ``ADAG``, ``AEASGD``, ``EAMSGD``, ``DynSGD``.  Constructor kwargs
+match the reference spellings (``keras_model``, ``worker_optimizer``, ``loss``,
+``num_workers``, ``batch_size``, ``features_col``, ``label_col``,
+``num_epoch``, ``communication_window``, ``rho``, ``momentum``, ...), and
+``train(dataset) -> FittedModel`` plus ``get_training_time()`` behave the same.
+
+Execution is entirely different (that's the point): instead of shipping a
+pickled worker closure to Spark executors and exchanging deltas with a socket
+PS (reference ``DistributedTrainer.train`` → ``rdd.mapPartitionsWithIndex``),
+training compiles into a single SPMD XLA program per epoch over a TPU device
+mesh (see ``parallel/spmd.py``).  The async algorithms keep their update rules
+with commits executing in deterministic bulk-synchronous rounds; the
+semantically-exact threaded-async path is available with
+``execution='host_ps'`` (see ``parameter_servers.py``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.model import Sequential, FittedModel, serialize_model
+from .core import optimizers as opt_lib
+from .core.train import init_state, make_epoch_runner
+from .data.dataset import Dataset
+from .parallel import mesh as mesh_lib
+from .parallel.spmd import SPMDEngine, DistState, shape_epoch_data
+from .parallel import rules
+
+tmap = jax.tree_util.tree_map
+
+
+def _as_model(keras_model) -> Sequential:
+    """Accept a native Sequential or a Keras model (converted via adapter)."""
+    if isinstance(keras_model, Sequential):
+        return keras_model
+    if isinstance(keras_model, FittedModel):
+        return keras_model.model
+    try:
+        from .core.keras_adapter import convert_keras_model
+        return convert_keras_model(keras_model)
+    except ImportError:  # pragma: no cover
+        raise TypeError(f"Cannot interpret model {type(keras_model)}")
+
+
+class Trainer:
+    """Abstract base (reference: ``trainers.py :: Trainer``).
+
+    Holds the model spec + loss + worker optimizer and the wall-clock
+    bookkeeping (``record_training_start/stop``, ``get_training_time``).
+    """
+
+    def __init__(self, keras_model, loss: str = "categorical_crossentropy",
+                 worker_optimizer="sgd", learning_rate: Optional[float] = None,
+                 seed: int = 0):
+        self.master_model = _as_model(keras_model)
+        self.loss = loss
+        self.worker_optimizer = worker_optimizer
+        self.learning_rate = learning_rate
+        self.seed = seed
+        self.history: List[float] = []
+        self.training_time = 0.0
+        self._time_start: Optional[float] = None
+        self._fitted: Optional[FittedModel] = None
+        if isinstance(keras_model, FittedModel):
+            self._initial_weights = keras_model.get_weights()
+        else:
+            self._initial_weights = None
+
+    # -- timing (exact parity with reference Trainer) ------------------------
+    def record_training_start(self):
+        self.training_time = 0.0
+        self._time_start = time.time()
+
+    def record_training_stop(self):
+        assert self._time_start is not None
+        self.training_time = time.time() - self._time_start
+
+    def get_training_time(self) -> float:
+        return self.training_time
+
+    def get_history(self) -> List[float]:
+        return self.history
+
+    # -- model plumbing ------------------------------------------------------
+    def _initial_params(self, input_shape):
+        params = self.master_model.init(jax.random.PRNGKey(self.seed),
+                                        input_shape)
+        if self._initial_weights is not None:
+            params = self.master_model.set_weights(params,
+                                                   self._initial_weights)
+        return params
+
+    def serialize(self) -> dict:
+        """Serialized master model (reference: ``Trainer.serialize``)."""
+        if self._fitted is not None:
+            return self._fitted.serialize()
+        raise ValueError("Trainer has no fitted model yet; call train() first")
+
+    def train(self, dataset: Dataset, shuffle: bool = False) -> FittedModel:
+        raise NotImplementedError
+
+
+class SingleTrainer(Trainer):
+    """Single-device baseline (reference: ``trainers.py :: SingleTrainer`` —
+    coalesce to one partition, one SequentialWorker).  Here: one chip, the
+    whole epoch as one jitted ``lax.scan`` over minibatches."""
+
+    def __init__(self, keras_model, features_col: str = "features",
+                 label_col: str = "label", batch_size: int = 32,
+                 num_epoch: int = 1, loss: str = "categorical_crossentropy",
+                 worker_optimizer="sgd", learning_rate=None, seed: int = 0):
+        super().__init__(keras_model, loss, worker_optimizer, learning_rate,
+                         seed)
+        self.features_col = features_col
+        self.label_col = label_col
+        self.batch_size = int(batch_size)
+        self.num_epoch = int(num_epoch)
+
+    def train(self, dataset: Dataset, shuffle: bool = False) -> FittedModel:
+        self.record_training_start()
+        x = dataset[self.features_col]
+        y = dataset[self.label_col]
+        input_shape = x.shape[1:]
+        params = self._initial_params(input_shape)
+        state, tx = init_state(self.master_model, jax.random.PRNGKey(self.seed),
+                               input_shape, self.worker_optimizer,
+                               self.learning_rate)
+        state = state._replace(params=params)
+        runner = make_epoch_runner(self.master_model, self.loss, tx)
+        rng = jax.random.PRNGKey(self.seed + 1)
+        for epoch in range(self.num_epoch):
+            if shuffle:
+                ds = Dataset({"x": x, "y": y}).shuffle(self.seed + epoch)
+                xe, ye = ds["x"], ds["y"]
+            else:
+                xe, ye = x, y
+            nb = len(xe) // self.batch_size
+            if nb == 0:
+                raise ValueError(
+                    f"batch_size {self.batch_size} exceeds dataset size "
+                    f"{len(xe)}")
+            rows = nb * self.batch_size
+            xb = xe[:rows].reshape((nb, self.batch_size) + xe.shape[1:])
+            yb = ye[:rows].reshape((nb, self.batch_size) + ye.shape[1:])
+            rng, sub = jax.random.split(rng)
+            state, losses = runner(state, jnp.asarray(xb), jnp.asarray(yb),
+                                   sub)
+            self.history.extend(np.asarray(losses).tolist())
+        self._fitted = FittedModel(self.master_model, state.params)
+        self.record_training_stop()
+        return self._fitted
+
+
+class DistributedTrainer(Trainer):
+    """Base for multi-worker trainers (reference:
+    ``trainers.py :: DistributedTrainer``): owns worker count, batch/window
+    config, and the train() lifecycle.  The reference's ``service()`` (PS
+    thread startup) maps to mesh construction + engine build here."""
+
+    ALGORITHM = "local"
+    DEFAULT_WINDOW = 5
+
+    def __init__(self, keras_model, num_workers: Optional[int] = None,
+                 batch_size: int = 32, features_col: str = "features",
+                 label_col: str = "label", num_epoch: int = 1,
+                 communication_window: Optional[int] = None,
+                 loss: str = "categorical_crossentropy",
+                 worker_optimizer="sgd", learning_rate=None,
+                 execution: str = "spmd", mesh=None, seed: int = 0):
+        super().__init__(keras_model, loss, worker_optimizer, learning_rate,
+                         seed)
+        self.mesh = mesh if mesh is not None else mesh_lib.get_mesh(num_workers)
+        self.num_workers = int(self.mesh.devices.size)
+        self.batch_size = int(batch_size)
+        self.features_col = features_col
+        self.label_col = label_col
+        self.num_epoch = int(num_epoch)
+        self.communication_window = int(
+            communication_window if communication_window is not None
+            else self.DEFAULT_WINDOW)
+        self.execution = execution
+        self._engine: Optional[SPMDEngine] = None
+        self._state: Optional[DistState] = None
+
+    # -- engine lifecycle (≈ reference service()/stop_service()) -------------
+    def _elastic_alpha(self) -> Optional[float]:
+        return None
+
+    def service(self, input_shape) -> SPMDEngine:
+        engine = SPMDEngine(
+            self.master_model, self.loss, self.worker_optimizer, self.mesh,
+            self.ALGORITHM, self.communication_window, self.learning_rate,
+            alpha=self._elastic_alpha())
+        self._state = engine.init_state(
+            jax.random.PRNGKey(self.seed), self._input_shape,
+            initial_params=self._initial_params(self._input_shape))
+        return engine
+
+    def train(self, dataset: Dataset, shuffle: bool = False) -> FittedModel:
+        if self.execution == "host_ps":
+            from .parameter_servers import run_host_ps_training
+            return run_host_ps_training(self, dataset, shuffle)
+        self.record_training_start()
+        x = np.asarray(dataset[self.features_col])
+        y = np.asarray(dataset[self.label_col])
+        self._input_shape = x.shape[1:]
+        engine = self.service(self._input_shape)
+        self._engine = engine
+        rngs = engine.worker_rngs(self.seed + 17)
+        for epoch in range(self.num_epoch):
+            if shuffle:
+                # deterministic per-epoch reshuffle (reference shuffles once
+                # up front via utils.shuffle; per-epoch is strictly better
+                # for convergence and still seed-reproducible)
+                perm = np.random.default_rng(self.seed + epoch).permutation(
+                    len(x))
+                xe, ye = x[perm], y[perm]
+            else:
+                xe, ye = x, y
+            xb, yb, _ = shape_epoch_data(xe, ye, self.num_workers,
+                                         self.communication_window,
+                                         self.batch_size)
+            self._state, losses = engine.run_epoch(self._state, xb, yb, rngs)
+            self.history.extend(np.asarray(losses).tolist())
+        center = jax.device_get(self._state.center)
+        self._fitted = FittedModel(self.master_model, center)
+        self.record_training_stop()
+        return self._fitted
+
+
+class AsynchronousDistributedTrainer(DistributedTrainer):
+    """Async-family base (reference: same-named class). On the SPMD engine the
+    async commits execute as deterministic rounds; semantics notes in
+    ``parallel/spmd.py``."""
+
+
+class SynchronousDistributedTrainer(DistributedTrainer):
+    """Sync-family base (reference: same-named class)."""
+
+
+class DOWNPOUR(AsynchronousDistributedTrainer):
+    """DistBelief-style async SGD (reference: ``trainers.py :: DOWNPOUR``):
+    workers push raw accumulated deltas every window (default 5) and re-pull
+    the center.  SPMD form: center += Σᵢ Δᵢ each round."""
+    ALGORITHM = "downpour"
+    DEFAULT_WINDOW = 5
+
+
+class ADAG(AsynchronousDistributedTrainer):
+    """Asynchronous Distributed Adaptive Gradients (reference:
+    ``trainers.py :: ADAG``) — the flagship/north-star algorithm.  Window
+    deltas are normalized over commit count before applying: in bulk-sync form
+    this is exactly an all-reduce *mean* of window deltas over ICI
+    (center += Σᵢ Δᵢ / N)."""
+    ALGORITHM = "adag"
+    DEFAULT_WINDOW = 12
+
+
+class DynSGD(AsynchronousDistributedTrainer):
+    """Staleness-aware async SGD (reference: ``trainers.py :: DynSGD``,
+    ``parameter_servers.py :: DynSGDParameterServer``): each commit is scaled
+    by 1/(staleness+1).  SPMD form emulates serialized commits with a
+    per-round rotation (see ``parallel/spmd.py``)."""
+    ALGORITHM = "dynsgd"
+    DEFAULT_WINDOW = 5
+
+
+class AEASGD(AsynchronousDistributedTrainer):
+    """Asynchronous Elastic Averaging SGD (Zhang et al. 2015; reference:
+    ``trainers.py :: AEASGD``).  Worker keeps persistent local params; every
+    window the elastic force α·(x−x̃) with α = learning_rate·rho is subtracted
+    locally and added to the center."""
+    ALGORITHM = "aeasgd"
+    DEFAULT_WINDOW = 32
+
+    def __init__(self, keras_model, rho: float = 5.0,
+                 learning_rate: float = 0.1, **kw):
+        super().__init__(keras_model, learning_rate=learning_rate, **kw)
+        self.rho = float(rho)
+
+    def _elastic_alpha(self) -> float:
+        lr = self.learning_rate if self.learning_rate is not None else 0.1
+        return self.rho * lr
+
+
+class EAMSGD(AEASGD):
+    """Elastic averaging with Nesterov momentum on the local update
+    (reference: ``trainers.py :: EAMSGD``, ``momentum`` default 0.9).  The
+    momentum lives in the worker optimizer (SGD+Nesterov); the elastic
+    exchange is identical to AEASGD."""
+    ALGORITHM = "eamsgd"
+
+    def __init__(self, keras_model, rho: float = 5.0,
+                 learning_rate: float = 0.1, momentum: float = 0.9, **kw):
+        kw.pop("worker_optimizer", None)
+        super().__init__(
+            keras_model, rho=rho, learning_rate=learning_rate,
+            worker_optimizer=opt_lib.SGD(learning_rate=learning_rate,
+                                         momentum=momentum, nesterov=True),
+            **kw)
+        self.momentum = float(momentum)
+
+
+class AveragingTrainer(DistributedTrainer):
+    """One-shot parameter averaging (reference:
+    ``trainers.py :: AveragingTrainer``): each worker trains independently on
+    its shard; the result is the weight average."""
+    ALGORITHM = "local"
+
+    def __init__(self, keras_model, **kw):
+        kw.setdefault("communication_window", 1)
+        super().__init__(keras_model, **kw)
+
+    def train(self, dataset: Dataset, shuffle: bool = False) -> FittedModel:
+        super().train(dataset, shuffle)
+        # average the per-worker local params (leading axis = workers)
+        local = jax.device_get(self._state.local)
+        avg = tmap(lambda v: np.mean(v, axis=0), local)
+        self._fitted = FittedModel(self.master_model, avg)
+        return self._fitted
+
+
+class EnsembleTrainer(DistributedTrainer):
+    """k independent models trained in parallel, returned as a list
+    (reference: ``trainers.py :: EnsembleTrainer``)."""
+    ALGORITHM = "local"
+
+    def __init__(self, keras_model, num_models: Optional[int] = None, **kw):
+        kw.setdefault("communication_window", 1)
+        if num_models is not None:
+            kw.setdefault("num_workers", num_models)
+        super().__init__(keras_model, **kw)
+        self.num_models = self.num_workers
+
+    def train(self, dataset: Dataset, shuffle: bool = False
+              ) -> List[FittedModel]:
+        super().train(dataset, shuffle)
+        local = jax.device_get(self._state.local)
+        models = []
+        for i in range(self.num_workers):
+            params_i = tmap(lambda v: v[i], local)
+            models.append(FittedModel(self.master_model, params_i))
+        self._ensemble = models
+        # serialize() should reflect trained weights, not the untouched
+        # center; use the first ensemble member as the representative.
+        self._fitted = models[0]
+        return models

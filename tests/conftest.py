@@ -1,0 +1,37 @@
+"""Test environment: force an 8-device virtual CPU platform *before* JAX
+initializes, so distributed-trainer tests exercise real mesh sharding +
+collectives without TPU hardware (SURVEY.md §4's multi-device simulation —
+the idiomatic analogue of the reference's Spark ``local[*]`` fake cluster).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the sandbox presets a TPU tunnel
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# jax may already be imported at interpreter startup (sitecustomize) with the
+# sandbox's JAX_PLATFORMS=axon snapshot — override through the config API,
+# which works any time before first backend initialization.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {devs}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

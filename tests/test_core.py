@@ -1,0 +1,163 @@
+"""Core layer/model/loss/optimizer tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.core import (Sequential, Dense, Conv2D, MaxPooling2D,
+                                Flatten, Reshape, Activation, Dropout,
+                                BatchNormalization)
+from distkeras_tpu.core.model import (serialize_model, deserialize_model,
+                                      FittedModel)
+from distkeras_tpu.core.losses import (categorical_crossentropy,
+                                       binary_crossentropy,
+                                       mean_squared_error, get_loss)
+from distkeras_tpu.core import optimizers as opt_lib
+from distkeras_tpu.core.train import init_state, make_train_step
+
+
+def small_mlp(cdtype="float32"):
+    return Sequential([Dense(16, activation="relu"),
+                       Dense(4, activation="softmax")],
+                      input_shape=(8,), compute_dtype=cdtype)
+
+
+def test_dense_forward_shapes():
+    m = small_mlp()
+    params = m.init(jax.random.PRNGKey(0))
+    x = jnp.ones((5, 8))
+    y = m.apply(params, x)
+    assert y.shape == (5, 4)
+    np.testing.assert_allclose(np.sum(np.asarray(y), axis=-1),
+                               np.ones(5), rtol=1e-5)
+
+
+def test_dense_matches_manual_matmul():
+    m = Sequential([Dense(3)], input_shape=(2,), compute_dtype="float32")
+    params = m.init(jax.random.PRNGKey(1))
+    x = np.array([[1.0, 2.0]], np.float32)
+    want = x @ np.asarray(params[0]["kernel"]) + np.asarray(params[0]["bias"])
+    got = np.asarray(m.apply(params, x))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_conv_pool_flatten_shapes():
+    m = Sequential([
+        Reshape((8, 8, 1)),
+        Conv2D(4, 3, padding="SAME", activation="relu"),
+        MaxPooling2D(2),
+        Flatten(),
+        Dense(10, activation="softmax"),
+    ], input_shape=(64,), compute_dtype="float32")
+    params = m.init(jax.random.PRNGKey(0))
+    y = m.apply(params, jnp.ones((2, 64)))
+    assert y.shape == (2, 10)
+    assert m.output_shape == (10,)
+
+
+def test_bf16_compute_close_to_f32():
+    m32 = small_mlp("float32")
+    mbf = small_mlp("bfloat16")
+    params = m32.init(jax.random.PRNGKey(2))
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((4, 8)),
+                    jnp.float32)
+    y32 = np.asarray(m32.apply(params, x))
+    ybf = np.asarray(mbf.apply(params, x))
+    np.testing.assert_allclose(y32, ybf, atol=0.03)
+
+
+def test_dropout_train_vs_eval():
+    m = Sequential([Dropout(0.5)], input_shape=(10,),
+                   compute_dtype="float32")
+    params = m.init(jax.random.PRNGKey(0))
+    x = jnp.ones((4, 10))
+    y_eval = m.apply(params, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.ones((4, 10)))
+    y_train = m.apply(params, x, train=True, rng=jax.random.PRNGKey(3))
+    vals = np.unique(np.asarray(y_train))
+    assert set(np.round(vals, 5)).issubset({0.0, 2.0})
+
+
+def test_batchnorm_shapes():
+    m = Sequential([Dense(6), BatchNormalization(), Activation("relu")],
+                   input_shape=(3,), compute_dtype="float32")
+    params = m.init(jax.random.PRNGKey(0))
+    y = m.apply(params, jnp.ones((5, 3)), train=True)
+    assert y.shape == (5, 6)
+    y_eval = m.apply(params, jnp.ones((5, 3)), train=False)
+    assert y_eval.shape == (5, 6)
+
+
+def test_serialize_roundtrip():
+    m = small_mlp()
+    params = m.init(jax.random.PRNGKey(0))
+    blob = serialize_model(m, params)
+    m2, params2 = deserialize_model(blob)
+    x = jnp.ones((3, 8))
+    np.testing.assert_allclose(np.asarray(m.apply(params, x)),
+                               np.asarray(m2.apply(params2, x)), rtol=1e-6)
+
+
+def test_fitted_model_save_load(tmp_path):
+    m = small_mlp()
+    params = m.init(jax.random.PRNGKey(0))
+    fm = FittedModel(m, params)
+    path = str(tmp_path / "model.npz")
+    fm.save(path)
+    fm2 = FittedModel.load(path)
+    x = np.ones((2, 8), np.float32)
+    np.testing.assert_allclose(fm.predict(x), fm2.predict(x), rtol=1e-6)
+
+
+def test_conv_model_json_roundtrip():
+    # tuples in layer configs (pool_size/strides/target_shape) must survive
+    # the JSON round-trip as tuples
+    from distkeras_tpu.models import mnist_convnet
+    m = mnist_convnet("float32")
+    params = m.init(jax.random.PRNGKey(0))
+    blob = serialize_model(m, params)
+    m2, params2 = deserialize_model(blob)
+    x = np.random.default_rng(0).uniform(0, 1, (2, 784)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(m.apply(params, x)),
+                               np.asarray(m2.apply(params2, x)), rtol=1e-5)
+
+
+def test_losses_closed_form():
+    y = jnp.array([[0.0, 1.0]])
+    p = jnp.array([[0.3, 0.7]])
+    np.testing.assert_allclose(
+        float(categorical_crossentropy(y, p)), -np.log(0.7), rtol=1e-3)
+    np.testing.assert_allclose(
+        float(mean_squared_error(jnp.array([1.0]), jnp.array([3.0]))), 4.0)
+    np.testing.assert_allclose(
+        float(binary_crossentropy(jnp.array([1.0]), jnp.array([0.5]))),
+        -np.log(0.5), rtol=1e-3)
+    with pytest.raises(ValueError):
+        get_loss("nope")
+
+
+def test_optimizer_resolution():
+    for name in ["sgd", "adam", "adagrad", "adadelta", "rmsprop"]:
+        opt = opt_lib.get_optimizer(name)
+        assert opt.to_optax() is not None
+    opt = opt_lib.get_optimizer(opt_lib.SGD(learning_rate=0.5))
+    assert opt.hyper["learning_rate"] == 0.5
+
+
+def test_train_step_reduces_loss():
+    m = small_mlp()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 8)).astype(np.float32)
+    labels = (x[:, 0] > 0).astype(np.int64)
+    y = np.eye(4, dtype=np.float32)[labels]
+    state, tx = init_state(m, jax.random.PRNGKey(0), (8,), "sgd", 0.1)
+    step = jax.jit(make_train_step(m, "categorical_crossentropy", tx))
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for i in range(30):
+        key, sub = jax.random.split(key)
+        state, l = step(state, (jnp.asarray(x), jnp.asarray(y)), sub)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.9
+    assert int(state.step) == 30

@@ -1,0 +1,67 @@
+"""Closed-form unit tests for the per-algorithm update rules (SURVEY.md §4:
+"unit-test each algorithm's update rule as a pure function").
+
+Each rule is checked against hand-computed numbers matching the reference PS
+semantics (DeltaParameterServer, ADAGParameterServer, DynSGDParameterServer,
+AEASGDWorker's elastic term).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.parallel import rules
+
+
+def tree(v):
+    return [{"kernel": jnp.asarray(v, jnp.float32)}]
+
+
+def leaf(t):
+    return np.asarray(t[0]["kernel"])
+
+
+def test_delta_commit():
+    center = tree([1.0, 2.0])
+    delta = tree([0.5, -1.0])
+    np.testing.assert_allclose(leaf(rules.delta_commit(center, delta)),
+                               [1.5, 1.0])
+
+
+def test_adag_commit_normalizes():
+    center = tree([0.0, 0.0])
+    summed = tree([4.0, 8.0])  # sum over 4 workers' deltas
+    out = rules.adag_commit(center, summed, 4)
+    np.testing.assert_allclose(leaf(out), [1.0, 2.0])
+
+
+def test_elastic_difference_and_updates():
+    local = tree([2.0])
+    center = tree([1.0])
+    alpha = 0.5
+    e = rules.elastic_difference(local, center, alpha)
+    np.testing.assert_allclose(leaf(e), [0.5])  # α(x − x̃)
+    new_local = rules.easgd_worker_update(local, e)
+    np.testing.assert_allclose(leaf(new_local), [1.5])  # x − e
+    new_center = rules.easgd_center_update(center, e)
+    np.testing.assert_allclose(leaf(new_center), [1.5])  # x̃ + e
+
+
+def test_elastic_fixed_point():
+    # at local == center the elastic force vanishes
+    local = center = tree([3.0])
+    e = rules.elastic_difference(local, center, 0.9)
+    np.testing.assert_allclose(leaf(e), [0.0])
+
+
+def test_dynsgd_staleness_scaling():
+    center = tree([0.0])
+    delta = tree([6.0])
+    np.testing.assert_allclose(
+        leaf(rules.dynsgd_commit(center, delta, 0.0)), [6.0])  # fresh
+    np.testing.assert_allclose(
+        leaf(rules.dynsgd_commit(center, delta, 2.0)), [2.0])  # stale by 2
+
+
+def test_average_trees():
+    out = rules.average_trees([tree([1.0, 3.0]), tree([3.0, 5.0])])
+    np.testing.assert_allclose(leaf(out), [2.0, 4.0])
