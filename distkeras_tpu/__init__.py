@@ -23,3 +23,6 @@ from .trainers import (Trainer, SingleTrainer, AveragingTrainer,
 from .predictors import Predictor, ModelPredictor
 from .evaluators import Evaluator, AccuracyEvaluator, LossEvaluator
 from . import utils
+from . import networking
+from . import workers
+from . import parameter_servers

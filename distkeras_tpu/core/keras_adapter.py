@@ -1,0 +1,141 @@
+"""Keras → native model conversion.
+
+The reference's public API takes an actual ``keras.Model``
+(reference: ``distkeras/trainers.py :: Trainer.__init__(keras_model=...)``).
+For drop-in familiarity our trainers accept one too: this adapter converts a
+Keras ``Sequential`` of supported layer types into the native declarative
+``Sequential`` (whose forward pass is a pure jittable function), and extracts
+the Keras weights **re-ordered into the native pytree leaf order** so a
+converted model starts from identical parameters.
+
+Import of ``keras`` is deferred and optional — the framework itself never
+needs it; only users handing us Keras objects do.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .model import Sequential
+from . import layers as L
+
+
+def _require_keras():
+    try:
+        import keras  # noqa: F401
+        return keras
+    except ImportError as e:  # pragma: no cover - env without keras
+        raise ImportError(
+            "Converting a Keras model requires the `keras` package; "
+            "build the model with distkeras_tpu.core layers instead "
+            "(same constructor surface: Dense/Conv2D/MaxPooling2D/...)."
+        ) from e
+
+
+def _act_name(activation) -> str:
+    name = getattr(activation, "__name__", None) or str(activation)
+    return {"linear": None}.get(name, name)
+
+
+def _convert_layer(kl) -> List[L.Layer]:
+    """One Keras layer → zero or more native layers."""
+    t = type(kl).__name__
+    cfg = kl.get_config()
+    if t == "Dense":
+        return [L.Dense(cfg["units"], activation=_act_name(kl.activation),
+                        use_bias=cfg.get("use_bias", True))]
+    if t == "Conv2D":
+        if cfg.get("data_format") == "channels_first":
+            raise ValueError("channels_first Conv2D not supported (TPU-native "
+                             "layout is NHWC)")
+        dil = tuple(np.broadcast_to(cfg.get("dilation_rate", 1), (2,)))
+        if dil != (1, 1) or cfg.get("groups", 1) != 1:
+            raise ValueError(
+                f"Conv2D with dilation_rate={dil} / groups="
+                f"{cfg.get('groups', 1)} is not supported by the converter; "
+                "converting would silently change the computed function")
+        return [L.Conv2D(cfg["filters"], cfg["kernel_size"],
+                         strides=cfg.get("strides", 1),
+                         padding=cfg.get("padding", "valid"),
+                         activation=_act_name(kl.activation),
+                         use_bias=cfg.get("use_bias", True))]
+    if t == "MaxPooling2D":
+        return [L.MaxPooling2D(cfg["pool_size"], cfg.get("strides"),
+                               cfg.get("padding", "valid"))]
+    if t == "AveragePooling2D":
+        return [L.AveragePooling2D(cfg["pool_size"], cfg.get("strides"),
+                                   cfg.get("padding", "valid"))]
+    if t == "GlobalAveragePooling2D":
+        return [L.GlobalAveragePooling2D()]
+    if t == "Flatten":
+        return [L.Flatten()]
+    if t == "Reshape":
+        return [L.Reshape(cfg["target_shape"])]
+    if t == "Activation":
+        return [L.Activation(_act_name(kl.activation))]
+    if t == "Dropout":
+        return [L.Dropout(cfg["rate"])]
+    if t == "BatchNormalization":
+        axis = cfg.get("axis", -1)
+        axis = axis[0] if isinstance(axis, (list, tuple)) else axis
+        if axis not in (-1, 3) or not cfg.get("center", True) \
+                or not cfg.get("scale", True):
+            raise ValueError(
+                "BatchNormalization with axis != last or center/scale=False "
+                "is not supported by the converter")
+        return [L.BatchNormalization(cfg.get("momentum", 0.99),
+                                     cfg.get("epsilon", 1e-3))]
+    if t == "Embedding":
+        return [L.Embedding(cfg["input_dim"], cfg["output_dim"])]
+    if t == "InputLayer":
+        return []
+    raise ValueError(f"Unsupported Keras layer type {t!r}")
+
+
+def convert_keras_model(km) -> Sequential:
+    """Convert a Keras Sequential to the native spec (no weights)."""
+    _require_keras()
+    in_shape = getattr(km, "input_shape", None)
+    if in_shape is None:
+        raise ValueError("Keras model must be built (call it once or pass "
+                         "input_shape) before conversion")
+    native_layers: List[L.Layer] = []
+    for kl in km.layers:
+        native_layers.extend(_convert_layer(kl))
+    return Sequential(native_layers, input_shape=tuple(in_shape[1:]),
+                      name=getattr(km, "name", "converted"))
+
+
+def keras_weights(km) -> List[np.ndarray]:
+    """Keras weights re-ordered to the native pytree leaf order.
+
+    Native leaves per layer are dict keys in sorted order
+    (Dense: bias, kernel; BatchNorm: offset, scale, stats.mean, stats.var),
+    while Keras ``get_weights`` returns [kernel, bias] / [gamma, beta,
+    moving_mean, moving_var].
+    """
+    _require_keras()
+    out: List[np.ndarray] = []
+    for kl in km.layers:
+        t = type(kl).__name__
+        w = [np.asarray(a) for a in kl.get_weights()]
+        if t in ("Dense", "Conv2D"):
+            if len(w) == 2:       # [kernel, bias] → bias, kernel
+                out.extend([w[1], w[0]])
+            else:                 # no bias → kernel only
+                out.extend(w)
+        elif t == "BatchNormalization":
+            if len(w) != 4:
+                raise ValueError(
+                    f"BatchNormalization layer {kl.name!r} has {len(w)} "
+                    "weight arrays (expected 4: gamma, beta, moving_mean, "
+                    "moving_var) — center=False/scale=False are unsupported")
+            gamma, beta, mean, var = w
+            out.extend([beta, gamma, mean, var])
+        elif t == "Embedding":
+            out.extend(w)
+        elif w:
+            raise ValueError(f"Unexpected weights on Keras layer {t!r}")
+    return out

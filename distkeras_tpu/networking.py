@@ -1,0 +1,226 @@
+"""DCN host transport — the socket backend for the host-parameter-server path.
+
+Reference being replaced: ``distkeras/networking.py`` (SURVEY.md §2.4), which
+frames **pickled** Python objects over TCP with a length prefix.  This module
+keeps the same four-function API — ``determine_host_address()``,
+``connect()``, ``send_data()``, ``recv_data()`` — but replaces pickle with a
+typed binary wire format:
+
+ - a JSON header describes the message *structure* (nested dicts/lists/
+   scalars) with ndarray leaves replaced by (buffer-index, dtype, shape)
+   descriptors;
+ - tensor payloads follow as raw contiguous buffers, written/read directly
+   with zero copies on the encode side beyond ``np.ascontiguousarray``.
+
+Rationale: (a) no arbitrary-code-execution surface (pickle's classic flaw),
+(b) ndarray bulk bytes skip pickle's memo machinery — weight-delta messages
+are the entire traffic of the PS path, so tensor framing is the fast path.
+
+On TPU pods the *primary* transport is ICI collectives inside the XLA program
+(``parallel/spmd.py``); this socket layer exists for the semantically-exact
+async algorithms (``execution='host_ps'``) whose hogwild interleaving cannot
+be expressed in a bulk-synchronous SPMD program, and it rides DCN between
+hosts exactly where the reference rode the Spark driver network.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, List
+
+import numpy as np
+
+MAGIC = b"DKT1"
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+#: maximum header size we will accept (sanity bound against garbage frames)
+MAX_HEADER_BYTES = 64 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# structure encoding
+# ---------------------------------------------------------------------------
+
+def _encode_node(obj: Any, buffers: List[np.ndarray]):
+    """Recursively replace ndarray leaves with buffer descriptors."""
+    if isinstance(obj, np.ndarray):
+        idx = len(buffers)
+        buffers.append(np.ascontiguousarray(obj))
+        return {"__nd__": idx, "dtype": obj.dtype.str,
+                "shape": list(obj.shape)}
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, dict):
+        return {"__dict__": {str(k): _encode_node(v, buffers)
+                             for k, v in obj.items()}}
+    if isinstance(obj, tuple):
+        return {"__tuple__": [_encode_node(v, buffers) for v in obj]}
+    if isinstance(obj, list):
+        return [_encode_node(v, buffers) for v in obj]
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    raise TypeError(f"Cannot encode {type(obj)} on the wire")
+
+
+def _decode_node(node: Any, buffers: List[bytes]):
+    if isinstance(node, dict):
+        if "__nd__" in node:
+            arr = np.frombuffer(buffers[node["__nd__"]],
+                                dtype=np.dtype(node["dtype"]))
+            return arr.reshape(node["shape"]).copy()
+        if "__dict__" in node:
+            return {k: _decode_node(v, buffers)
+                    for k, v in node["__dict__"].items()}
+        if "__tuple__" in node:
+            return tuple(_decode_node(v, buffers) for v in node["__tuple__"])
+        raise ValueError(f"Malformed wire node: {node!r}")
+    if isinstance(node, list):
+        return [_decode_node(v, buffers) for v in node]
+    return node
+
+
+def encode_message(obj: Any) -> bytes:
+    """Serialize a message (nested dict/list/tuple/scalars/ndarrays)."""
+    buffers: List[np.ndarray] = []
+    header = json.dumps(
+        {"tree": _encode_node(obj, buffers), "nbuf": len(buffers)}
+    ).encode()
+    parts = [MAGIC, _U32.pack(len(header)), header]
+    for b in buffers:
+        raw = b.tobytes()
+        parts.append(_U64.pack(len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def _expected_buffer_sizes(tree: Any, out: dict):
+    """Collect idx → byte-size for every ndarray descriptor in a header tree,
+    so buffer lengths on the wire can be validated *before* allocation."""
+    if isinstance(tree, dict):
+        if "__nd__" in tree:
+            size = int(np.dtype(tree["dtype"]).itemsize)
+            for d in tree["shape"]:
+                size *= int(d)
+            out[int(tree["__nd__"])] = size
+        elif "__dict__" in tree:
+            for v in tree["__dict__"].values():
+                _expected_buffer_sizes(v, out)
+        elif "__tuple__" in tree:
+            for v in tree["__tuple__"]:
+                _expected_buffer_sizes(v, out)
+    elif isinstance(tree, list):
+        for v in tree:
+            _expected_buffer_sizes(v, out)
+
+
+def decode_message(data: bytes) -> Any:
+    if data[:4] != MAGIC:
+        raise ValueError("Bad magic on wire message")
+    (hlen,) = _U32.unpack_from(data, 4)
+    header = json.loads(data[8:8 + hlen].decode())
+    expected: dict = {}
+    _expected_buffer_sizes(header["tree"], expected)
+    off = 8 + hlen
+    buffers: List[bytes] = []
+    for i in range(header["nbuf"]):
+        (blen,) = _U64.unpack_from(data, off)
+        if blen != expected.get(i, -1):
+            raise ValueError(
+                f"buffer {i} declares {blen} bytes, header expects "
+                f"{expected.get(i)}")
+        off += 8
+        buffers.append(data[off:off + blen])
+        off += blen
+    return _decode_node(header["tree"], buffers)
+
+
+# ---------------------------------------------------------------------------
+# socket API (reference-parity surface: networking.py module functions)
+# ---------------------------------------------------------------------------
+
+def determine_host_address() -> str:
+    """Best-effort routable address of this host (reference:
+    ``networking.determine_host_address``).  Uses the UDP-connect trick; falls
+    back to loopback in isolated sandboxes."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))  # no packets are actually sent (UDP)
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def connect(host: str, port: int, disable_nagle: bool = True,
+            timeout: float = 60.0) -> socket.socket:
+    """TCP connect with Nagle disabled (reference: ``networking.connect`` —
+    TCP_NODELAY matters because commits are latency-sensitive small-ish
+    bursts, and the reference sets it too)."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    if disable_nagle:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = sock.recv(min(remaining, 1 << 20))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def send_data(sock: socket.socket, obj: Any) -> None:
+    """Frame and send one message (reference: ``networking.send_data``)."""
+    sock.sendall(encode_message(obj))
+
+
+def recv_data(sock: socket.socket) -> Any:
+    """Receive one full message (reference: ``networking.recv_data`` — loop
+    until the declared byte count arrives)."""
+    head = _recv_exact(sock, 8)
+    if head[:4] != MAGIC:
+        raise ValueError("Bad magic on wire message")
+    (hlen,) = _U32.unpack(head[4:])
+    if hlen > MAX_HEADER_BYTES:
+        raise ValueError(f"Header too large: {hlen}")
+    header = json.loads(_recv_exact(sock, hlen).decode())
+    # buffer lengths must match the dtype*shape the header declares — a
+    # corrupt/malicious frame cannot drive unbounded allocation
+    expected: dict = {}
+    _expected_buffer_sizes(header["tree"], expected)
+    buffers: List[bytes] = []
+    for i in range(header["nbuf"]):
+        (blen,) = _U64.unpack(_recv_exact(sock, 8))
+        if blen != expected.get(i, -1):
+            raise ValueError(
+                f"buffer {i} declares {blen} bytes, header expects "
+                f"{expected.get(i)}")
+        buffers.append(_recv_exact(sock, blen))
+    return _decode_node(header["tree"], buffers)
+
+
+def send_opcode(sock: socket.socket, op: bytes) -> None:
+    """Send a 1-byte action opcode (reference protocol: ``'p'`` pull /
+    ``'c'`` commit; we add ``'q'`` quit)."""
+    assert len(op) == 1
+    sock.sendall(op)
+
+
+def recv_opcode(sock: socket.socket) -> bytes:
+    """Receive a 1-byte opcode; returns b'' on clean EOF (worker hung up)."""
+    try:
+        op = sock.recv(1)
+    except (ConnectionError, OSError):
+        return b""
+    return op

@@ -1,0 +1,291 @@
+"""Host parameter servers — the semantically-exact asynchronous path.
+
+Reference being replaced: ``distkeras/parameter_servers.py`` (SURVEY.md §2.1
+rows 14–16, §3.4): a TCP server thread on the Spark driver holding the center
+model; one handler thread per worker connection; 1-byte actions ``'p'``
+(pull → send center weights) and ``'c'`` (commit → apply delta).  The
+reference applies commits **without a lock** (GIL-tolerated hogwild); we keep
+true hogwild *interleaving* across windows but make each individual apply
+atomic under a mutex — same algorithm semantics, no torn ndarray writes.
+
+Where this fits in the TPU design: the primary execution engine is the
+bulk-synchronous SPMD program over ICI (``parallel/spmd.py``).  This module is
+selected with ``Trainer(..., execution='host_ps')`` and exists because true
+asynchronous staleness (DOWNPOUR/DynSGD semantics) is *not representable*
+inside a single XLA program — so it runs on the host side over DCN/loopback,
+with each worker thread driving jitted window steps on its device.  Update
+rules mirror the pure functions in ``parallel/rules.py``, applied here as
+in-place numpy loops on flat weight lists for commit-path speed;
+tests/test_host_ps.py asserts the two implementations agree.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import networking
+from .core.model import FittedModel, deserialize_model, serialize_model
+from .workers import WORKER_CLASSES, share_compiled_state
+
+
+class ParameterServer:
+    """Base PS (reference: ``parameter_servers.py :: ParameterServer``):
+    holds the center weights + the update clock."""
+
+    def __init__(self, model_blob: dict):
+        self.model_blob = model_blob
+        self.center: List[np.ndarray] = [
+            np.array(w, dtype=np.float32, copy=True)
+            for w in model_blob["weights"]]
+        self.num_updates = 0
+        self._lock = threading.Lock()
+
+    def initialize(self):
+        """Reference-parity hook (center is built in __init__ here)."""
+
+    def next_update(self) -> int:
+        self.num_updates += 1
+        return self.num_updates
+
+    def get_model(self) -> FittedModel:
+        model, params = deserialize_model(
+            {"model": self.model_blob["model"], "weights": self.center})
+        return FittedModel(model, params)
+
+    # -- the per-algorithm apply rule (subclasses override) ------------------
+    def handle_commit(self, msg: Dict[str, Any]):
+        raise NotImplementedError
+
+    def handle_pull(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"weights": [w.copy() for w in self.center],
+                    "clock": self.num_updates}
+
+
+class DeltaParameterServer(ParameterServer):
+    """center += delta (reference: ``DeltaParameterServer`` — DOWNPOUR's and
+    the elastic family's PS; for EASGD the committed 'delta' is the elastic
+    term, so the same rule applies)."""
+
+    def handle_commit(self, msg):
+        delta = msg["delta"]
+        with self._lock:
+            for c, d in zip(self.center, delta):
+                c += d
+            self.next_update()
+
+
+class ADAGParameterServer(ParameterServer):
+    """ADAG normalization (reference: ``ADAGParameterServer``): accumulated
+    deltas are normalized over the number of concurrent committers before
+    applying — the per-commit form of ``rules.adag_commit`` (which divides
+    the cross-worker sum by the worker count)."""
+
+    def __init__(self, model_blob, num_workers: int):
+        super().__init__(model_blob)
+        self.num_workers = max(int(num_workers), 1)
+
+    def handle_commit(self, msg):
+        delta = msg["delta"]
+        scale = 1.0 / self.num_workers
+        with self._lock:
+            for c, d in zip(self.center, delta):
+                c += scale * d
+            self.next_update()
+
+
+class DynSGDParameterServer(ParameterServer):
+    """Staleness-aware apply (reference: ``DynSGDParameterServer``):
+    center += delta / (staleness + 1), where staleness = updates that landed
+    since this worker's last pull (the commit's ``clock`` field) — exactly
+    ``rules.dynsgd_commit``."""
+
+    def handle_commit(self, msg):
+        delta = msg["delta"]
+        with self._lock:
+            staleness = max(self.num_updates - int(msg.get("clock", 0)), 0)
+            scale = 1.0 / (staleness + 1.0)
+            for c, d in zip(self.center, delta):
+                c += scale * d
+            self.next_update()
+
+
+class SocketParameterServer:
+    """TCP accept-loop wrapper around a ParameterServer (reference:
+    ``SocketParameterServer.run`` — thread per connection, opcode dispatch).
+
+    Composition instead of inheritance so the apply rules above stay pure-ish
+    and unit-testable without sockets.
+    """
+
+    def __init__(self, ps: ParameterServer, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.ps = ps
+        self.host = host
+        self.port = port  # 0 → ephemeral; real port set by start()
+        self._server: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self._running = False
+
+    # -- lifecycle (reference: initialize/start/stop) ------------------------
+    def start(self):
+        self.ps.initialize()
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((self.host, self.port))
+        self.port = self._server.getsockname()[1]
+        self._server.listen(128)
+        self._running = True
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="dkt-ps-accept")
+        t.start()
+        self._threads.append(t)
+
+    def stop(self):
+        self._running = False
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        for t in self._threads[1:]:
+            t.join(timeout=5.0)
+
+    def get_model(self) -> FittedModel:
+        return self.ps.get_model()
+
+    # -- service loops -------------------------------------------------------
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return  # socket closed by stop()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._handle_connection, args=(conn,),
+                                 daemon=True, name="dkt-ps-conn")
+            t.start()
+            self._threads.append(t)
+
+    def _handle_connection(self, conn: socket.socket):
+        """Reference: ``handle_connection`` — loop on 1-byte actions until
+        EOF/quit ('p' pull, 'c' commit, 'q' quit)."""
+        try:
+            while True:
+                op = networking.recv_opcode(conn)
+                if op in (b"", b"q"):
+                    return
+                if op == b"p":
+                    networking.send_data(conn, self.ps.handle_pull())
+                elif op == b"c":
+                    self.ps.handle_commit(networking.recv_data(conn))
+                else:
+                    raise ValueError(f"unknown opcode {op!r}")
+        except (ConnectionError, OSError):
+            return  # worker died: reference behavior is silent handler exit
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+PS_CLASSES = {
+    "downpour": DeltaParameterServer,
+    "adag": ADAGParameterServer,
+    "dynsgd": DynSGDParameterServer,
+    "aeasgd": DeltaParameterServer,
+    "eamsgd": DeltaParameterServer,
+}
+
+
+def allocate_parameter_server(algorithm: str, model_blob: dict,
+                              num_workers: int) -> ParameterServer:
+    """Factory (reference: ``DistributedTrainer.allocate_parameter_server``)."""
+    cls = PS_CLASSES[algorithm]
+    if cls is ADAGParameterServer:
+        return cls(model_blob, num_workers)
+    return cls(model_blob)
+
+
+def run_host_ps_training(trainer, dataset, shuffle: bool = False
+                         ) -> FittedModel:
+    """Execute a DistributedTrainer with true async semantics: a live socket
+    PS + one worker thread per "executor", each driving jitted window steps.
+
+    This is the full reference execution model (SURVEY.md §3.1) on loopback —
+    the analogue of Spark ``local[*]`` — and the same code path a multi-host
+    DCN deployment uses with workers on other hosts pointing at
+    ``determine_host_address()``.
+    """
+    algorithm = trainer.ALGORITHM
+    if algorithm not in WORKER_CLASSES:
+        raise ValueError(
+            f"execution='host_ps' supports PS algorithms "
+            f"{sorted(WORKER_CLASSES)}, not {algorithm!r} "
+            f"({type(trainer).__name__})")
+
+    trainer.record_training_start()
+    x = np.asarray(dataset[trainer.features_col])
+    y = np.asarray(dataset[trainer.label_col])
+    if shuffle:
+        perm = np.random.default_rng(trainer.seed).permutation(len(x))
+        x, y = x[perm], y[perm]
+    input_shape = x.shape[1:]
+    params = trainer._initial_params(input_shape)
+    blob = serialize_model(trainer.master_model, params)
+
+    ps = allocate_parameter_server(algorithm, blob, trainer.num_workers)
+    server = SocketParameterServer(ps)
+    server.start()
+
+    # shard rows contiguously per worker (Spark repartition analogue)
+    n = trainer.num_workers
+    rows = (len(x) // n) * n
+    xs = x[:rows].reshape((n, rows // n) + x.shape[1:])
+    ys = y[:rows].reshape((n, rows // n) + y.shape[1:])
+
+    worker_cls = WORKER_CLASSES[algorithm]
+    kw = dict(
+        worker_optimizer=trainer.worker_optimizer, loss=trainer.loss,
+        ps_host="127.0.0.1", ps_port=server.port,
+        communication_window=trainer.communication_window,
+        features_col=trainer.features_col, label_col=trainer.label_col,
+        batch_size=trainer.batch_size, num_epoch=trainer.num_epoch,
+        learning_rate=trainer.learning_rate, seed=trainer.seed)
+    if worker_cls.ALGORITHM in ("aeasgd", "eamsgd"):
+        kw["rho"] = getattr(trainer, "rho", 5.0)
+
+    workers = [worker_cls(blob, **kw) for _ in range(n)]
+    share_compiled_state(workers)  # compile the window program once, not N×
+    results: List[Optional[dict]] = [None] * n
+    errors: List[BaseException] = []
+
+    def run(i):
+        try:
+            results[i] = workers[i].train(
+                i, {trainer.features_col: xs[i], trainer.label_col: ys[i]})
+        except BaseException as e:  # propagate to the driver thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,), name=f"dkt-worker-{i}")
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    server.stop()
+    if errors:
+        raise errors[0]
+
+    for r in results:
+        if r:
+            trainer.history.extend(r["history"])
+    fitted = server.get_model()
+    trainer._fitted = fitted
+    trainer.record_training_stop()
+    return fitted

@@ -1,0 +1,312 @@
+"""Worker closures for the host-parameter-server execution path.
+
+Reference being replaced: ``distkeras/workers.py`` (SURVEY.md §2.1 rows 12–13)
+— per-partition training closures shipped to Spark executors, each connecting
+back to the driver's socket PS, pulling the center model, training local
+minibatches, and committing weight deltas every ``communication_window``
+steps.
+
+Here a worker is a thread (same-host simulation, like the reference's Spark
+``local[*]`` mode) or a per-host process on a pod, and the minibatch hot loop
+is **one jitted ``lax.scan`` per communication window** instead of a Python
+loop of ``train_on_batch`` calls — host↔device traffic happens once per
+window, exactly when the algorithm needs the weights on the host anyway for
+the commit.  The update-rule math mirrors the SPMD engine's pure functions in
+``parallel/rules.py`` (equivalence is asserted by tests/test_host_ps.py);
+only the execution differs (true asynchronous hogwild commits against a live
+PS, vs. deterministic bulk-synchronous rounds).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .core import optimizers as opt_lib
+from .core.model import Sequential, deserialize_model
+from .core.losses import get_loss
+from . import networking
+
+
+class Worker:
+    """Base worker (reference: ``workers.py :: Worker``): holds the serialized
+    model + training config and builds the jitted local window runner."""
+
+    def __init__(self, model_blob: dict, worker_optimizer, loss,
+                 features_col: str = "features", label_col: str = "label",
+                 batch_size: int = 32, num_epoch: int = 1,
+                 learning_rate: Optional[float] = None, seed: int = 0):
+        self.model_blob = model_blob
+        self.worker_optimizer = worker_optimizer
+        self.loss = loss
+        self.features_col = features_col
+        self.label_col = label_col
+        self.batch_size = int(batch_size)
+        self.num_epoch = int(num_epoch)
+        self.learning_rate = learning_rate
+        self.seed = seed
+        self.history: List[float] = []
+        # lazily-built jit state (shared across threads is fine: jax caches
+        # compiled executables per shape under its own locks)
+        self._model: Optional[Sequential] = None
+        self._params0 = None
+        self._tx = None
+        self._window_fn = None
+
+    # -- model/optimizer plumbing -------------------------------------------
+    def _ensure_model(self):
+        if self._model is None:
+            self._model, self._params0 = deserialize_model(self.model_blob)
+            self._tx, _ = opt_lib.build(self.worker_optimizer, self._params0,
+                                        self.learning_rate)
+        return self._model
+
+    def _build_window_fn(self):
+        """jitted (params, opt_state, xw, yw, rng) -> (params, opt_state, loss)
+        scanning a (window, batch, ...) stack of minibatches."""
+        if self._window_fn is not None:
+            return self._window_fn
+        model = self._ensure_model()
+        loss_fn = get_loss(self.loss)
+        tx = self._tx
+
+        def loss_of(p, x, y, key):
+            pred = model.apply(p, x, train=True, rng=key)
+            return loss_fn(y, pred)
+
+        def window(params, opt_state, xw, yw, rng):
+            def body(carry, inp):
+                p, s, key = carry
+                x, y = inp
+                key, sub = jax.random.split(key)
+                l, g = jax.value_and_grad(loss_of)(p, x, y, sub)
+                upd, s = tx.update(g, s, p)
+                p = optax.apply_updates(p, upd)
+                return (p, s, key), l
+
+            (params, opt_state, _), losses = jax.lax.scan(
+                body, (params, opt_state, rng), (xw, yw))
+            return params, opt_state, jnp.mean(losses)
+
+        self._window_fn = jax.jit(window)
+        return self._window_fn
+
+    def _weights_to_params(self, weights: List[np.ndarray]):
+        model = self._ensure_model()
+        return model.set_weights(self._params0, weights)
+
+    def _params_to_weights(self, params) -> List[np.ndarray]:
+        return self._ensure_model().get_weights(params)
+
+    def _shard_to_windows(self, shard: Dict[str, np.ndarray], window: int,
+                          epoch_seed: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Shape one epoch of this worker's shard into
+        (num_windows, window, batch, ...) stacks, shuffled per epoch."""
+        x = np.asarray(shard[self.features_col])
+        y = np.asarray(shard[self.label_col])
+        perm = np.random.default_rng(epoch_seed).permutation(len(x))
+        x, y = x[perm], y[perm]
+        per_window = window * self.batch_size
+        nwin = len(x) // per_window
+        if nwin == 0:
+            raise ValueError(
+                f"worker shard of {len(x)} rows < one communication window "
+                f"({window} batches × {self.batch_size})")
+        rows = nwin * per_window
+        xw = x[:rows].reshape((nwin, window, self.batch_size) + x.shape[1:])
+        yw = y[:rows].reshape((nwin, window, self.batch_size) + y.shape[1:])
+        return xw, yw
+
+
+class SequentialWorker(Worker):
+    """Plain local training, no PS (reference: ``workers.py ::
+    SequentialWorker`` — what SingleTrainer ships to its one partition)."""
+
+    def train(self, index: int, shard: Dict[str, np.ndarray]) -> dict:
+        model = self._ensure_model()
+        window_fn = self._build_window_fn()
+        params = self._params0
+        opt_state = self._tx.init(params)
+        rng = jax.random.PRNGKey(self.seed + index)
+        for epoch in range(self.num_epoch):
+            # window==1: every batch is its own scan step
+            xw, yw = self._shard_to_windows(shard, 1, self.seed + epoch)
+            for i in range(len(xw)):
+                rng, sub = jax.random.split(rng)
+                params, opt_state, loss = window_fn(
+                    params, opt_state, jnp.asarray(xw[i]), jnp.asarray(yw[i]),
+                    sub)
+                self.history.append(float(loss))
+        return {"weights": self._params_to_weights(params),
+                "history": self.history}
+
+
+class PSWorker(Worker):
+    """Base for PS-connected workers (reference: the ``*Worker`` family).
+
+    Protocol (reference parity, §2.4): 1-byte opcodes on a persistent TCP
+    connection — ``'p'`` pull → PS replies {weights, clock}; ``'c'`` commit →
+    worker sends {delta, worker_id, clock}; ``'q'`` quit.
+    """
+
+    ALGORITHM = "downpour"
+
+    def __init__(self, model_blob, worker_optimizer, loss, ps_host: str,
+                 ps_port: int, communication_window: int = 5, **kw):
+        super().__init__(model_blob, worker_optimizer, loss, **kw)
+        self.ps_host = ps_host
+        self.ps_port = ps_port
+        self.window = int(communication_window)
+        self._sock: Optional[socket.socket] = None
+        self._last_clock = 0
+
+    # -- wire ---------------------------------------------------------------
+    def connect(self):
+        self._sock = networking.connect(self.ps_host, self.ps_port)
+
+    def disconnect(self):
+        if self._sock is not None:
+            try:
+                networking.send_opcode(self._sock, b"q")
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def pull(self) -> List[np.ndarray]:
+        """'p': fetch center weights + PS clock (reference: Worker.pull)."""
+        networking.send_opcode(self._sock, b"p")
+        msg = networking.recv_data(self._sock)
+        self._last_clock = int(msg["clock"])
+        return msg["weights"]
+
+    def commit(self, delta: List[np.ndarray], worker_id: int):
+        """'c': push a weight-shaped delta (reference: Worker.commit)."""
+        networking.send_opcode(self._sock, b"c")
+        networking.send_data(self._sock, {
+            "delta": delta,
+            "worker_id": worker_id,
+            "clock": self._last_clock,
+        })
+
+    # -- the training loop ---------------------------------------------------
+    def train(self, index: int, shard: Dict[str, np.ndarray]) -> dict:
+        window_fn = self._build_window_fn()
+        self.connect()
+        try:
+            params = self._weights_to_params(self.pull())
+            opt_state = self._tx.init(params)
+            rng = jax.random.PRNGKey(self.seed + 100 + index)
+            for epoch in range(self.num_epoch):
+                xw, yw = self._shard_to_windows(
+                    shard, self.window, self.seed + 1000 * epoch + index)
+                for i in range(len(xw)):
+                    rng, sub = jax.random.split(rng)
+                    params, opt_state, loss = self._window_step(
+                        window_fn, params, opt_state, xw[i], yw[i], sub,
+                        index)
+                    self.history.append(float(loss))
+        finally:
+            self.disconnect()
+        return {"history": self.history}
+
+    def _window_step(self, window_fn, params, opt_state, xw, yw, rng,
+                     index: int):
+        raise NotImplementedError
+
+
+class DOWNPOURWorker(PSWorker):
+    """DistBelief async SGD (reference: ``workers.py :: DOWNPOURWorker``):
+    commit the raw accumulated window delta, then re-pull the center."""
+    ALGORITHM = "downpour"
+
+    def _window_step(self, window_fn, params, opt_state, xw, yw, rng, index):
+        before = self._params_to_weights(params)
+        params, opt_state, loss = window_fn(
+            params, opt_state, jnp.asarray(xw), jnp.asarray(yw), rng)
+        after = self._params_to_weights(params)
+        delta = [a - b for a, b in zip(after, before)]
+        self.commit(delta, index)
+        params = self._weights_to_params(self.pull())
+        return params, opt_state, loss
+
+
+class ADAGWorker(DOWNPOURWorker):
+    """ADAG (reference: ``workers.py :: ADAGWorker``): same commit shape as
+    DOWNPOUR; the normalization lives on the PS side
+    (``ADAGParameterServer`` divides by the concurrent-commit count), matching
+    ``rules.adag_commit``."""
+    ALGORITHM = "adag"
+
+
+class DynSGDWorker(DOWNPOURWorker):
+    """DynSGD (reference: ``workers.py :: DynSGDWorker``): identical loop; the
+    commit's ``clock`` field (last-seen PS update count, set by ``pull``) is
+    what ``DynSGDParameterServer`` uses to compute staleness."""
+    ALGORITHM = "dynsgd"
+
+
+class AEASGDWorker(PSWorker):
+    """Elastic averaging (reference: ``workers.py :: AEASGDWorker``): keeps a
+    *persistent* local model; every window computes the elastic force
+    e = α·(x − x̃) against a freshly pulled center, subtracts it locally, and
+    commits it (PS does x̃ += e). α = rho · learning_rate."""
+    ALGORITHM = "aeasgd"
+
+    def __init__(self, *args, rho: float = 5.0, **kw):
+        super().__init__(*args, **kw)
+        self.rho = float(rho)
+        lr = self.learning_rate if self.learning_rate is not None else 0.1
+        self.alpha = self.rho * lr
+
+    def _window_step(self, window_fn, params, opt_state, xw, yw, rng, index):
+        params, opt_state, loss = window_fn(
+            params, opt_state, jnp.asarray(xw), jnp.asarray(yw), rng)
+        center = self.pull()
+        local = self._params_to_weights(params)
+        elastic = [self.alpha * (l - c) for l, c in zip(local, center)]
+        local = [l - e for l, e in zip(local, elastic)]
+        self.commit(elastic, index)
+        return self._weights_to_params(local), opt_state, loss
+
+
+class EAMSGDWorker(AEASGDWorker):
+    """EAMSGD (reference: ``workers.py :: EAMSGDWorker``): AEASGD whose local
+    optimizer carries Nesterov momentum — the momentum state lives in the
+    worker optimizer passed in by the ``EAMSGD`` trainer, so the exchange
+    logic is identical."""
+    ALGORITHM = "eamsgd"
+
+
+def share_compiled_state(workers: List["Worker"]) -> None:
+    """Make all workers reuse one model/optimizer/jitted-window-fn.
+
+    jax.jit caches per function object, so N identical-but-distinct window
+    closures would compile N times; jitted callables are thread-safe and the
+    shared pieces (model spec, params template, optax tx) are read-only in
+    the training loop.
+    """
+    if not workers:
+        return
+    head = workers[0]
+    head._ensure_model()
+    head._build_window_fn()
+    for w in workers[1:]:
+        w._model = head._model
+        w._params0 = head._params0
+        w._tx = head._tx
+        w._window_fn = head._window_fn
+
+
+WORKER_CLASSES = {
+    "downpour": DOWNPOURWorker,
+    "adag": ADAGWorker,
+    "dynsgd": DynSGDWorker,
+    "aeasgd": AEASGDWorker,
+    "eamsgd": EAMSGDWorker,
+}

@@ -1,0 +1,201 @@
+"""Tests for the host-parameter-server path: wire protocol, PS apply rules
+over sockets, and end-to-end ``execution='host_ps'`` training — the
+semantically-exact async engine (true hogwild interleaving on loopback, the
+analogue of the reference's Spark ``local[*]`` simulation; SURVEY.md §4)."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import (Sequential, Dense, ADAG, DOWNPOUR, AEASGD, EAMSGD,
+                           DynSGD, Dataset, OneHotTransformer)
+from distkeras_tpu import networking
+from distkeras_tpu.parameter_servers import (
+    DeltaParameterServer, ADAGParameterServer, DynSGDParameterServer,
+    SocketParameterServer)
+
+NUM_CLASSES = 4
+
+
+def make_dataset(n=2048, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    protos = rng.uniform(-1, 1, (NUM_CLASSES, d))
+    labels = rng.integers(0, NUM_CLASSES, n)
+    x = (protos[labels] + 0.3 * rng.standard_normal((n, d))).astype(np.float32)
+    ds = Dataset({"features": x, "label": labels.astype(np.int64)})
+    return OneHotTransformer(NUM_CLASSES, input_col="label",
+                             output_col="label_encoded").transform(ds)
+
+
+def make_model():
+    return Sequential([Dense(32, activation="relu"),
+                       Dense(NUM_CLASSES, activation="softmax")],
+                      input_shape=(16,), compute_dtype="float32")
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+# ---------------------------------------------------------------------------
+
+def test_wire_roundtrip_structures():
+    msg = {
+        "weights": [np.arange(6, dtype=np.float32).reshape(2, 3),
+                    np.ones((4,), np.float64)],
+        "clock": 7,
+        "name": "worker-0",
+        "nested": {"t": (1, 2.5, None), "flag": True},
+    }
+    out = networking.decode_message(networking.encode_message(msg))
+    assert out["clock"] == 7 and out["name"] == "worker-0"
+    assert out["nested"]["t"] == (1, 2.5, None)
+    assert out["nested"]["flag"] is True
+    np.testing.assert_array_equal(out["weights"][0], msg["weights"][0])
+    assert out["weights"][1].dtype == np.float64
+
+
+def test_wire_rejects_garbage():
+    with pytest.raises(ValueError):
+        networking.decode_message(b"XXXX" + b"\x00" * 16)
+    with pytest.raises(TypeError):
+        networking.encode_message({"bad": object()})
+
+
+def test_wire_rejects_mismatched_buffer_length():
+    # a frame whose u64 buffer length disagrees with the header's dtype*shape
+    # must be rejected before allocation (OOM guard on the PS host)
+    good = networking.encode_message({"w": np.zeros((4,), np.float32)})
+    tampered = bytearray(good)
+    off = len(good) - 16 - 8  # u64 length prefix of the single 16-byte buffer
+    tampered[off:off + 8] = (1 << 60).to_bytes(8, "little")
+    with pytest.raises(ValueError, match="expects"):
+        networking.decode_message(bytes(tampered))
+
+
+def test_send_recv_over_socketpair():
+    a, b = socket.socketpair()
+    payload = {"delta": [np.random.default_rng(0).standard_normal((128, 64))]}
+    t = threading.Thread(target=networking.send_data, args=(a, payload))
+    t.start()
+    out = networking.recv_data(b)
+    t.join()
+    np.testing.assert_array_equal(out["delta"][0], payload["delta"][0])
+    a.close(); b.close()
+
+
+# ---------------------------------------------------------------------------
+# PS apply rules over real sockets
+# ---------------------------------------------------------------------------
+
+def _tiny_blob():
+    return {"model": make_model().to_json(),
+            "weights": [np.zeros((3,), np.float32)] * 1}
+
+
+def test_socket_ps_pull_commit_delta():
+    ps = DeltaParameterServer(_tiny_blob())
+    server = SocketParameterServer(ps)
+    server.start()
+    try:
+        sock = networking.connect("127.0.0.1", server.port)
+        networking.send_opcode(sock, b"p")
+        msg = networking.recv_data(sock)
+        assert msg["clock"] == 0
+        np.testing.assert_array_equal(msg["weights"][0], np.zeros(3))
+
+        networking.send_opcode(sock, b"c")
+        networking.send_data(sock, {"delta": [np.ones(3, np.float32)],
+                                    "worker_id": 0, "clock": 0})
+        networking.send_opcode(sock, b"p")
+        msg = networking.recv_data(sock)
+        assert msg["clock"] == 1
+        np.testing.assert_array_equal(msg["weights"][0], np.ones(3))
+        sock.close()
+    finally:
+        server.stop()
+
+
+def test_adag_ps_normalizes_by_workers():
+    ps = ADAGParameterServer(_tiny_blob(), num_workers=4)
+    ps.handle_commit({"delta": [np.full(3, 8.0, np.float32)], "clock": 0})
+    np.testing.assert_allclose(ps.center[0], np.full(3, 2.0))
+
+
+def test_ps_applies_match_shared_rules():
+    """The PS numpy commit loops must agree with parallel/rules.py — the
+    single source of algorithm semantics both engines claim to implement."""
+    from distkeras_tpu.parallel import rules
+    rng = np.random.default_rng(3)
+    w0 = [rng.standard_normal((5,)).astype(np.float32),
+          rng.standard_normal((2, 3)).astype(np.float32)]
+    delta = [rng.standard_normal(a.shape).astype(np.float32) for a in w0]
+
+    def blob():
+        return {"model": make_model().to_json(),
+                "weights": [a.copy() for a in w0]}
+
+    ps = DeltaParameterServer(blob())
+    ps.handle_commit({"delta": delta, "clock": 0})
+    expect = rules.delta_commit(w0, delta)
+    for got, want in zip(ps.center, expect):
+        np.testing.assert_allclose(got, np.asarray(want), rtol=1e-6)
+
+    ps = ADAGParameterServer(blob(), num_workers=4)
+    ps.handle_commit({"delta": delta, "clock": 0})
+    expect = rules.adag_commit(w0, delta, 4)
+    for got, want in zip(ps.center, expect):
+        np.testing.assert_allclose(got, np.asarray(want), rtol=1e-6)
+
+    ps = DynSGDParameterServer(blob())
+    ps.num_updates = 3  # worker pulled at clock 1 → staleness 2
+    ps.handle_commit({"delta": delta, "clock": 1})
+    expect = rules.dynsgd_commit(w0, delta, 2.0)
+    for got, want in zip(ps.center, expect):
+        np.testing.assert_allclose(got, np.asarray(want), rtol=1e-6)
+
+
+def test_dynsgd_ps_staleness_scaling():
+    ps = DynSGDParameterServer(_tiny_blob())
+    # first commit: staleness 0 → full apply
+    ps.handle_commit({"delta": [np.ones(3, np.float32)], "clock": 0})
+    np.testing.assert_allclose(ps.center[0], np.ones(3))
+    # second commit still claims clock 0 → staleness 1 → halved
+    ps.handle_commit({"delta": [np.ones(3, np.float32)], "clock": 0})
+    np.testing.assert_allclose(ps.center[0], np.full(3, 1.5))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end host_ps training
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("cls,kw", [
+    (ADAG, {"communication_window": 4, "learning_rate": 0.1}),
+    (DOWNPOUR, {"communication_window": 4, "learning_rate": 0.02}),
+    (DynSGD, {"communication_window": 4, "learning_rate": 0.05}),
+    (AEASGD, {"communication_window": 8, "rho": 1.0, "learning_rate": 0.05}),
+    (EAMSGD, {"communication_window": 8, "rho": 1.0, "learning_rate": 0.05,
+              "momentum": 0.9}),
+])
+def test_host_ps_training_learns(cls, kw):
+    ds = make_dataset()
+    t = cls(make_model(), num_workers=2, batch_size=32, num_epoch=2,
+            label_col="label_encoded", execution="host_ps", **kw)
+    fitted = t.train(ds)
+    assert t.get_training_time() > 0
+    assert len(t.get_history()) > 0
+    # async scheduling is nondeterministic; assert learning, not exact curves
+    hist = t.get_history()
+    assert np.mean(hist[-5:]) < np.mean(hist[:5])
+    preds = fitted.predict(ds["features"][:256])
+    acc = float(np.mean(np.argmax(preds, axis=1) == ds["label"][:256]))
+    assert acc > 0.6
+
+
+def test_host_ps_rejects_non_ps_trainer():
+    from distkeras_tpu import AveragingTrainer
+    ds = make_dataset(n=256)
+    t = AveragingTrainer(make_model(), num_workers=2, batch_size=32,
+                         label_col="label_encoded", execution="host_ps")
+    with pytest.raises(ValueError, match="host_ps"):
+        t.train(ds)
