@@ -1,0 +1,91 @@
+"""North-star benchmark: ADAG on the MNIST ConvNet (BASELINE.json).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "examples/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` is the multiple over the measured reference-proxy CPU
+throughput in ``BASELINE_MEASURED.json`` (the reference publishes no numbers
+— see BASELINE.md; scripts/measure_cpu_baseline.py measures the proxy).
+North-star target: ≥ 8×.
+
+Runs on whatever devices are visible (one real TPU chip under the driver;
+CPU elsewhere).  Steady-state timing: the first epoch is warmup/compile,
+then full epochs are timed until ~5 s have elapsed.
+"""
+
+import json
+import os
+import time
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.data.datasets import load_mnist
+    from distkeras_tpu.models.zoo import mnist_convnet
+    from distkeras_tpu.parallel.mesh import get_mesh
+    from distkeras_tpu.parallel.spmd import SPMDEngine, shape_epoch_data
+
+    batch = int(os.environ.get("DISTKERAS_BENCH_BATCH", "128"))
+    window = int(os.environ.get("DISTKERAS_BENCH_WINDOW", "12"))
+    n_rows = int(os.environ.get("DISTKERAS_BENCH_ROWS", "60000"))
+
+    mesh = get_mesh()
+    n = mesh.devices.size
+    model = mnist_convnet()
+    engine = SPMDEngine(model, "categorical_crossentropy", "adam", mesh,
+                        "adag", communication_window=window)
+
+    train, _ = load_mnist(n_train=n_rows)
+    x = np.asarray(train["features"], np.float32) / 255.0
+    y = np.eye(10, dtype=np.float32)[np.asarray(train["label"])]
+    xb, yb, rounds = shape_epoch_data(x, y, n, window, batch)
+
+    state = engine.init_state(jax.random.PRNGKey(0), (784,))
+    rngs = engine.worker_rngs(0)
+
+    # The whole epoch's data lives in HBM across epochs (188 MB at MNIST
+    # scale) — place it once; steady-state training never re-transfers.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(mesh, P(None, None, "workers"))
+    xb = jax.device_put(xb, sh)
+    yb = jax.device_put(yb, sh)
+    epoch_fn = engine._build_epoch_fn()
+
+    # warmup twice: the first call compiles for host-committed inputs, the
+    # second for the donated-state buffer layouts.
+    for _ in range(2):
+        state, losses = epoch_fn(state, xb, yb, rngs)
+        assert np.isfinite(np.asarray(losses)).all()
+
+    reps = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 3.0 and reps < 200:
+        state, losses = epoch_fn(state, xb, yb, rngs)
+        np.asarray(losses)  # force materialization each epoch
+        reps += 1
+    dt = time.perf_counter() - t0
+
+    examples = reps * rounds * window * n * batch
+    eps_per_chip = examples / dt / n
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BASELINE_MEASURED.json")
+    vs = None
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f)
+        if base.get("value"):
+            vs = round(eps_per_chip / float(base["value"]), 2)
+
+    print(json.dumps({
+        "metric": "examples_per_sec_per_chip_mnist_convnet_adag",
+        "value": round(eps_per_chip, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": vs,
+    }))
+
+
+if __name__ == "__main__":
+    main()

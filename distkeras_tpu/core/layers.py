@@ -15,7 +15,10 @@ pytree *is* the weights).
 All matmuls/convs run in a configurable ``compute_dtype`` (default bfloat16 on
 TPU) with float32 parameters and float32 accumulation via
 ``preferred_element_type`` — this keeps the MXU fed without fp32 conversion
-costs on the HBM side.
+costs on the HBM side.  (Convs route through ``_conv_f32_acc``: jax 0.9's
+conv transpose rule can't differentiate the upcast, so the f32-accumulating
+conv carries a custom VJP — don't add ``preferred_element_type`` to a conv
+call directly.)
 """
 
 from __future__ import annotations
@@ -191,6 +194,42 @@ class Dense(Layer):
         return _apply_activation(self.activation, y)
 
 
+def _conv_f32_acc(x, k, strides, padding):
+    """Convolution with low-precision operands and a float32-accumulated
+    *forward* output.
+
+    jax 0.9's conv transpose rule rejects ``preferred_element_type``
+    upcasting under grad, so the f32-accumulating forward gets a custom VJP
+    that differentiates the plain same-dtype conv.  Gradient contract: the
+    backward convs therefore run entirely in ``compute_dtype`` (the
+    cotangent is rounded once to ``compute_dtype``; on TPU the MXU still
+    accumulates partial products in f32 internally, with bf16 rounding at
+    conv boundaries) — standard mixed-precision training behavior, but
+    note it is *less* precise than Dense's grads, which keep
+    ``preferred_element_type=f32`` end to end.
+    """
+    dn = ("NHWC", "HWIO", "NHWC")
+
+    @jax.custom_vjp
+    def conv(x, k):
+        return jax.lax.conv_general_dilated(
+            x, k, strides, padding, dimension_numbers=dn,
+            preferred_element_type=jnp.float32)
+
+    def fwd(x, k):
+        return conv(x, k), (x, k)
+
+    def bwd(res, g):
+        x, k = res
+        _, vjp = jax.vjp(
+            lambda a, b: jax.lax.conv_general_dilated(
+                a, b, strides, padding, dimension_numbers=dn), x, k)
+        return vjp(g.astype(x.dtype))
+
+    conv.defvjp(fwd, bwd)
+    return conv(x, k)
+
+
 class Conv2D(Layer):
     """2-D convolution, NHWC layout (TPU-native; XLA tiles it onto the MXU)."""
 
@@ -225,13 +264,9 @@ class Conv2D(Layer):
 
     def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
               rng=None):
-        y = jax.lax.conv_general_dilated(
-            x.astype(compute_dtype),
-            params["kernel"].astype(compute_dtype),
-            self.strides, self.padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32,
-        )
+        y = _conv_f32_acc(x.astype(compute_dtype),
+                          params["kernel"].astype(compute_dtype),
+                          self.strides, self.padding)
         if self.use_bias:
             y = y + params["bias"]
         return _apply_activation(self.activation, y)
