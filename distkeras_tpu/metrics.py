@@ -1,0 +1,91 @@
+"""Structured metrics + profiler tracing.
+
+The reference's observability is wall-clock only —
+``Trainer.record_training_start/stop`` plus loss-history lists collected from
+workers, and scattered ``print`` statements (SURVEY.md §5).  Here metrics are
+structured events (JSONL) with throughput derived per epoch, and ``trace()``
+wraps ``jax.profiler`` so a TensorBoard-readable device trace is one context
+manager away — required plumbing for the examples/sec/chip north-star metric.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import IO, Any, Dict, List, Optional
+
+
+class MetricsLogger:
+    """Append-only JSONL event log + in-memory history.
+
+    Events carry a monotonic wall-clock ``t`` and arbitrary scalar fields:
+    ``log(step=3, loss=0.7, examples_per_sec=1e6)``.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.events: List[Dict[str, Any]] = []
+        self._fh: Optional[IO[str]] = open(path, "a") if path else None
+
+    def log(self, **fields) -> Dict[str, Any]:
+        # absolute wall time: stays monotonic when a resumed run appends to
+        # the same JSONL file
+        event = {"t": round(time.time(), 6)}
+        event.update({k: (float(v) if hasattr(v, "item") else v)
+                      for k, v in fields.items()})
+        self.events.append(event)
+        if self._fh:
+            self._fh.write(json.dumps(event) + "\n")
+            self._fh.flush()
+        return event
+
+    def scalar_series(self, field: str) -> List[float]:
+        return [e[field] for e in self.events if field in e]
+
+    def close(self):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+class EpochMetrics:
+    """Derives per-epoch throughput for the trainers: examples/sec and
+    examples/sec/chip from (rows, seconds, num_chips)."""
+
+    def __init__(self, logger: Optional[MetricsLogger] = None,
+                 num_chips: int = 1):
+        self.logger = logger or MetricsLogger()
+        self.num_chips = max(int(num_chips), 1)
+
+    def epoch(self, epoch: int, examples: int, seconds: float,
+              mean_loss: float) -> Dict[str, Any]:
+        eps = examples / seconds if seconds > 0 else float("inf")
+        return self.logger.log(
+            kind="epoch", epoch=epoch, examples=examples,
+            seconds=round(seconds, 6), loss=mean_loss,
+            examples_per_sec=round(eps, 2),
+            examples_per_sec_per_chip=round(eps / self.num_chips, 2))
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, enabled: bool = True):
+    """Capture a ``jax.profiler`` device trace for the enclosed block
+    (view with TensorBoard / Perfetto).  No-ops cleanly when disabled."""
+    if not enabled:
+        yield
+        return
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region in the profiler timeline (jax.profiler.TraceAnnotation)."""
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -99,6 +99,16 @@ class SPMDEngine:
         return DistState(center, local, opt_state,
                          jnp.zeros((), jnp.int32))
 
+    def put_state(self, state: DistState) -> DistState:
+        """Re-apply mesh shardings to a host-side state pytree (checkpoint
+        restore path — the leaves arrive as numpy arrays)."""
+        ws = worker_sharded(self.mesh)
+        center = jax.device_put(state.center, replicated(self.mesh))
+        local = tmap(lambda x: jax.device_put(x, ws), state.local)
+        opt_state = tmap(lambda x: jax.device_put(x, ws), state.opt_state)
+        return DistState(center, local, opt_state,
+                         jnp.asarray(state.round_idx, jnp.int32))
+
     # -- the per-round SPMD body ---------------------------------------------
     def _local_window(self, params, opt_state, xw, yw, rng):
         """Run ``window`` minibatch steps on one worker's shard (in-graph)."""

@@ -68,6 +68,7 @@ class Trainer:
         self.learning_rate = learning_rate
         self.seed = seed
         self.history: List[float] = []
+        self.metrics: List[dict] = []
         self.training_time = 0.0
         self._time_start: Optional[float] = None
         self._fitted: Optional[FittedModel] = None
@@ -176,7 +177,10 @@ class DistributedTrainer(Trainer):
                  communication_window: Optional[int] = None,
                  loss: str = "categorical_crossentropy",
                  worker_optimizer="sgd", learning_rate=None,
-                 execution: str = "spmd", mesh=None, seed: int = 0):
+                 execution: str = "spmd", mesh=None, seed: int = 0,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1,
+                 metrics_path: Optional[str] = None):
         super().__init__(keras_model, loss, worker_optimizer, learning_rate,
                          seed)
         self.mesh = mesh if mesh is not None else mesh_lib.get_mesh(num_workers)
@@ -189,6 +193,9 @@ class DistributedTrainer(Trainer):
             communication_window if communication_window is not None
             else self.DEFAULT_WINDOW)
         self.execution = execution
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = max(int(checkpoint_every), 1)
+        self.metrics_path = metrics_path
         self._engine: Optional[SPMDEngine] = None
         self._state: Optional[DistState] = None
 
@@ -206,8 +213,14 @@ class DistributedTrainer(Trainer):
             initial_params=self._initial_params(self._input_shape))
         return engine
 
-    def train(self, dataset: Dataset, shuffle: bool = False) -> FittedModel:
+    def train(self, dataset: Dataset, shuffle: bool = False,
+              resume: bool = False) -> FittedModel:
         if self.execution == "host_ps":
+            if self.checkpoint_dir is not None or resume:
+                raise NotImplementedError(
+                    "checkpoint/resume is not supported on the host_ps "
+                    "execution path (async PS state is not serialized); "
+                    "use execution='spmd'")
             from .parameter_servers import run_host_ps_training
             return run_host_ps_training(self, dataset, shuffle)
         self.record_training_start()
@@ -216,22 +229,52 @@ class DistributedTrainer(Trainer):
         self._input_shape = x.shape[1:]
         engine = self.service(self._input_shape)
         self._engine = engine
+        ckpt = None
+        start_epoch = 0
+        if resume and self.checkpoint_dir is None:
+            raise ValueError("train(resume=True) needs checkpoint_dir")
+        if self.checkpoint_dir is not None:
+            from .checkpoint import Checkpointer
+            ckpt = Checkpointer(self.checkpoint_dir)
+            latest = ckpt.latest_step()
+            if resume and latest is not None:
+                # epoch checkpoints: step k = state after k epochs
+                self._state = engine.put_state(
+                    ckpt.restore(jax.device_get(self._state), latest))
+                start_epoch = latest
+        from .metrics import EpochMetrics, MetricsLogger
+        metrics = EpochMetrics(MetricsLogger(self.metrics_path),
+                               num_chips=self.num_workers)
+        self.metrics = metrics.logger.events
         rngs = engine.worker_rngs(self.seed + 17)
-        for epoch in range(self.num_epoch):
-            if shuffle:
-                # deterministic per-epoch reshuffle (reference shuffles once
-                # up front via utils.shuffle; per-epoch is strictly better
-                # for convergence and still seed-reproducible)
-                perm = np.random.default_rng(self.seed + epoch).permutation(
-                    len(x))
-                xe, ye = x[perm], y[perm]
-            else:
-                xe, ye = x, y
-            xb, yb, _ = shape_epoch_data(xe, ye, self.num_workers,
-                                         self.communication_window,
-                                         self.batch_size)
-            self._state, losses = engine.run_epoch(self._state, xb, yb, rngs)
-            self.history.extend(np.asarray(losses).tolist())
+        try:
+            for epoch in range(start_epoch, self.num_epoch):
+                t0 = time.time()
+                if shuffle:
+                    # deterministic per-epoch reshuffle (reference shuffles
+                    # once up front via utils.shuffle; per-epoch is strictly
+                    # better for convergence and still seed-reproducible)
+                    perm = np.random.default_rng(
+                        self.seed + epoch).permutation(len(x))
+                    xe, ye = x[perm], y[perm]
+                else:
+                    xe, ye = x, y
+                xb, yb, rounds = shape_epoch_data(xe, ye, self.num_workers,
+                                                  self.communication_window,
+                                                  self.batch_size)
+                self._state, losses = engine.run_epoch(self._state, xb, yb,
+                                                       rngs)
+                losses = np.asarray(losses)
+                self.history.extend(losses.tolist())
+                examples = (rounds * self.communication_window
+                            * self.batch_size * self.num_workers)
+                metrics.epoch(epoch, examples, time.time() - t0,
+                              float(losses.mean()))
+                if ckpt is not None and (
+                        epoch + 1) % self.checkpoint_every == 0:
+                    ckpt.save(epoch + 1, jax.device_get(self._state))
+        finally:
+            metrics.logger.close()
         center = jax.device_get(self._state.center)
         self._fitted = FittedModel(self.master_model, center)
         self.record_training_stop()
@@ -321,8 +364,9 @@ class AveragingTrainer(DistributedTrainer):
         kw.setdefault("communication_window", 1)
         super().__init__(keras_model, **kw)
 
-    def train(self, dataset: Dataset, shuffle: bool = False) -> FittedModel:
-        super().train(dataset, shuffle)
+    def train(self, dataset: Dataset, shuffle: bool = False,
+              resume: bool = False) -> FittedModel:
+        super().train(dataset, shuffle, resume)
         # average the per-worker local params (leading axis = workers)
         local = jax.device_get(self._state.local)
         avg = tmap(lambda v: np.mean(v, axis=0), local)
@@ -342,9 +386,9 @@ class EnsembleTrainer(DistributedTrainer):
         super().__init__(keras_model, **kw)
         self.num_models = self.num_workers
 
-    def train(self, dataset: Dataset, shuffle: bool = False
-              ) -> List[FittedModel]:
-        super().train(dataset, shuffle)
+    def train(self, dataset: Dataset, shuffle: bool = False,
+              resume: bool = False) -> List[FittedModel]:
+        super().train(dataset, shuffle, resume)
         local = jax.device_get(self._state.local)
         models = []
         for i in range(self.num_workers):

@@ -1,0 +1,95 @@
+"""Checkpoint/resume + metrics (rebuild-over-reference subsystems; the
+reference has neither — SURVEY.md §5 rows "Checkpoint / resume" and
+"Metrics / logging").
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import ADAG, Dataset, OneHotTransformer
+from distkeras_tpu.checkpoint import Checkpointer
+from distkeras_tpu.metrics import EpochMetrics, MetricsLogger
+
+from test_trainers import make_dataset, make_model, eval_accuracy
+
+
+def test_checkpointer_roundtrip_pytree(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    state = {"params": [np.arange(6, dtype=np.float32).reshape(2, 3),
+                        np.ones((4,), np.float32)],
+             "step": np.int32(7)}
+    ck.save(1, state)
+    target = {"params": [np.zeros((2, 3), np.float32),
+                         np.zeros((4,), np.float32)],
+              "step": np.int32(0)}
+    restored = ck.restore(target)
+    np.testing.assert_array_equal(restored["params"][0], state["params"][0])
+    assert int(restored["step"]) == 7
+
+
+def test_checkpointer_retention_and_latest(tmp_path):
+    ck = Checkpointer(str(tmp_path), max_to_keep=2)
+    for s in (1, 2, 3, 4):
+        ck.save(s, [np.full((2,), float(s))])
+    assert ck.all_steps() == [3, 4]
+    assert ck.latest_step() == 4
+    restored = ck.restore([np.zeros((2,))], step=3)
+    np.testing.assert_array_equal(restored[0], [3.0, 3.0])
+
+
+def test_checkpointer_structure_mismatch_raises(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, [np.zeros((2,))])
+    with pytest.raises(ValueError, match="structure mismatch"):
+        ck.restore([np.zeros((2,)), np.zeros((2,))])
+    with pytest.raises(ValueError, match="shape"):
+        ck.restore([np.zeros((3,))])
+
+
+def test_trainer_checkpoint_resume_exact(eight_devices, tmp_path):
+    """A run interrupted after epoch 1 and resumed matches the uninterrupted
+    2-epoch run bit-for-bit (deterministic SPMD — SURVEY.md §5 race note)."""
+    ds = make_dataset(n=512)
+    kw = dict(num_workers=8, batch_size=8, num_epoch=2,
+              communication_window=4, label_col="label_encoded",
+              worker_optimizer="sgd", learning_rate=0.1, seed=3)
+
+    full = ADAG(make_model(), **kw)
+    fitted_full = full.train(ds)
+
+    ck_dir = str(tmp_path / "ck")
+    first = ADAG(make_model(), checkpoint_dir=ck_dir, **dict(kw, num_epoch=1))
+    first.train(ds)
+    assert Checkpointer(ck_dir).latest_step() == 1
+
+    second = ADAG(make_model(), checkpoint_dir=ck_dir, **kw)
+    fitted_resumed = second.train(ds, resume=True)
+
+    for a, b in zip(fitted_full.get_weights(), fitted_resumed.get_weights()):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_metrics_logger_jsonl(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    m = EpochMetrics(MetricsLogger(path), num_chips=4)
+    m.epoch(0, examples=4096, seconds=2.0, mean_loss=0.5)
+    m.logger.close()
+    events = [json.loads(l) for l in open(path)]
+    assert events[0]["examples_per_sec"] == 2048.0
+    assert events[0]["examples_per_sec_per_chip"] == 512.0
+    assert events[0]["loss"] == 0.5
+
+
+def test_trainer_emits_metrics(eight_devices, tmp_path):
+    ds = make_dataset(n=512)
+    path = str(tmp_path / "m.jsonl")
+    t = ADAG(make_model(), num_workers=8, batch_size=8, num_epoch=2,
+             communication_window=4, label_col="label_encoded",
+             learning_rate=0.1, metrics_path=path)
+    t.train(ds)
+    assert len(t.metrics) == 2
+    assert all(e["examples_per_sec_per_chip"] > 0 for e in t.metrics)
+    assert os.path.exists(path) and len(open(path).readlines()) == 2
