@@ -12,8 +12,8 @@ from .core import (Sequential, Dense, Conv2D, MaxPooling2D, Flatten, Reshape,
                    Activation, Dropout, BatchNormalization,
                    SGD, Adam, Adagrad, Adadelta, RMSprop)
 from .core.model import FittedModel, serialize_model, deserialize_model
-from .data import (Dataset, MinMaxTransformer, DenseTransformer,
-                   ReshapeTransformer, OneHotTransformer,
+from .data import (Dataset, MinMaxTransformer, StandardScaleTransformer,
+                   DenseTransformer, ReshapeTransformer, OneHotTransformer,
                    LabelIndexTransformer)
 from .trainers import (Trainer, SingleTrainer, AveragingTrainer,
                        EnsembleTrainer, DistributedTrainer,
@@ -26,3 +26,8 @@ from . import utils
 from . import networking
 from . import workers
 from . import parameter_servers
+from . import job_deployment
+from . import checkpoint
+from . import metrics
+from .checkpoint import Checkpointer
+from .metrics import MetricsLogger

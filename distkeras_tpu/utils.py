@@ -17,6 +17,33 @@ from .core.model import (Sequential, FittedModel, serialize_model,
 from .data.dataset import Dataset
 
 
+# -- platform selection -------------------------------------------------------
+
+def honor_platform_env() -> None:
+    """Apply ``JAX_PLATFORMS=cpu`` / ``--xla_force_host_platform_device_count``
+    through the jax config API.
+
+    Needed because jax may be imported at interpreter startup (sitecustomize)
+    with the sandbox's platform snapshot, in which case the env vars alone are
+    ignored and the first ``jax.devices()`` call silently binds the default
+    platform.  Call this at the top of any script that should honor the env
+    (the examples and tests do); it is a no-op once a backend is live.
+    """
+    import os
+    import re
+
+    if "cpu" not in os.environ.get("JAX_PLATFORMS", ""):
+        return
+    m = re.search(r"host_platform_device_count=(\d+)",
+                  os.environ.get("XLA_FLAGS", ""))
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        if m:
+            jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+    except (RuntimeError, AttributeError):
+        pass  # backend already initialized (or old jax); keep what it has
+
+
 # -- model (de)serialization (reference: serialize_keras_model) --------------
 
 def serialize_keras_model(model) -> dict:

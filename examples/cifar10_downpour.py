@@ -1,0 +1,58 @@
+"""CIFAR-10 ConvNet with DOWNPOUR (reference DOWNPOUR config,
+``BASELINE.json.configs``; algorithm: SURVEY.md §2.1 row 7).
+
+Run:  python examples/cifar10_downpour.py [--workers 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run without installing
+
+import jax
+
+from distkeras_tpu import (DOWNPOUR, MinMaxTransformer, OneHotTransformer,
+                           ModelPredictor, LabelIndexTransformer,
+                           AccuracyEvaluator)
+from distkeras_tpu.data.datasets import load_cifar10
+from distkeras_tpu.models.zoo import cifar10_convnet
+
+
+def main():
+    from distkeras_tpu.utils import honor_platform_env
+    honor_platform_env()  # JAX_PLATFORMS=cpu simulation support
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=16384)
+    ap.add_argument("--test-rows", type=int, default=2048)
+    ap.add_argument("--workers", type=int, default=None)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--window", type=int, default=5)
+    args = ap.parse_args()
+
+    train, test = load_cifar10(n_train=args.rows, n_test=args.test_rows)
+    for t in (MinMaxTransformer(o_min=0.0, o_max=255.0),
+              OneHotTransformer(10)):
+        train, test = t.transform(train), t.transform(test)
+
+    workers = args.workers or len(jax.devices())
+    trainer = DOWNPOUR(cifar10_convnet(), num_workers=workers,
+                       batch_size=args.batch_size, num_epoch=args.epochs,
+                       communication_window=args.window,
+                       label_col="label_encoded", worker_optimizer="adam",
+                       learning_rate=5e-4)
+    fitted = trainer.train(train, shuffle=True)
+    print(f"time: {trainer.get_training_time():.2f}s  "
+          f"final loss: {trainer.get_history()[-1]:.4f}")
+
+    predicted = ModelPredictor(fitted).predict(test)
+    predicted = LabelIndexTransformer().transform(predicted)
+    print(f"test accuracy: {AccuracyEvaluator().evaluate(predicted):.4f}")
+
+
+if __name__ == "__main__":
+    main()

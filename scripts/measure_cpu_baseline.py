@@ -19,15 +19,17 @@ import os
 import sys
 import time
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distkeras_tpu.utils import honor_platform_env
+
+honor_platform_env()
+
+import jax
 
 from distkeras_tpu.core.train import init_state, make_train_step
 from distkeras_tpu.data.datasets import load_mnist

@@ -1,0 +1,33 @@
+"""Packaging for distkeras_tpu (parity with the reference's pip-installable
+single package; reference: ``setup.py`` — SURVEY.md §2.1 row 24).
+
+Builds the optional C++ wire-codec extension (``csrc/``) when a toolchain is
+present; the pure-Python fallback keeps the package fully functional without
+it (see ``distkeras_tpu/networking.py``).
+"""
+
+import os
+
+from setuptools import Extension, find_packages, setup
+
+ext_modules = []
+if os.environ.get("DISTKERAS_TPU_NO_NATIVE", "0") != "1":
+    ext_modules.append(Extension(
+        "distkeras_tpu._wirecodec",
+        sources=["csrc/wirecodec.cpp"],
+        extra_compile_args=["-O3", "-std=c++17"],
+        optional=True,  # fall back to pure Python if the build fails
+    ))
+
+setup(
+    name="distkeras_tpu",
+    version="0.1.0",
+    description=("TPU-native distributed deep-learning framework with the "
+                 "capability surface of dist-keras, rebuilt on JAX/XLA"),
+    license="MIT",
+    packages=find_packages(include=["distkeras_tpu", "distkeras_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["jax", "numpy", "optax"],
+    extras_require={"test": ["pytest"], "keras": ["keras>=3"]},
+    ext_modules=ext_modules,
+)

@@ -13,12 +13,14 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 # jax may already be imported at interpreter startup (sitecustomize) with the
-# sandbox's JAX_PLATFORMS=axon snapshot — override through the config API,
-# which works any time before first backend initialization.
-import jax
+# sandbox's JAX_PLATFORMS=axon snapshot — re-apply the env through the config
+# API (shared workaround lives in distkeras_tpu.utils).
+import sys
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+from distkeras_tpu.utils import honor_platform_env
+
+honor_platform_env()
 
 import numpy as np
 import pytest

@@ -1,0 +1,97 @@
+"""Job deployment + punchcard queue (reference:
+``distkeras/job_deployment.py`` — SURVEY.md §2.1 row 22).  SSH itself is not
+exercised (no cluster in CI); the LocalJobRunner doubles for it, and the SSH
+command rendering is checked textually.
+"""
+
+import os
+import sys
+
+from distkeras_tpu.job_deployment import (Job, LocalJobRunner, SSHJobRunner,
+                                          Punchcard)
+
+
+def _touch_script(tmp_path, body: str) -> str:
+    p = tmp_path / "job_script.py"
+    p.write_text(body)
+    return str(p)
+
+
+def test_local_job_runs_and_reports_exit(tmp_path):
+    out = tmp_path / "out.txt"
+    script = _touch_script(tmp_path, f"""
+import os
+with open({str(out)!r}, "a") as f:
+    f.write(os.environ["DISTKERAS_TPU_PROCESS_ID"] + "\\n")
+""")
+    job = Job("write-pid", script, hosts=["h0", "h1", "h2"])
+    rc = job.run(runner=LocalJobRunner())
+    assert rc == 0
+    assert job.returncodes == [0, 0, 0]
+    pids = sorted(out.read_text().split())
+    assert pids == ["0", "1", "2"]
+
+
+def test_job_failure_propagates(tmp_path):
+    script = _touch_script(tmp_path, "import sys; sys.exit(3)")
+    job = Job("fail", script)
+    assert job.run(runner=LocalJobRunner()) == 3
+
+
+def test_host_env_renders_coordinator():
+    job = Job("j", "train.py", hosts=["tpu-a", "tpu-b"], coordinator_port=9999)
+    env0 = job.host_env(0)
+    env1 = job.host_env(1)
+    assert env0["DISTKERAS_TPU_COORDINATOR"] == "tpu-a:9999"
+    assert env1["DISTKERAS_TPU_COORDINATOR"] == "tpu-a:9999"
+    assert env0["DISTKERAS_TPU_PROCESS_ID"] == "0"
+    assert env1["DISTKERAS_TPU_PROCESS_ID"] == "1"
+    assert env1["DISTKERAS_TPU_NUM_PROCESSES"] == "2"
+
+
+def test_ssh_command_rendering(monkeypatch):
+    captured = []
+
+    class FakePopen:
+        def __init__(self, cmd, **kw):
+            captured.append(cmd)
+
+        def wait(self):
+            return 0
+
+    import distkeras_tpu.job_deployment as jd
+    monkeypatch.setattr(jd.subprocess, "Popen", FakePopen)
+    job = Job("j", "train.py", args=["--epochs", "2"], hosts=["a", "b"])
+    assert job.run(runner=SSHJobRunner()) == 0
+    assert len(captured) == 2
+    assert captured[0][0] == "ssh"
+    assert captured[0][-2] == "a"
+    assert "DISTKERAS_TPU_PROCESS_ID=0" in captured[0][-1]
+    assert "--epochs 2" in captured[0][-1].replace("'", "")
+
+
+def test_punchcard_fifo(tmp_path):
+    q = Punchcard(str(tmp_path / "queue.jsonl"))
+    assert q.pop() is None
+    script = _touch_script(tmp_path, "pass")
+    q.submit(Job("first", script))
+    q.submit(Job("second", script, args=["x"], hosts=["h"]))
+    assert [j.name for j in q.pending()] == ["first", "second"]
+    head = q.pop()
+    assert head.name == "first"
+    assert [j.name for j in q.pending()] == ["second"]
+    restored = q.pending()[0]
+    assert restored.args == ["x"] and restored.hosts == ["h"]
+
+
+def test_punchcard_serve_drains(tmp_path):
+    marker = tmp_path / "ran.txt"
+    script = _touch_script(
+        tmp_path, f"open({str(marker)!r}, 'a').write('x')")
+    q = Punchcard(str(tmp_path / "queue.jsonl"))
+    q.submit(Job("a", script))
+    q.submit(Job("b", script))
+    n = q.serve(runner=LocalJobRunner())
+    assert n == 2
+    assert marker.read_text() == "xx"
+    assert q.pending() == []
