@@ -1,6 +1,8 @@
 from .layers import (Layer, Dense, Conv2D, MaxPooling2D, AveragePooling2D,
                      GlobalAveragePooling2D, Flatten, Reshape, Activation,
-                     Dropout, BatchNormalization, Embedding, get_activation)
+                     Dropout, BatchNormalization, Embedding, get_activation,
+                     LayerNormalization, PositionalEmbedding,
+                     MultiHeadAttention, TransformerBlock)
 from .model import Sequential, serialize_model, deserialize_model
 from .losses import get_loss
 from .optimizers import (Optimizer, SGD, Adam, Adagrad, Adadelta, RMSprop,
@@ -11,6 +13,8 @@ __all__ = [
     "Layer", "Dense", "Conv2D", "MaxPooling2D", "AveragePooling2D",
     "GlobalAveragePooling2D", "Flatten", "Reshape", "Activation", "Dropout",
     "BatchNormalization", "Embedding", "get_activation",
+    "LayerNormalization", "PositionalEmbedding", "MultiHeadAttention",
+    "TransformerBlock",
     "Sequential", "serialize_model", "deserialize_model",
     "get_loss",
     "Optimizer", "SGD", "Adam", "Adagrad", "Adadelta", "RMSprop",

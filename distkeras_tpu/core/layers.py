@@ -371,6 +371,18 @@ class Activation(Layer):
         return _apply_activation(self.activation, x)
 
 
+def _dropout(rng, rate: float, x, train: bool):
+    """Inverted dropout; identity at inference (shared by Dropout and
+    TransformerBlock so the semantics live in one place)."""
+    if not train or rate <= 0.0:
+        return x
+    if rng is None:
+        raise ValueError("Dropout in train mode requires an rng")
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
 class Dropout(Layer):
     """Inverted dropout; identity at inference. Uses the functional rng threaded
     through ``Model.apply`` (no global RNG state — jit/scan friendly)."""
@@ -383,13 +395,7 @@ class Dropout(Layer):
 
     def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
               rng=None):
-        if not train or self.rate <= 0.0:
-            return x
-        if rng is None:
-            raise ValueError("Dropout in train mode requires an rng")
-        keep = 1.0 - self.rate
-        mask = jax.random.bernoulli(rng, keep, x.shape)
-        return jnp.where(mask, x / keep, jnp.zeros_like(x))
+        return _dropout(rng, self.rate, x, train)
 
 
 class BatchNormalization(Layer):
@@ -431,6 +437,166 @@ class BatchNormalization(Layer):
         y = (x32 - mean) * jax.lax.rsqrt(var + self.epsilon)
         y = y * params["scale"] + params["offset"]
         return y.astype(x.dtype)
+
+
+class LayerNormalization(Layer):
+    """Layer norm over the trailing dim, f32 arithmetic (bf16-safe)."""
+
+    def __init__(self, epsilon: float = 1e-5):
+        self.epsilon = float(epsilon)
+
+    def init(self, rng, in_shape):
+        c = in_shape[-1]
+        return {"scale": jnp.ones((c,), jnp.float32),
+                "offset": jnp.zeros((c,), jnp.float32)}, tuple(in_shape)
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + self.epsilon)
+        return (y * params["scale"] + params["offset"]).astype(x.dtype)
+
+
+class PositionalEmbedding(Layer):
+    """Learned additive positional embedding for (B, S, D) inputs."""
+
+    def __init__(self, max_len: int):
+        self.max_len = int(max_len)
+
+    def init(self, rng, in_shape):
+        s, d = in_shape
+        if s > self.max_len:
+            raise ValueError(f"sequence {s} exceeds max_len {self.max_len}")
+        params = {"embedding": 0.02 * jax.random.normal(
+            rng, (self.max_len, d), jnp.float32)}
+        return params, tuple(in_shape)
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        s = x.shape[1]
+        return x + params["embedding"][:s].astype(x.dtype)
+
+
+def _project(x, kernel, bias, compute_dtype):
+    y = jax.lax.dot_general(
+        x.astype(compute_dtype), kernel.astype(compute_dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+class MultiHeadAttention(Layer):
+    """Multi-head self-attention on (B, S, D) inputs.
+
+    The score/softmax path runs through ``ops.attention`` (XLA fusion or the
+    Pallas flash kernel on TPU).  No reference counterpart — part of the
+    long-context layer (SURVEY.md §2.3 marks SP/attention absent upstream).
+    """
+
+    def __init__(self, num_heads: int, key_dim: int, causal: bool = False,
+                 use_bias: bool = True, attention_impl: Optional[str] = None):
+        self.num_heads = int(num_heads)
+        self.key_dim = int(key_dim)  # per-head dim
+        self.causal = bool(causal)
+        self.use_bias = bool(use_bias)
+        self.attention_impl = attention_impl
+
+    def init(self, rng, in_shape):
+        s, d = in_shape
+        inner = self.num_heads * self.key_dim
+        ks = jax.random.split(rng, 4)
+        params = {
+            "wq": init_weight(ks[0], (d, inner)),
+            "wk": init_weight(ks[1], (d, inner)),
+            "wv": init_weight(ks[2], (d, inner)),
+            "wo": init_weight(ks[3], (inner, d)),
+        }
+        if self.use_bias:
+            params.update(bq=jnp.zeros((inner,), jnp.float32),
+                          bk=jnp.zeros((inner,), jnp.float32),
+                          bv=jnp.zeros((inner,), jnp.float32),
+                          bo=jnp.zeros((d,), jnp.float32))
+        return params, tuple(in_shape)
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        from ..ops.attention import attention
+        b, s, _ = x.shape
+        h, dh = self.num_heads, self.key_dim
+
+        def proj(name):
+            bias = params.get("b" + name[1]) if self.use_bias else None
+            y = _project(x, params[name], bias, compute_dtype)
+            return y.astype(compute_dtype).reshape(b, s, h, dh)
+
+        out = attention(proj("wq"), proj("wk"), proj("wv"),
+                        causal=self.causal, impl=self.attention_impl)
+        out = out.reshape(b, s, h * dh)
+        bias_o = params.get("bo") if self.use_bias else None
+        return _project(out, params["wo"], bias_o, compute_dtype)
+
+
+class TransformerBlock(Layer):
+    """Pre-LN transformer block: LN → MHA → residual, LN → MLP → residual.
+
+    Self-contained params (no nested Layer objects) so the spec stays
+    JSON-serializable like every other layer.
+    """
+
+    def __init__(self, num_heads: int, key_dim: int, mlp_dim: int,
+                 dropout: float = 0.0, causal: bool = False,
+                 activation: str = "gelu",
+                 attention_impl: Optional[str] = None):
+        self.num_heads = int(num_heads)
+        self.key_dim = int(key_dim)
+        self.mlp_dim = int(mlp_dim)
+        self.dropout = float(dropout)
+        self.causal = bool(causal)
+        self.activation = activation
+        self.attention_impl = attention_impl
+
+    def _mha(self) -> MultiHeadAttention:
+        return MultiHeadAttention(self.num_heads, self.key_dim,
+                                  causal=self.causal,
+                                  attention_impl=self.attention_impl)
+
+    def init(self, rng, in_shape):
+        s, d = in_shape
+        k_ln1, k_attn, k_ln2, k_m1, k_m2 = jax.random.split(rng, 5)
+        ln = LayerNormalization()
+        attn_params, _ = self._mha().init(k_attn, in_shape)
+        params = {
+            "ln1": ln.init(k_ln1, in_shape)[0],
+            "attn": attn_params,
+            "ln2": ln.init(k_ln2, in_shape)[0],
+            "mlp_w1": init_weight(k_m1, (d, self.mlp_dim)),
+            "mlp_b1": jnp.zeros((self.mlp_dim,), jnp.float32),
+            "mlp_w2": init_weight(k_m2, (self.mlp_dim, d)),
+            "mlp_b2": jnp.zeros((d,), jnp.float32),
+        }
+        return params, tuple(in_shape)
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        ln = LayerNormalization()
+        drop_rngs = (jax.random.split(rng, 2) if rng is not None else
+                     (None, None))
+
+        h = ln.apply(params["ln1"], x, compute_dtype=compute_dtype)
+        h = self._mha().apply(params["attn"], h, compute_dtype=compute_dtype,
+                              train=train, rng=None)
+        x = x + _dropout(drop_rngs[0], self.dropout, h.astype(x.dtype), train)
+
+        h = ln.apply(params["ln2"], x, compute_dtype=compute_dtype)
+        h = _project(h, params["mlp_w1"], params["mlp_b1"], compute_dtype)
+        h = _apply_activation(self.activation, h).astype(compute_dtype)
+        h = _project(h, params["mlp_w2"], params["mlp_b2"], compute_dtype)
+        return x + _dropout(drop_rngs[1], self.dropout, h.astype(x.dtype),
+                            train)
 
 
 class Embedding(Layer):

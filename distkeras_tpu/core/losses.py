@@ -57,10 +57,21 @@ def mean_absolute_error(y_true, y_pred):
         y_true.astype(jnp.float32) - y_pred.astype(jnp.float32)))
 
 
+def _from_logits(fn):
+    def wrapped(y_true, y_pred):
+        return fn(y_true, y_pred, from_logits=True)
+    return wrapped
+
+
 _LOSSES = {
     "categorical_crossentropy": categorical_crossentropy,
     "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
     "binary_crossentropy": binary_crossentropy,
+    "categorical_crossentropy_from_logits":
+        _from_logits(categorical_crossentropy),
+    "sparse_categorical_crossentropy_from_logits":
+        _from_logits(sparse_categorical_crossentropy),
+    "binary_crossentropy_from_logits": _from_logits(binary_crossentropy),
     "mean_squared_error": mean_squared_error,
     "mse": mean_squared_error,
     "mean_absolute_error": mean_absolute_error,

@@ -1,3 +1,5 @@
-from .zoo import mnist_mlp, mnist_convnet, cifar10_convnet, higgs_mlp
+from .zoo import (mnist_mlp, mnist_convnet, cifar10_convnet, higgs_mlp,
+                  transformer_lm)
 
-__all__ = ["mnist_mlp", "mnist_convnet", "cifar10_convnet", "higgs_mlp"]
+__all__ = ["mnist_mlp", "mnist_convnet", "cifar10_convnet", "higgs_mlp",
+           "transformer_lm"]

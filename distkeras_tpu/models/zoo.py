@@ -8,6 +8,8 @@ from __future__ import annotations
 
 from ..core import (Sequential, Dense, Conv2D, MaxPooling2D, Flatten, Reshape,
                     Dropout)
+from ..core.layers import (Embedding, PositionalEmbedding, TransformerBlock,
+                           LayerNormalization)
 
 
 def mnist_mlp(compute_dtype: str = "bfloat16") -> Sequential:
@@ -62,3 +64,29 @@ def higgs_mlp(compute_dtype: str = "bfloat16") -> Sequential:
         Dense(500, activation="relu"),
         Dense(2, activation="softmax"),
     ], input_shape=(28,), compute_dtype=compute_dtype, name="higgs_mlp")
+
+
+def transformer_lm(vocab_size: int = 256, seq_len: int = 128,
+                   d_model: int = 128, num_heads: int = 4,
+                   num_layers: int = 2, mlp_dim: int = 512,
+                   dropout: float = 0.0, compute_dtype: str = "bfloat16",
+                   attention_impl=None) -> Sequential:
+    """Decoder-only causal transformer LM — the long-context flagship.
+
+    No reference counterpart (SURVEY.md §2.3: attention/sequence models are
+    absent upstream); this model family exists so the framework's sequence-
+    parallel path (ring attention over a 'seq' mesh axis) has a first-class
+    workload.  Input: (seq_len,) int token ids; output: (seq_len, vocab)
+    logits — train with loss="sparse_categorical_crossentropy_from_logits".
+    """
+    layers = [
+        Embedding(vocab_size, d_model),
+        PositionalEmbedding(seq_len),
+    ]
+    for _ in range(num_layers):
+        layers.append(TransformerBlock(
+            num_heads, d_model // num_heads, mlp_dim, dropout=dropout,
+            causal=True, attention_impl=attention_impl))
+    layers += [LayerNormalization(), Dense(vocab_size)]
+    return Sequential(layers, input_shape=(seq_len,),
+                      compute_dtype=compute_dtype, name="transformer_lm")

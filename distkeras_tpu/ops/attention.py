@@ -1,0 +1,63 @@
+"""Attention ops — XLA reference implementation + dispatch.
+
+No counterpart exists in the reference (its models are MLPs/small ConvNets;
+SURVEY.md §2.3 "sequence parallelism: absent") — this is part of the
+framework's long-context layer.  Layout is **BSHD** ``(batch, seq, heads,
+head_dim)`` throughout: S in the second dimension keeps the (S, Dh) matmuls
+MXU-shaped and makes the sequence axis shardable for ring attention
+(``parallel/ring.py``).
+
+``impl``: ``"xla"`` — plain jnp, XLA fuses the softmax chain; ``"pallas"`` —
+the fused flash kernel in ``ops/flash_attention.py`` (TPU); ``None`` — pick
+pallas on TPU when shapes qualify, else xla.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float("-inf")
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False,
+                          scale: Optional[float] = None):
+    """Softmax(q·kᵀ)·v with f32 softmax arithmetic.
+
+    q: (B, Sq, H, Dh); k, v: (B, Sk, H, Dh) → (B, Sq, H, Dh), in q.dtype.
+    """
+    *_, d = q.shape
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = jnp.arange(q.shape[1])
+        k_pos = jnp.arange(k.shape[1])
+        mask = k_pos[None, :] > q_pos[:, None]  # (Sq, Sk): True = hide
+        scores = jnp.where(mask[None, None], NEG_INF, scores)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
+              impl: Optional[str] = None):
+    """Dispatching entry point used by the MultiHeadAttention layer."""
+    if impl is None:
+        impl = "pallas" if _pallas_eligible(q) else "xla"
+    if impl == "xla":
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "pallas":
+        from .flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+def _pallas_eligible(q) -> bool:
+    """Fused kernel wants TPU + lane-aligned head_dim + tileable seq."""
+    if jax.default_backend() != "tpu":
+        return False
+    b, s, h, d = q.shape
+    return d % 128 == 0 and s % 128 == 0

@@ -1,0 +1,130 @@
+"""Fused flash-attention forward kernel in Pallas (TPU).
+
+The hot op of the long-context path.  XLA's unfused attention materializes
+the (S×S) score matrix in HBM; this kernel streams k/v blocks through VMEM
+with the online-softmax recurrence, so HBM traffic stays O(S·D) per head —
+the standard flash schedule, shaped for the MXU:
+
+ - grid = (batch·heads, S/block_q): one program instance owns one q block,
+   resident in VMEM; k/v for its (batch, head) stream in via ``pl.ds`` slices;
+ - scores/accumulators are (block_q, block_k)/(block_q, D) f32 tiles — MXU
+   matmuls with f32 accumulation, 2-D shapes throughout (TPU vector layout);
+ - the running max/denominator are (block_q, 1) columns, not 1-D vectors.
+
+Backward: ``jax.custom_vjp`` recomputes through the XLA reference attention
+(``ops.attention.dot_product_attention``) — flash-forward + recompute-backward
+is the classic memory/time trade; a fused backward kernel can slot in later
+without touching callers.
+
+On non-TPU backends the kernel runs in Pallas interpret mode (tests); the
+``ops.attention.attention`` dispatcher only routes here on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = float("-inf")
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
+                  block_k: int, seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale            # (bq, d)
+    bq, d = q.shape
+    nk = seq_len // block_k
+
+    m = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    if causal:
+        # skip blocks entirely in the future of this q block — the standard
+        # flash schedule halves causal FLOPs
+        nk = jnp.minimum(nk, ((qi + 1) * bq + block_k - 1) // block_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+        new_m = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        safe = jnp.where(new_m == NEG_INF, 0.0, new_m)
+        p = jnp.exp(s - safe)                            # (bq, bk)
+        corr = jnp.exp(m - safe)                         # (bq, 1)
+        acc = acc * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        return new_m, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m, l, acc))
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
+                   block_k: int, interpret: bool):
+    b, s, h, d = q.shape
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    if s % bq or s % bk:
+        raise ValueError(f"seq_len {s} not divisible by blocks ({bq},{bk})")
+    # (B, S, H, D) → (B·H, S, D): one grid row per (batch, head)
+    fold = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    qf, kf, vf = fold(q), fold(k), fold(v)
+
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, causal=causal,
+                          block_k=bk, seq_len=s),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(b * h, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """Flash attention on (B, S, H, Dh) tensors; same contract as
+    ``ops.attention.dot_product_attention``."""
+    scale = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, scale, block_q, block_k, interpret, res, g):
+    from .attention import dot_product_attention
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda a, b, c: dot_product_attention(a, b, c, causal=causal,
+                                              scale=scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

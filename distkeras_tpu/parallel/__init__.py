@@ -1,10 +1,12 @@
 from .mesh import (WORKER_AXIS, get_mesh, initialize, replicated,
                    worker_sharded, put_replicated, put_worker_sharded)
 from .spmd import SPMDEngine, DistState, shape_epoch_data
+from .ring import SEQ_AXIS, ring_attention, ring_self_attention
 from . import rules
 
 __all__ = [
     "WORKER_AXIS", "get_mesh", "initialize", "replicated", "worker_sharded",
     "put_replicated", "put_worker_sharded",
     "SPMDEngine", "DistState", "shape_epoch_data", "rules",
+    "SEQ_AXIS", "ring_attention", "ring_self_attention",
 ]

@@ -1,0 +1,110 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+No reference counterpart (SURVEY.md §2.3: sequence parallelism absent
+upstream) — this is the long-context layer of the framework.  The sequence
+dim of q/k/v is sharded across devices on a mesh axis; each device keeps its
+q shard resident and the k/v shards rotate around the ring with
+``lax.ppermute`` (riding ICI neighbor links) while a flash-style *online
+softmax* accumulates the attention output:
+
+    num ← num·e^{m−m'} + e^{s−m'}·V_blk      den ← den·e^{m−m'} + Σ e^{s−m'}
+
+so the full (S × S) score matrix never materializes and per-device memory
+stays O(S_local²·heads).  After ``ring_size`` rotations every q row has seen
+every k/v block; the result equals full attention bit-for-close (f32
+accumulation), verified against ``ops.attention.dot_product_attention`` in
+``tests/test_attention.py`` (forward and gradients).
+
+Causality is expressed through global positions (block origin × S_local +
+row), so late blocks are masked out entirely for early queries — those steps
+contribute zeros, keeping the schedule SPMD-uniform (XLA requires identical
+programs per device; skipping work data-dependently would desync the ring).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SEQ_AXIS = "seq"
+
+
+def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
+                   causal: bool = False, scale: Optional[float] = None):
+    """Collective attention over sequence shards — call *inside* shard_map.
+
+    q, k, v: local shards (B, S_local, H, Dh), sequence-sharded on
+    ``axis_name``.  Returns the local (B, S_local, H, Dh) output in q.dtype.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+
+    q32 = q.astype(jnp.float32) * scale
+    q_pos = idx * s_loc + jnp.arange(s_loc)
+    # send-to-left rotation: after r steps the resident block originated at
+    # ring position (idx + r) mod n
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    def attend(acc, k_blk, v_blk, src):
+        num, den, mx = acc
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                            k_blk.astype(jnp.float32))
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            hide = k_pos[None, :] > q_pos[:, None]
+            scores = jnp.where(hide[None, None], -jnp.inf, scores)
+        blk_max = jnp.max(scores, axis=-1)                     # (B,H,Sq)
+        new_mx = jnp.maximum(mx, blk_max)
+        # fully-masked-so-far rows keep mx = -inf; shift by 0 there so the
+        # exps below stay NaN-free (e^{-inf-0} = 0)
+        safe = jnp.where(jnp.isneginf(new_mx), 0.0, new_mx)
+        p = jnp.exp(scores - safe[..., None])                  # (B,H,Sq,Sk)
+        corr = jnp.exp(mx - safe)                              # (B,H,Sq)
+        num = num * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        den = den * corr + jnp.sum(p, axis=-1)
+        return num, den, new_mx
+
+    def body(carry, r):
+        # rotate first, then attend — n-1 rotations total, none wasted
+        k_blk, v_blk, num, den, mx = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        num, den, mx = attend((num, den, mx), k_blk, v_blk,
+                              jnp.mod(idx + r, n))
+        return (k_blk, v_blk, num, den, mx), None
+
+    # accumulators start as constants (device-invariant); mark them varying
+    # over the ring axis so the scan carry types stay fixed once the online
+    # update makes them data-dependent
+    varying = lambda a: jax.lax.pcast(a, axis_name, to="varying")
+    acc0 = attend((varying(jnp.zeros((b, h, s_loc, d), jnp.float32)),
+                   varying(jnp.zeros((b, h, s_loc), jnp.float32)),
+                   varying(jnp.full((b, h, s_loc), -jnp.inf, jnp.float32))),
+                  k, v, idx)                                    # own block
+    (_, _, num, den, _), _ = jax.lax.scan(
+        body, (k, v) + acc0, jnp.arange(1, n))
+    den = jnp.where(den == 0.0, 1.0, den)
+    out = num / den[..., None]                                  # (B,H,Sq,Dh)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
+                        causal: bool = False,
+                        scale: Optional[float] = None):
+    """Convenience wrapper: global (B, S, H, Dh) arrays in, sequence sharded
+    over ``mesh[axis_name]``, ring attention, global array out.  For models
+    already running under shard_map, call ``ring_attention`` directly."""
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        lambda a, b_, c: ring_attention(a, b_, c, axis_name, causal, scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    sharding = NamedSharding(mesh, spec)
+    return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),
+              jax.device_put(v, sharding))

@@ -1,0 +1,51 @@
+"""Pallas flash-attention kernel vs the XLA reference (interpret mode on CPU;
+the same kernel compiles for TPU — SURVEY.md §2.2 TPU-native kernel note)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.ops.attention import dot_product_attention
+from distkeras_tpu.ops.flash_attention import flash_attention
+
+
+def rand_qkv(seed, b=2, s=64, h=2, d=16):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d)) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    q, k, v = rand_qkv(0)
+    out = flash_attention(q, k, v, causal, None, 16, 16, True)
+    want = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-5)
+
+
+def test_flash_single_block():
+    q, k, v = rand_qkv(1, s=16)
+    out = flash_attention(q, k, v, True, None, 128, 128, True)
+    want = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-5)
+
+
+def test_flash_gradients():
+    q, k, v = rand_qkv(2, b=1, s=32, h=1, d=8)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, True, None, 16, 16, True).sum()
+
+    def loss_ref(q, k, v):
+        return dot_product_attention(q, k, v, causal=True).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_indivisible_seq_raises():
+    q, k, v = rand_qkv(3, s=48)
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_attention(q, k, v, False, None, 32, 32, True)
