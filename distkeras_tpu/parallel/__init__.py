@@ -2,6 +2,11 @@ from .mesh import (WORKER_AXIS, get_mesh, initialize, replicated,
                    worker_sharded, put_replicated, put_worker_sharded)
 from .spmd import SPMDEngine, DistState, shape_epoch_data
 from .ring import SEQ_AXIS, ring_attention, ring_self_attention
+from .tp import (MODEL_AXIS, column_parallel_dense, row_parallel_dense,
+                 tp_mlp, tp_self_attention)
+from .moe import moe_mlp, top1_routing
+from .pipeline import STAGE_AXIS, pipeline_apply
+from .transformer import ParallelTransformerLM
 from . import rules
 
 __all__ = [
@@ -9,4 +14,7 @@ __all__ = [
     "put_replicated", "put_worker_sharded",
     "SPMDEngine", "DistState", "shape_epoch_data", "rules",
     "SEQ_AXIS", "ring_attention", "ring_self_attention",
+    "MODEL_AXIS", "column_parallel_dense", "row_parallel_dense",
+    "tp_mlp", "tp_self_attention", "moe_mlp", "top1_routing",
+    "STAGE_AXIS", "pipeline_apply", "ParallelTransformerLM",
 ]

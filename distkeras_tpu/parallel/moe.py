@@ -1,0 +1,110 @@
+"""Expert parallelism — mixture-of-experts with all_to_all dispatch.
+
+No reference counterpart (SURVEY.md §2.3: expert parallelism absent
+upstream).  Experts are sharded across a mesh axis (each device owns
+``E / n`` expert MLPs); tokens are routed top-1 with a capacity bound and
+physically moved to their expert's device with ``lax.all_to_all`` over ICI,
+then moved back and combined with their gate weight — the Switch-Transformer
+schedule:
+
+  route (local) → dispatch einsum → all_to_all → expert MLP →
+  all_to_all back → combine einsum
+
+Everything is dense einsums against one-hot dispatch masks, so the whole
+block is differentiable and jit/scan-safe (static capacity; dropped tokens
+contribute zero and pass their residual through untouched in the caller).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+EXPERT_AXIS = "model"  # experts ride the model axis by default
+
+
+def top1_routing(logits, capacity: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 router with per-expert capacity.
+
+    logits: (T, E) f32 → dispatch (T, E, C) one-hot, combine (T, E, C)
+    gate-weighted.  Token t goes to its argmax expert e at queue slot c if
+    fewer than ``capacity`` earlier tokens chose e; otherwise it is dropped
+    (all-zero row — the caller's residual connection carries it).
+    """
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(gates, axis=-1)                       # (T,)
+    gate = jnp.max(gates, axis=-1)                            # (T,)
+    onehot = jax.nn.one_hot(expert, logits.shape[-1],
+                            dtype=jnp.float32)                # (T, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot                 # 1-based slot
+    onehot = onehot * (pos <= capacity)
+    slot = jax.nn.one_hot((pos - 1.0).astype(jnp.int32), capacity,
+                          dtype=jnp.float32)                  # (T, E, C)
+    dispatch = onehot[..., None] * slot
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def moe_mlp(x, router_kernel, w1, b1, w2, b2, *,
+            axis_name: str = EXPERT_AXIS, capacity_factor: float = 1.25,
+            activation=jax.nn.gelu, compute_dtype=jnp.bfloat16):
+    """Expert-parallel MoE MLP for (B, S, D) inputs inside shard_map.
+
+    ``x`` is replicated (in value) over ``axis_name``; each shard routes only
+    its 1/n slice of the tokens, so expert FLOPs and all_to_all bytes are
+    paid once per token, not once per shard.  The per-slice outputs reunite
+    with a psum (each slice scatters into its own rows of a zero (T, D)
+    buffer), so the return value is provably replicated over the axis.
+
+    router_kernel: (D, E) replicated; w1: (E_local, D, F), b1: (E_local, F),
+    w2: (E_local, F, D), b2: (E_local, D) — local expert shards.  Returns
+    (B, S, D) f32 (add to the residual stream in the caller).  Requires
+    B·S divisible by the axis size.
+    """
+    n = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    e_local = w1.shape[0]
+    e_total = n * e_local
+    b, s, d = x.shape
+    t = b * s
+    if t % n:
+        raise ValueError(f"token count {t} not divisible by axis size {n}")
+    t_loc = t // n
+    capacity = max(int(math.ceil(capacity_factor * t_loc / e_total)), 1)
+
+    xt = x.reshape(t, d)
+    xl = jax.lax.dynamic_slice_in_dim(xt, rank * t_loc, t_loc)  # my slice
+    logits = xl.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
+    dispatch, combine = top1_routing(logits, capacity)      # (T_loc, E, C)
+
+    # gather my tokens into per-expert buffers and ship each expert's buffer
+    # to the device that owns it
+    buf = jnp.einsum("td,tec->ecd", xl.astype(jnp.float32), dispatch)
+    buf = buf.reshape(n, e_local, capacity, d)
+    buf = jax.lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0)
+    # (n, e_local, C, D): axis 0 is now the *source* device
+    buf = buf.transpose(1, 0, 2, 3).reshape(e_local, n * capacity, d)
+
+    h = jnp.einsum("etd,edf->etf", buf.astype(compute_dtype),
+                   w1.astype(compute_dtype),
+                   preferred_element_type=jnp.float32)
+    h = activation(h + b1[:, None, :]).astype(compute_dtype)
+    out = jnp.einsum("etf,efd->etd", h, w2.astype(compute_dtype),
+                     preferred_element_type=jnp.float32)
+    out = out + b2[:, None, :]
+
+    # return every token to its source device and recombine my slice
+    out = out.reshape(e_local, n, capacity, d).transpose(1, 0, 2, 3)
+    out = jax.lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0)
+    # (n, e_local, C, D): axis 0 is now the expert group again
+    out = out.reshape(e_total, capacity, d)
+    yl = jnp.einsum("ecd,tec->td", out, combine)            # (T_loc, D)
+
+    # reassemble: every shard contributes its rows, psum replicates the sum
+    y = jnp.zeros((t, d), jnp.float32)
+    y = jax.lax.dynamic_update_slice_in_dim(y, yl, rank * t_loc, axis=0)
+    y = jax.lax.psum(y, axis_name)
+    return y.reshape(b, s, d)

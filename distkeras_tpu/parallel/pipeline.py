@@ -1,0 +1,71 @@
+"""Pipeline parallelism — GPipe-style microbatch pipeline over a mesh axis.
+
+No reference counterpart (SURVEY.md §2.3: pipeline parallelism absent
+upstream).  Layers are partitioned into ``n`` stages, one stage's params per
+device on the 'stage' mesh axis; microbatches stream through the ring with
+``lax.ppermute`` carrying activations stage→stage each tick.  The schedule
+runs ``M + n - 1`` ticks (M microbatches + the fill/drain bubble); every
+device executes the *same* program every tick (SPMD uniformity — bubbles
+compute on garbage and their results are masked out), and reverse-mode
+autodiff through the scan + ppermute gives pipeline-parallel backprop for
+free (ppermute's transpose is the reverse permute).
+
+Constraint: the stage function must be shape-preserving ((micro_b, ...) →
+same shape), which holds for transformer blocks — the canonical PP workload.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+STAGE_AXIS = "stage"
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
+                   axis_name: str = STAGE_AXIS):
+    """Run microbatches through the stage pipeline — call inside shard_map.
+
+    stage_fn(params, x) -> y, shape-preserving.
+    stage_params: this device's stage params (leading 'stage' axis already
+    split by shard_map, squeezed by the caller).
+    x_micro: (M, micro_b, ...) microbatches — meaningful on stage 0 (other
+    stages may carry zeros; their values are ignored).
+    Returns (M, micro_b, ...): meaningful on the last stage, zeros elsewhere.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    m = x_micro.shape[0]
+    ticks = m + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]  # send forward
+    micro_shape = x_micro.shape[1:]
+
+    varying = lambda a: jax.lax.pcast(a, axis_name, to="varying")
+    buf0 = varying(jnp.zeros(micro_shape, x_micro.dtype))
+    out0 = varying(jnp.zeros((m,) + micro_shape, jnp.float32))
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # stage 0 ingests microbatch t (clamped during drain); later stages
+        # consume what arrived from the previous stage last tick
+        feed = jax.lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, m - 1), keepdims=False)
+        x_in = jnp.where(idx == 0, feed, buf)
+        y = stage_fn(stage_params, x_in)
+        # the last stage finished microbatch t-(n-1); record it (masked to
+        # zero elsewhere and during fill)
+        slot = t - (n - 1)
+        record = jnp.where((idx == n - 1) & (slot >= 0),
+                           y.astype(jnp.float32),
+                           jnp.zeros_like(y, jnp.float32))
+        # during fill (slot < 0) this writes zeros into slot 0, which the
+        # real slot-0 record overwrites at tick n-1
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, record, jnp.clip(slot, 0, m - 1), axis=0)
+        buf = jax.lax.ppermute(y, axis_name, perm)
+        return (buf, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(ticks))
+    return outputs

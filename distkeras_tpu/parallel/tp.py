@@ -1,0 +1,99 @@
+"""Tensor-parallel building blocks (Megatron-style) for shard_map programs.
+
+No reference counterpart (SURVEY.md §2.3: tensor parallelism absent upstream
+— its models fit on one 2016 CPU).  On TPU, tensor parallelism is how a model
+larger than one chip's HBM trains: weight matrices are split across a mesh
+axis and the *activations* are exchanged over ICI instead.
+
+The two primitives compose into the standard one-collective-per-block
+pattern:
+
+  column_parallel:  y_local = x @ W[:, shard]          (no communication)
+  row_parallel:     y = psum_tp(x_local @ W[shard, :]) (one psum)
+
+so an MLP (column → gelu → row) and an attention block (qkv column-split by
+head, output row-split) each cost exactly one ``psum`` over the 'model' axis
+— the Megatron schedule.  All functions here assume they run inside
+``shard_map`` with ``axis_name`` a live mesh axis; weights arrive already
+sharded (leading ``W.shape[...]`` are the *local* shard sizes).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+MODEL_AXIS = "model"
+
+
+def column_parallel_dense(x, kernel, bias=None, *,
+                          compute_dtype=jnp.bfloat16):
+    """x @ W_col_shard. Kernel is the local (D, F/tp) shard; output stays
+    sharded on its trailing dim — zero communication."""
+    y = jax.lax.dot_general(
+        x.astype(compute_dtype), kernel.astype(compute_dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def row_parallel_dense(x, kernel, bias=None, *, axis_name: str = MODEL_AXIS,
+                       compute_dtype=jnp.bfloat16):
+    """psum(x_shard @ W_row_shard). Kernel is the local (F/tp, D) shard; the
+    partial products reduce over ICI — the block's single collective.  Bias
+    is added once, after the reduce (it is replicated)."""
+    y = jax.lax.dot_general(
+        x.astype(compute_dtype), kernel.astype(compute_dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    y = jax.lax.psum(y, axis_name)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def tp_mlp(x, w1, b1, w2, b2, *, axis_name: str = MODEL_AXIS,
+           activation=jax.nn.gelu, compute_dtype=jnp.bfloat16):
+    """Column → activation → row: the Megatron MLP, one psum total.
+    w1: (D, mlp/tp) local shard; w2: (mlp/tp, D) local shard."""
+    h = column_parallel_dense(x, w1, b1, compute_dtype=compute_dtype)
+    h = activation(h).astype(compute_dtype)
+    return row_parallel_dense(h, w2, b2, axis_name=axis_name,
+                              compute_dtype=compute_dtype)
+
+
+def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
+                      head_dim: int, axis_name: str = MODEL_AXIS,
+                      seq_axis: Optional[str] = None, causal: bool = True,
+                      compute_dtype=jnp.bfloat16):
+    """Head-parallel self-attention: each model-axis shard owns
+    ``num_local_heads`` heads end to end (qkv column-split by head, local
+    attention, output row-split) — one psum per block.  With ``seq_axis``
+    set, attention itself runs as a ring over that mesh axis (sequence
+    parallelism composing with tensor parallelism).
+
+    x: (B, S_local, D) replicated over 'model'; wq/wk/wv: (D, local_heads·Dh)
+    shards; wo: (local_heads·Dh, D) shard.
+    """
+    from .ring import ring_attention
+    from ..ops.attention import dot_product_attention
+
+    b, s, _ = x.shape
+    h, dh = num_local_heads, head_dim
+
+    def proj(w):
+        y = column_parallel_dense(x, w, compute_dtype=compute_dtype)
+        return y.astype(compute_dtype).reshape(b, s, h, dh)
+
+    q, k, v = proj(wq), proj(wk), proj(wv)
+    if seq_axis is not None:
+        out = ring_attention(q, k, v, seq_axis, causal=causal)
+    else:
+        out = dot_product_attention(q, k, v, causal=causal)
+    out = out.reshape(b, s, h * dh)
+    return row_parallel_dense(out, wo, axis_name=axis_name,
+                              compute_dtype=compute_dtype)

@@ -1,0 +1,269 @@
+"""ParallelTransformerLM — one train step composing dp × tp × sp (+ ep).
+
+The integration point of the model-parallel layer (no reference counterpart;
+SURVEY.md §2.3): a decoder-only LM whose single jitted train step shards
+
+ - the batch over the 'data' mesh axis (data parallelism),
+ - the sequence over the 'seq' axis (ring attention, ``ring.py``),
+ - attention heads + MLP/expert weights over the 'model' axis
+   (Megatron tensor parallelism, ``tp.py``; Switch expert parallelism,
+   ``moe.py``),
+
+inside one ``shard_map`` over the full mesh.  Gradients come out correct
+without hand-written reductions: jax's varying-axes machinery inserts the
+psum transposes for replicated params automatically, and sharded params keep
+their 'model'-varying grads aligned with their shards.  The loss is the
+global token mean (psum over data+seq of local sums).
+
+This is the program ``__graft_entry__.dryrun_multichip`` compiles over an
+n-device mesh.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .tp import tp_mlp, tp_self_attention
+from .moe import moe_mlp
+
+tmap = jax.tree_util.tree_map
+
+
+class ParallelTransformerLM:
+    """Causal LM over a ('data', 'seq', 'model') mesh."""
+
+    def __init__(self, vocab_size: int, seq_len: int, d_model: int,
+                 num_heads: int, num_layers: int, mlp_dim: int,
+                 mesh: Mesh, *, moe_layers: Tuple[int, ...] = (),
+                 num_experts: Optional[int] = None,
+                 capacity_factor: float = 2.0,
+                 compute_dtype=jnp.bfloat16,
+                 data_axis: str = "data", seq_axis: str = "seq",
+                 model_axis: str = "model"):
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self.d_model = d_model
+        self.num_heads = num_heads
+        self.num_layers = num_layers
+        self.mlp_dim = mlp_dim
+        self.mesh = mesh
+        self.moe_layers = tuple(moe_layers)
+        self.capacity_factor = capacity_factor
+        self.compute_dtype = compute_dtype
+        self.axes = (data_axis, seq_axis, model_axis)
+        self.tp = mesh.shape[model_axis]
+        self.sp = mesh.shape[seq_axis]
+        self.dp = mesh.shape[data_axis]
+        if num_heads % self.tp:
+            raise ValueError(f"num_heads {num_heads} % tp {self.tp} != 0")
+        if mlp_dim % self.tp:
+            raise ValueError(f"mlp_dim {mlp_dim} % tp {self.tp} != 0")
+        if seq_len % self.sp:
+            raise ValueError(f"seq_len {seq_len} % sp {self.sp} != 0")
+        self.num_experts = (num_experts if num_experts is not None
+                            else self.tp)
+        if self.moe_layers and self.num_experts % self.tp:
+            raise ValueError("num_experts must divide over the model axis")
+        self.head_dim = d_model // num_heads
+
+    # -- params + specs -------------------------------------------------------
+    def _layer_shapes(self, i: int):
+        d, f, hd = self.d_model, self.mlp_dim, self.num_heads * self.head_dim
+        _, _, model = self.axes
+        shapes = {
+            "ln1": ((d,), P()),
+            "ln2": ((d,), P()),
+            "wq": ((d, hd), P(None, model)),
+            "wk": ((d, hd), P(None, model)),
+            "wv": ((d, hd), P(None, model)),
+            "wo": ((hd, d), P(model, None)),
+        }
+        if i in self.moe_layers:
+            e = self.num_experts
+            shapes.update({
+                "router": ((d, e), P()),
+                "w1": ((e, d, f), P(model, None, None)),
+                "b1": ((e, f), P(model, None)),
+                "w2": ((e, f, d), P(model, None, None)),
+                "b2": ((e, d), P(model, None)),
+            })
+        else:
+            shapes.update({
+                "w1": ((d, f), P(None, model)),
+                "b1": ((f,), P(model)),
+                "w2": ((f, d), P(model, None)),
+                "b2": ((d,), P()),
+            })
+        return shapes
+
+    def _shapes_and_specs(self):
+        d = self.d_model
+        shapes: dict = {
+            "embed": ((self.vocab_size, d), P()),
+            "pos": ((self.seq_len, d), P()),
+            "ln_f": ((d,), P()),
+            "head": ((d, self.vocab_size), P()),
+            "layers": [self._layer_shapes(i) for i in range(self.num_layers)],
+        }
+        split = lambda take: tmap(lambda sp: sp[take], shapes,
+                                  is_leaf=lambda x: isinstance(x, tuple)
+                                  and len(x) == 2 and isinstance(x[0], tuple))
+        return split(0), split(1)
+
+    def param_specs(self):
+        return self._shapes_and_specs()[1]
+
+    def init(self, rng) -> Any:
+        """Initialize params directly into their mesh shardings.
+
+        LN scales → ones, biases → zeros, embeddings/pos → small normal,
+        matmul weights → normal / sqrt(fan_in).
+        """
+        shapes, specs = self._shapes_and_specs()
+        is_shape = lambda x: (isinstance(x, tuple)
+                              and all(isinstance(d, int) for d in x))
+        flat, tree = jax.tree_util.tree_flatten_with_path(
+            shapes, is_leaf=is_shape)
+        flat_specs = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, P))
+        rngs = jax.random.split(rng, len(flat))
+        leaves = []
+        for k, (path, shape), spec in zip(rngs, flat, flat_specs):
+            name = path[-1].key if hasattr(path[-1], "key") else ""
+            if name.startswith("ln"):
+                arr = jnp.ones(shape, jnp.float32)
+            elif name.startswith("b"):
+                arr = jnp.zeros(shape, jnp.float32)
+            elif name in ("embed", "pos"):
+                arr = 0.02 * jax.random.normal(k, shape, jnp.float32)
+            else:
+                arr = (jax.random.normal(k, shape, jnp.float32)
+                       / math.sqrt(max(shape[-2] if len(shape) > 1
+                                       else shape[0], 1)))
+            leaves.append(jax.device_put(
+                arr, NamedSharding(self.mesh, spec)))
+        return jax.tree_util.tree_unflatten(tree, leaves)
+
+    # -- forward --------------------------------------------------------------
+    def _forward(self, params, tokens):
+        """Local forward inside shard_map: tokens (B_loc, S_loc) int32 →
+        logits (B_loc, S_loc, V) f32."""
+        data_axis, seq_axis, model_axis = self.axes
+        cdt = self.compute_dtype
+        s_loc = tokens.shape[1]
+        seq_idx = jax.lax.axis_index(seq_axis)
+
+        x = params["embed"].astype(cdt)[tokens]
+        pos = jax.lax.dynamic_slice_in_dim(params["pos"], seq_idx * s_loc,
+                                           s_loc)
+        x = x + pos.astype(cdt)
+
+        def ln(scale, h):
+            h32 = h.astype(jnp.float32)
+            mu = jnp.mean(h32, axis=-1, keepdims=True)
+            var = jnp.var(h32, axis=-1, keepdims=True)
+            return ((h32 - mu) * jax.lax.rsqrt(var + 1e-5)
+                    * scale).astype(cdt)
+
+        for i, lp in enumerate(params["layers"]):
+            h = ln(lp["ln1"], x)
+            attn = tp_self_attention(
+                h, lp["wq"], lp["wk"], lp["wv"], lp["wo"],
+                num_local_heads=self.num_heads // self.tp,
+                head_dim=self.head_dim, axis_name=model_axis,
+                seq_axis=seq_axis, causal=True, compute_dtype=cdt)
+            x = x + attn.astype(cdt)
+            h = ln(lp["ln2"], x)
+            if i in self.moe_layers:
+                # token slices are routed per model shard and psum-reunited
+                # inside moe_mlp, so y comes back replicated over 'model'
+                y = moe_mlp(h, lp["router"], lp["w1"], lp["b1"], lp["w2"],
+                            lp["b2"], axis_name=model_axis,
+                            capacity_factor=self.capacity_factor,
+                            compute_dtype=cdt)
+            else:
+                y = tp_mlp(h, lp["w1"], lp["b1"], lp["w2"], lp["b2"],
+                           axis_name=model_axis, compute_dtype=cdt)
+            x = x + y.astype(cdt)
+
+        x = ln(params["ln_f"], x)
+        return jax.lax.dot_general(
+            x.astype(cdt), params["head"].astype(cdt),
+            (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def _loss(self, params, tokens, labels):
+        data_axis, seq_axis, _ = self.axes
+        logits = self._forward(params, tokens)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            logp, labels.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        local_sum = -jnp.sum(picked)
+        local_cnt = jnp.asarray(picked.size, jnp.float32)
+        total = jax.lax.psum(local_sum, (data_axis, seq_axis))
+        count = jax.lax.psum(local_cnt, (data_axis, seq_axis))
+        return total / count
+
+    # -- train step -----------------------------------------------------------
+    def _opt_specs(self, optimizer, params):
+        """PartitionSpecs for the optimizer state.
+
+        Optax moment trees (mu/nu/trace...) embed the full param tree, so
+        every state leaf's key path *ends with* some param's key path — match
+        on that suffix to inherit the param's spec; leaves with no param
+        suffix (step counters, scalars) replicate."""
+        opt_shape = jax.eval_shape(optimizer.init, params)
+        spec_leaves = jax.tree_util.tree_leaves(
+            self.param_specs(), is_leaf=lambda x: isinstance(x, P))
+        path_to_spec = {
+            tuple(str(k) for k in path): sp
+            for (path, _), sp in zip(
+                jax.tree_util.tree_flatten_with_path(params)[0], spec_leaves)}
+
+        def leaf_spec(path, leaf):
+            keys = tuple(str(k) for k in path)
+            for start in range(len(keys)):
+                sp = path_to_spec.get(keys[start:])
+                if sp is not None:
+                    return sp
+            return P()
+
+        return jax.tree_util.tree_map_with_path(leaf_spec, opt_shape)
+
+    def compile_train_step(self, optimizer: optax.GradientTransformation,
+                           params):
+        """Build (opt_state, jitted step): step(params, opt, tokens, labels)
+        -> (params, opt, loss).  tokens/labels are (B, S) int32 sharded
+        ``P('data', 'seq')``."""
+        data_axis, seq_axis, _ = self.axes
+        specs = self.param_specs()
+        batch_spec = P(data_axis, seq_axis)
+        opt_sp = self._opt_specs(optimizer, params)
+
+        def local_step(params, opt_state, tokens, labels):
+            loss, grads = jax.value_and_grad(self._loss)(params, tokens,
+                                                         labels)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        opt_state = jax.jit(
+            optimizer.init,
+            out_shardings=tmap(lambda s: NamedSharding(self.mesh, s), opt_sp,
+                               is_leaf=lambda x: isinstance(x, P)))(params)
+        step = jax.jit(jax.shard_map(
+            local_step, mesh=self.mesh,
+            in_specs=(specs, opt_sp, batch_spec, batch_spec),
+            out_specs=(specs, opt_sp, P())),
+            donate_argnums=(0, 1))
+        return opt_state, step
+
+    def batch_sharding(self) -> NamedSharding:
+        data_axis, seq_axis, _ = self.axes
+        return NamedSharding(self.mesh, P(data_axis, seq_axis))

@@ -1,0 +1,85 @@
+"""ParallelTransformerLM: the integrated dp × sp × tp (+ ep) train step.
+
+Checks: (a) the 8-device 2×2×2 mesh program computes the same loss as the
+same model on a degenerate 1×1×1 mesh (sharding changes nothing
+numerically), (b) training converges on a deterministic next-token task,
+(c) sharded params actually carry their specs on device.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from distkeras_tpu.parallel.transformer import ParallelTransformerLM
+
+
+def make_lm(mesh, **kw):
+    cfg = dict(vocab_size=32, seq_len=16, d_model=16, num_heads=2,
+               num_layers=2, mlp_dim=32, mesh=mesh,
+               compute_dtype=jnp.float32)
+    cfg.update(kw)
+    return ParallelTransformerLM(**cfg)
+
+
+def make_batch(lm, n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, lm.vocab_size, (n, lm.seq_len)).astype(np.int32)
+    labels = (toks + 1) % lm.vocab_size
+    sh = lm.batch_sharding()
+    return jax.device_put(toks, sh), jax.device_put(labels, sh)
+
+
+def mesh_of(shape):
+    n = int(np.prod(shape))
+    devs = np.array(jax.devices()[:n]).reshape(shape)
+    return Mesh(devs, ("data", "seq", "model"))
+
+
+def run_steps(lm, steps, seed=0, lr=1e-2):
+    params = lm.init(jax.random.PRNGKey(7))
+    opt_state, step = lm.compile_train_step(optax.adam(lr), params)
+    toks, labels = make_batch(lm, seed=seed)
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, toks, labels)
+        losses.append(float(loss))
+    return losses, params
+
+
+@pytest.mark.parametrize("moe", [False, True])
+def test_sharded_matches_single_device(eight_devices, moe):
+    kw = {}
+    if moe:
+        # capacity_factor high enough that no token drops on either mesh
+        # (per-shard capacities differ between meshes otherwise)
+        kw = dict(moe_layers=(1,), num_experts=2, capacity_factor=8.0)
+    l8, _ = run_steps(make_lm(mesh_of((2, 2, 2)), **kw), 3)
+    l1, _ = run_steps(make_lm(mesh_of((1, 1, 1)), **kw), 3)
+    np.testing.assert_allclose(l8, l1, rtol=2e-4)
+
+
+def test_training_converges(eight_devices):
+    losses, _ = run_steps(
+        make_lm(mesh_of((2, 2, 2)), moe_layers=(1,), num_experts=2), 30)
+    assert losses[-1] < 0.3 * losses[0], losses
+
+
+def test_params_are_sharded(eight_devices):
+    lm = make_lm(mesh_of((2, 2, 2)))
+    params = lm.init(jax.random.PRNGKey(0))
+    wq = params["layers"][0]["wq"]          # P(None, 'model'): split in 2
+    assert wq.sharding.spec == jax.sharding.PartitionSpec(None, "model")
+    local = wq.addressable_shards[0].data.shape
+    assert local == (16, 8)                  # (d, H·Dh/tp) = (16, 16/2)
+    assert params["embed"].addressable_shards[0].data.shape == (32, 16)
+
+
+def test_validation_errors():
+    mesh = mesh_of((2, 2, 2))
+    with pytest.raises(ValueError, match="num_heads"):
+        make_lm(mesh, num_heads=3)
+    with pytest.raises(ValueError, match="seq_len"):
+        make_lm(mesh, seq_len=15)
