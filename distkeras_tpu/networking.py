@@ -39,6 +39,14 @@ _U64 = struct.Struct("<Q")
 #: maximum header size we will accept (sanity bound against garbage frames)
 MAX_HEADER_BYTES = 64 * 1024 * 1024
 
+# Native C++ codec (csrc/wirecodec.cpp, built by `setup.py build_ext
+# --inplace`): byte-identical wire format, single-allocation encode and
+# zero-copy decode.  Optional — the pure-Python path below is the fallback.
+try:
+    from . import _wirecodec as _native
+except ImportError:  # pragma: no cover - depends on build environment
+    _native = None
+
 
 # ---------------------------------------------------------------------------
 # structure encoding
@@ -90,6 +98,8 @@ def encode_message(obj: Any) -> bytes:
     header = json.dumps(
         {"tree": _encode_node(obj, buffers), "nbuf": len(buffers)}
     ).encode()
+    if _native is not None:
+        return _native.encode_frames(header, buffers)
     parts = [MAGIC, _U32.pack(len(header)), header]
     for b in buffers:
         raw = b.tobytes()
@@ -119,11 +129,26 @@ def _expected_buffer_sizes(tree: Any, out: dict):
 
 
 def decode_message(data: bytes) -> Any:
+    if _native is not None:
+        raw_header, views = _native.decode_frames(data)
+        header = json.loads(raw_header.decode())
+        expected: dict = {}
+        _expected_buffer_sizes(header["tree"], expected)
+        if len(views) != header["nbuf"]:
+            raise ValueError(
+                f"{len(views)} buffers on wire, header declares "
+                f"{header['nbuf']}")
+        for i, v in enumerate(views):
+            if v.nbytes != expected.get(i, -1):
+                raise ValueError(
+                    f"buffer {i} carries {v.nbytes} bytes, header expects "
+                    f"{expected.get(i)}")
+        return _decode_node(header["tree"], views)
     if data[:4] != MAGIC:
         raise ValueError("Bad magic on wire message")
     (hlen,) = _U32.unpack_from(data, 4)
     header = json.loads(data[8:8 + hlen].decode())
-    expected: dict = {}
+    expected = {}
     _expected_buffer_sizes(header["tree"], expected)
     off = 8 + hlen
     buffers: List[bytes] = []

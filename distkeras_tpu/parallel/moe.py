@@ -56,8 +56,10 @@ def moe_mlp(x, router_kernel, w1, b1, w2, b2, *,
     ``x`` is replicated (in value) over ``axis_name``; each shard routes only
     its 1/n slice of the tokens, so expert FLOPs and all_to_all bytes are
     paid once per token, not once per shard.  The per-slice outputs reunite
-    with a psum (each slice scatters into its own rows of a zero (T, D)
-    buffer), so the return value is provably replicated over the axis.
+    with a tiled all_gather — the result is replicated in *value* over the
+    axis but typed as axis-varying; callers whose outputs must be provably
+    replicated reduce later (e.g. a pmean on the scalar loss, as
+    ``ParallelTransformerLM`` does).
 
     router_kernel: (D, E) replicated; w1: (E_local, D, F), b1: (E_local, F),
     w2: (E_local, F, D), b2: (E_local, D) — local expert shards.  Returns
@@ -103,8 +105,7 @@ def moe_mlp(x, router_kernel, w1, b1, w2, b2, *,
     out = out.reshape(e_total, capacity, d)
     yl = jnp.einsum("ecd,tec->td", out, combine)            # (T_loc, D)
 
-    # reassemble: every shard contributes its rows, psum replicates the sum
-    y = jnp.zeros((t, d), jnp.float32)
-    y = jax.lax.dynamic_update_slice_in_dim(y, yl, rank * t_loc, axis=0)
-    y = jax.lax.psum(y, axis_name)
+    # reassemble the full token set from the per-shard slices (ships only
+    # the 1/n non-zero payload, unlike a zero-padded psum)
+    y = jax.lax.all_gather(yl, axis_name, axis=0, tiled=True)
     return y.reshape(b, s, d)

@@ -208,7 +208,10 @@ class ParallelTransformerLM:
         local_cnt = jnp.asarray(picked.size, jnp.float32)
         total = jax.lax.psum(local_sum, (data_axis, seq_axis))
         count = jax.lax.psum(local_cnt, (data_axis, seq_axis))
-        return total / count
+        # scalar pmean over 'model': a no-op in value (every model shard
+        # computes the same loss) that makes the replication provable — the
+        # MoE all_gather leaves activations typed model-varying
+        return jax.lax.pmean(total / count, self.axes[2])
 
     # -- train step -----------------------------------------------------------
     def _opt_specs(self, optimizer, params):

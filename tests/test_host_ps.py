@@ -69,6 +69,19 @@ def test_wire_rejects_mismatched_buffer_length():
     tampered = bytearray(good)
     off = len(good) - 16 - 8  # u64 length prefix of the single 16-byte buffer
     tampered[off:off + 8] = (1 << 60).to_bytes(8, "little")
+    with pytest.raises(ValueError, match="expects|Truncated"):
+        networking.decode_message(bytes(tampered))
+
+
+def test_wire_rejects_mismatched_buffer_length_python_path(monkeypatch):
+    """Same OOM-guard, forced through the pure-Python decode path (the
+    native codec, when built, otherwise intercepts with 'Truncated')."""
+    monkeypatch.setattr(networking, "_native", None)
+    good = networking.encode_message({"w": np.zeros((4,), np.float32)})
+    tampered = bytearray(good)
+    off = len(good) - 16 - 8
+    tampered[off:off + 8] = (64).to_bytes(8, "little")  # wrong but in-range
+    tampered += b"\x00" * 48  # pad so the lie is physically satisfiable
     with pytest.raises(ValueError, match="expects"):
         networking.decode_message(bytes(tampered))
 
