@@ -182,11 +182,19 @@ class SocketParameterServer:
                 if op == b"p":
                     networking.send_data(conn, self.ps.handle_pull())
                 elif op == b"c":
-                    self.ps.handle_commit(networking.recv_data(conn))
+                    try:
+                        msg = networking.recv_data(conn)
+                    except ValueError:
+                        return  # torn/corrupt frame: drop the connection
+                    # apply-rule errors deliberately propagate (visible
+                    # thread traceback) — only transport faults are silent
+                    self.ps.handle_commit(msg)
                 else:
-                    raise ValueError(f"unknown opcode {op!r}")
+                    return  # protocol violation: drop the connection
         except (ConnectionError, OSError):
-            return  # worker died: reference behavior is silent handler exit
+            # worker died: reference behavior is silent handler exit; the
+            # server keeps serving the others
+            return
         finally:
             try:
                 conn.close()

@@ -1,0 +1,130 @@
+"""Determinism and failure behavior.
+
+SURVEY.md §5: the reference's PS applies commits racily (GIL-tolerated
+hogwild) and its failure story is Spark task retry.  The SPMD rebuild is
+deterministic by construction — assert it — and the host-PS path must
+survive worker connection death the way the reference does (handler thread
+exits silently, the server keeps serving).
+"""
+
+import threading
+
+import numpy as np
+
+from distkeras_tpu import ADAG, networking
+from distkeras_tpu.core.model import serialize_model
+from distkeras_tpu.parameter_servers import (DeltaParameterServer,
+                                             SocketParameterServer)
+
+from test_trainers import make_dataset, make_model
+
+
+def test_spmd_training_is_bit_deterministic(eight_devices):
+    """Two identical ADAG runs produce bit-identical weights (the reference's
+    PS race cannot: commit interleaving varies run to run)."""
+
+    def run():
+        t = ADAG(make_model(), num_workers=8, batch_size=16, num_epoch=2,
+                 communication_window=4, label_col="label_encoded",
+                 worker_optimizer="adam", learning_rate=1e-3, seed=42)
+        return t.train(make_dataset(seed=5), shuffle=True)
+
+    w1 = run().get_weights()
+    w2 = run().get_weights()
+    for a, b in zip(w1, w2):
+        np.testing.assert_array_equal(a, b)
+
+
+def _start_ps():
+    model = make_model()
+    params = model.init(__import__("jax").random.PRNGKey(0), (16,))
+    ps = DeltaParameterServer(serialize_model(model, params))
+    server = SocketParameterServer(ps)
+    server.start()
+    return ps, server
+
+
+def test_ps_survives_worker_death():
+    """A worker that dies mid-protocol (EOF after opcode, torn frame) must
+    not take down the PS or corrupt service for healthy workers."""
+    ps, server = _start_ps()
+    try:
+        # victim 1: connects and vanishes immediately
+        c1 = networking.connect("127.0.0.1", server.port)
+        c1.close()
+
+        # victim 2: sends a commit opcode then dies mid-frame
+        c2 = networking.connect("127.0.0.1", server.port)
+        networking.send_opcode(c2, b"c")
+        c2.sendall(b"DKT1\x10\x00\x00\x00partial")  # torn frame
+        c2.close()
+
+        # victim 3: sends garbage opcode
+        c3 = networking.connect("127.0.0.1", server.port)
+        c3.sendall(b"Z")
+        c3.close()
+
+        # healthy worker: full pull + commit cycle still works
+        h = networking.connect("127.0.0.1", server.port)
+        networking.send_opcode(h, b"p")
+        pulled = networking.recv_data(h)
+        assert pulled["clock"] == 0
+        delta = [np.ones_like(w) for w in pulled["weights"]]
+        networking.send_opcode(h, b"c")
+        networking.send_data(h, {"delta": delta, "clock": 0})
+        networking.send_opcode(h, b"p")
+        after = networking.recv_data(h)
+        assert after["clock"] == 1
+        np.testing.assert_allclose(after["weights"][0],
+                                   pulled["weights"][0] + 1.0)
+        networking.send_opcode(h, b"q")
+        h.close()
+    finally:
+        server.stop()
+
+
+def test_ps_concurrent_commits_all_land():
+    """N threads commit concurrently; the clock counts every commit and the
+    center equals the sum of all deltas (per-apply mutex: no torn writes —
+    the deliberate divergence from the reference's lock-free apply)."""
+    ps, server = _start_ps()
+    n_threads, commits_each = 4, 8
+    try:
+        def worker():
+            c = networking.connect("127.0.0.1", server.port)
+            for _ in range(commits_each):
+                networking.send_opcode(c, b"p")
+                pulled = networking.recv_data(c)
+                delta = [np.ones_like(w) for w in pulled["weights"]]
+                networking.send_opcode(c, b"c")
+                networking.send_data(c, {"delta": delta, "clock": 0})
+            networking.send_opcode(c, b"q")
+            c.close()
+
+        before = [w.copy() for w in ps.center]
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # commits are fire-and-forget: the handler may still be applying the
+        # last frame after the client closed — wait for the clock to settle
+        import time
+        deadline = time.time() + 5.0
+        while (ps.num_updates < n_threads * commits_each
+               and time.time() < deadline):
+            time.sleep(0.01)
+        assert ps.num_updates == n_threads * commits_each
+        np.testing.assert_allclose(
+            ps.center[0], before[0] + n_threads * commits_each, atol=1e-5)
+    finally:
+        server.stop()
+
+
+def test_stop_is_idempotent_and_unblocks():
+    ps, server = _start_ps()
+    accept_thread = server._threads[0]
+    server.stop()
+    server.stop()  # second stop must not raise
+    accept_thread.join(timeout=5.0)
+    assert not accept_thread.is_alive()
