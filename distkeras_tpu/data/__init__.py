@@ -4,10 +4,12 @@ from .transformers import (Transformer, MinMaxTransformer,
                            ReshapeTransformer, OneHotTransformer,
                            LabelIndexTransformer, LabelVectorTransformerUDF)
 from .datasets import load_mnist, load_cifar10, load_atlas_higgs
+from .pipeline import round_stream, prefetch_to_device
 
 __all__ = [
     "Dataset", "Transformer", "MinMaxTransformer", "StandardScaleTransformer",
     "DenseTransformer", "ReshapeTransformer", "OneHotTransformer",
     "LabelIndexTransformer", "LabelVectorTransformerUDF",
     "load_mnist", "load_cifar10", "load_atlas_higgs",
+    "round_stream", "prefetch_to_device",
 ]

@@ -1,0 +1,84 @@
+"""Streaming input pipeline — host-side batching with device prefetch.
+
+The reference streams rows out of Spark partition iterators into per-worker
+numpy buffers (reference: ``distkeras/workers.py :: SequentialWorker.train``
+builds minibatches from the partition iterator).  The SPMD engine's default
+path instead ships a whole epoch to HBM once (``shape_epoch_data``) — optimal
+when the data fits.  This module is the third mode, for datasets that do
+NOT fit device memory: a generator of per-round host arrays, double-buffered
+onto the devices (``jax.device_put`` is async, so the round r+1 transfer
+overlaps round r's compute), consumed by
+``SPMDEngine.run_epoch_streaming``.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def round_stream(x: np.ndarray, y: np.ndarray, num_workers: int,
+                 window: int, batch_size: int,
+                 shuffle_seed: Optional[int] = None
+                 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Yield per-round arrays shaped (window, workers, batch, ...).
+
+    Row layout matches ``shape_epoch_data`` (worker-major contiguous shards,
+    tail truncated to whole rounds), so a streamed epoch visits exactly the
+    same batches as the all-at-once path — verified bit-for-bit in
+    tests/test_pipeline.py.
+    """
+    n, w, b = num_workers, window, batch_size
+    per_round = n * w * b
+    rounds = len(x) // per_round
+    if rounds == 0:
+        raise ValueError(
+            f"dataset of {len(x)} rows is smaller than one round "
+            f"(workers({n}) * window({w}) * batch({b}) = {per_round})")
+    # only the permutation (an index vector) is materialized up front; rows
+    # are gathered one round at a time, so peak extra host memory is one
+    # round, not a full shuffled copy of the dataset
+    perm = (np.random.default_rng(shuffle_seed).permutation(len(x))
+            if shuffle_seed is not None else None)
+    stride = rounds * w * b  # rows per worker shard
+    for r in range(rounds):
+        # worker i, round r owns (permuted) rows
+        # [i*stride + r*w*b, i*stride + (r+1)*w*b)
+        sel = np.concatenate([
+            np.arange(i * stride + r * w * b, i * stride + (r + 1) * w * b)
+            for i in range(n)])
+        if perm is not None:
+            sel = perm[sel]
+        xr = x[sel].reshape((n, w, b) + x.shape[1:])
+        yr = y[sel].reshape((n, w, b) + y.shape[1:])
+        yield (np.ascontiguousarray(np.moveaxis(xr, 0, 1)),
+               np.ascontiguousarray(np.moveaxis(yr, 0, 1)))
+
+
+def prefetch_to_device(iterator: Iterator, shardings, buffer_size: int = 2):
+    """Wrap an iterator of array tuples, keeping ``buffer_size`` elements
+    in flight on device.
+
+    ``jax.device_put`` returns immediately (transfers run on a background
+    stream), so enqueueing the next round before the current one is consumed
+    overlaps host→HBM copies with device compute — the classic flax
+    ``prefetch_to_device`` pattern, generalized to explicit shardings.
+    """
+    queue = collections.deque()
+
+    def enqueue(k):
+        for _ in range(k):
+            try:
+                item = next(iterator)
+            except StopIteration:
+                return
+            queue.append(tuple(
+                jax.device_put(a, s) for a, s in zip(item, shardings)))
+
+    enqueue(buffer_size)
+    while queue:
+        yield queue.popleft()
+        enqueue(1)

@@ -79,6 +79,7 @@ class SPMDEngine:
         self.optimizer = opt_lib.get_optimizer(worker_optimizer, learning_rate)
         self.tx = None  # built in init_state (needs params for masking)
         self._epoch_fn = None
+        self._round_step = None
 
     # -- state --------------------------------------------------------------
     def init_state(self, rng, input_shape, initial_params=None) -> DistState:
@@ -194,33 +195,41 @@ class SPMDEngine:
         return round_fn
 
     # -- epoch program -------------------------------------------------------
-    def _build_epoch_fn(self) -> Callable:
-        round_fn = self._make_round_fn()
-        mesh = self.mesh
-        shmapped = jax.shard_map(
-            round_fn,
-            mesh=mesh,
+    def _shmapped_round(self) -> Callable:
+        """The single shard_map'd round program — the one contract both the
+        scanned epoch and the streaming path execute."""
+        return jax.shard_map(
+            self._make_round_fn(),
+            mesh=self.mesh,
             in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(),
                       P(None, WORKER_AXIS), P(None, WORKER_AXIS),
                       P(WORKER_AXIS)),
             out_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
         )
 
+    @staticmethod
+    def _run_round(shmapped, state: DistState, x, y, rngs):
+        """One round: fold the per-worker keys with the round clock, execute,
+        re-wrap the state (shared by epoch scan and streaming)."""
+        keys = jax.vmap(
+            lambda k: jax.random.fold_in(k, state.round_idx))(rngs)
+        center, local, opt_state, loss = shmapped(
+            state.center, state.local, state.opt_state, state.round_idx,
+            x, y, keys)
+        return (DistState(center, local, opt_state, state.round_idx + 1),
+                loss)
+
+    def _build_epoch_fn(self) -> Callable:
+        shmapped = self._shmapped_round()
+
         def epoch(state: DistState, xb, yb, rngs):
             # xb, yb: (rounds, window, workers, batch, ...) sharded on axis 2
-            def body(carry, inp):
-                center, local, opt_state, ridx, keys = carry
-                x, y = inp
-                next_keys = jax.vmap(
-                    lambda k: jax.random.fold_in(k, ridx))(keys)
-                center, local, opt_state, loss = shmapped(
-                    center, local, opt_state, ridx, x, y, next_keys)
-                return (center, local, opt_state, ridx + 1, keys), loss
+            def body(st, inp):
+                st, loss = self._run_round(shmapped, st, inp[0], inp[1],
+                                           rngs)
+                return st, loss
 
-            (center, local, opt_state, ridx, _), losses = jax.lax.scan(
-                body, (state.center, state.local, state.opt_state,
-                       state.round_idx, rngs), (xb, yb))
-            return DistState(center, local, opt_state, ridx), losses
+            return jax.lax.scan(body, state, (xb, yb))
 
         return jax.jit(epoch, donate_argnums=(0,))
 
@@ -234,6 +243,35 @@ class SPMDEngine:
         yb = jax.device_put(yb, sh)
         state, losses = self._epoch_fn(state, xb, yb, rngs)
         return state, losses
+
+    # -- streaming epoch (datasets larger than HBM) ---------------------------
+    def _build_round_step(self) -> Callable:
+        shmapped = self._shmapped_round()
+
+        def step(state: DistState, x, y, rngs):
+            return self._run_round(shmapped, state, x, y, rngs)
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def run_epoch_streaming(self, state: DistState, round_iter, rngs
+                            ) -> Tuple[DistState, np.ndarray]:
+        """Run an epoch from a generator of per-round host arrays shaped
+        (window, workers, batch, ...) (see ``data.pipeline.round_stream``),
+        double-buffered onto the mesh.  Same math as ``run_epoch`` — one jit
+        call per round instead of one per epoch — for datasets that cannot
+        live in HBM whole.
+        """
+        from ..data.pipeline import prefetch_to_device
+        if self._round_step is None:
+            self._round_step = self._build_round_step()
+        sh = NamedSharding(self.mesh, P(None, WORKER_AXIS))
+        losses = []
+        for xb, yb in prefetch_to_device(round_iter, (sh, sh)):
+            state, loss = self._round_step(state, xb, yb, rngs)
+            losses.append(loss)
+        # one device→host transfer for the whole epoch, f32 like run_epoch
+        return state, np.asarray(jax.device_get(jnp.stack(losses)),
+                                 dtype=np.float32)
 
     def worker_rngs(self, seed: int):
         keys = jax.random.split(jax.random.PRNGKey(seed), self.num_workers)

@@ -43,7 +43,7 @@ class ParallelTransformerLM:
                  mesh: Mesh, *, moe_layers: Tuple[int, ...] = (),
                  num_experts: Optional[int] = None,
                  capacity_factor: float = 2.0,
-                 compute_dtype=jnp.bfloat16,
+                 compute_dtype=jnp.bfloat16, remat: bool = False,
                  data_axis: str = "data", seq_axis: str = "seq",
                  model_axis: str = "model"):
         self.vocab_size = vocab_size
@@ -56,6 +56,7 @@ class ParallelTransformerLM:
         self.moe_layers = tuple(moe_layers)
         self.capacity_factor = capacity_factor
         self.compute_dtype = compute_dtype
+        self.remat = bool(remat)
         self.axes = (data_axis, seq_axis, model_axis)
         self.tp = mesh.shape[model_axis]
         self.sp = mesh.shape[seq_axis]
@@ -171,26 +172,34 @@ class ParallelTransformerLM:
             return ((h32 - mu) * jax.lax.rsqrt(var + 1e-5)
                     * scale).astype(cdt)
 
+        def block(i):
+            def body(x, lp):
+                h = ln(lp["ln1"], x)
+                attn = tp_self_attention(
+                    h, lp["wq"], lp["wk"], lp["wv"], lp["wo"],
+                    num_local_heads=self.num_heads // self.tp,
+                    head_dim=self.head_dim, axis_name=model_axis,
+                    seq_axis=seq_axis, causal=True, compute_dtype=cdt)
+                x = x + attn.astype(cdt)
+                h = ln(lp["ln2"], x)
+                if i in self.moe_layers:
+                    # token slices route per model shard and all_gather back
+                    # inside moe_mlp (value-replicated over 'model')
+                    y = moe_mlp(h, lp["router"], lp["w1"], lp["b1"],
+                                lp["w2"], lp["b2"], axis_name=model_axis,
+                                capacity_factor=self.capacity_factor,
+                                compute_dtype=cdt)
+                else:
+                    y = tp_mlp(h, lp["w1"], lp["b1"], lp["w2"], lp["b2"],
+                               axis_name=model_axis, compute_dtype=cdt)
+                return x + y.astype(cdt)
+
+            # remat: recompute block activations in the backward pass instead
+            # of keeping them in HBM — the long-context memory/FLOPs trade
+            return jax.checkpoint(body) if self.remat else body
+
         for i, lp in enumerate(params["layers"]):
-            h = ln(lp["ln1"], x)
-            attn = tp_self_attention(
-                h, lp["wq"], lp["wk"], lp["wv"], lp["wo"],
-                num_local_heads=self.num_heads // self.tp,
-                head_dim=self.head_dim, axis_name=model_axis,
-                seq_axis=seq_axis, causal=True, compute_dtype=cdt)
-            x = x + attn.astype(cdt)
-            h = ln(lp["ln2"], x)
-            if i in self.moe_layers:
-                # token slices are routed per model shard and psum-reunited
-                # inside moe_mlp, so y comes back replicated over 'model'
-                y = moe_mlp(h, lp["router"], lp["w1"], lp["b1"], lp["w2"],
-                            lp["b2"], axis_name=model_axis,
-                            capacity_factor=self.capacity_factor,
-                            compute_dtype=cdt)
-            else:
-                y = tp_mlp(h, lp["w1"], lp["b1"], lp["w2"], lp["b2"],
-                           axis_name=model_axis, compute_dtype=cdt)
-            x = x + y.astype(cdt)
+            x = block(i)(x, lp)
 
         x = ln(params["ln_f"], x)
         return jax.lax.dot_general(

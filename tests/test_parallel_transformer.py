@@ -77,6 +77,13 @@ def test_params_are_sharded(eight_devices):
     assert params["embed"].addressable_shards[0].data.shape == (32, 16)
 
 
+def test_remat_matches_no_remat(eight_devices):
+    """jax.checkpoint per block changes memory, not math: identical losses."""
+    plain, _ = run_steps(make_lm(mesh_of((2, 2, 2))), 3)
+    remat, _ = run_steps(make_lm(mesh_of((2, 2, 2)), remat=True), 3)
+    np.testing.assert_allclose(plain, remat, rtol=1e-6)
+
+
 def test_validation_errors():
     mesh = mesh_of((2, 2, 2))
     with pytest.raises(ValueError, match="num_heads"):
