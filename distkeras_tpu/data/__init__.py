@@ -3,13 +3,13 @@ from .transformers import (Transformer, MinMaxTransformer,
                            StandardScaleTransformer, DenseTransformer,
                            ReshapeTransformer, OneHotTransformer,
                            LabelIndexTransformer, LabelVectorTransformerUDF)
-from .datasets import load_mnist, load_cifar10, load_atlas_higgs
+from .datasets import load_mnist, load_cifar10, load_atlas_higgs, read_csv
 from .pipeline import round_stream, prefetch_to_device
 
 __all__ = [
     "Dataset", "Transformer", "MinMaxTransformer", "StandardScaleTransformer",
     "DenseTransformer", "ReshapeTransformer", "OneHotTransformer",
     "LabelIndexTransformer", "LabelVectorTransformerUDF",
-    "load_mnist", "load_cifar10", "load_atlas_higgs",
+    "load_mnist", "load_cifar10", "load_atlas_higgs", "read_csv",
     "round_stream", "prefetch_to_device",
 ]

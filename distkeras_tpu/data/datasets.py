@@ -144,3 +144,32 @@ def load_atlas_higgs(n_train: int = 200_000, n_test: int = 50_000,
         xte, yte = make(n_test, seed + 1)
     return (Dataset({"features": xtr, "label": ytr}),
             Dataset({"features": xte, "label": yte}))
+
+
+def read_csv(path: str, label_column: str,
+             feature_columns: Optional[list] = None,
+             delimiter: str = ",") -> Dataset:
+    """Read a headered CSV into a Dataset (reference workflow parity:
+    ``examples/workflow.ipynb`` reads the ATLAS Higgs CSV through Spark and
+    assembles named columns into a features vector).
+
+    ``feature_columns`` defaults to every column except the label, in file
+    order.  Features come back as one float32 ``features`` matrix and the
+    label as an int64 ``label`` column — ready for the transformer pipeline.
+    """
+    data = np.atleast_1d(np.genfromtxt(path, delimiter=delimiter, names=True,
+                                       dtype=np.float64, encoding="utf-8"))
+    names = list(data.dtype.names)
+    if label_column not in names:
+        raise ValueError(f"label column {label_column!r} not in CSV header "
+                         f"{names}")
+    if feature_columns is not None and len(feature_columns) == 0:
+        raise ValueError("feature_columns is empty")
+    feats = (feature_columns if feature_columns is not None
+             else [n for n in names if n != label_column])
+    missing = [c for c in feats if c not in names]
+    if missing:
+        raise ValueError(f"feature columns {missing} not in CSV header")
+    x = np.stack([data[c] for c in feats], axis=1).astype(np.float32)
+    y = data[label_column].astype(np.int64)
+    return Dataset({"features": x, "label": y})

@@ -52,12 +52,26 @@ except ImportError:  # pragma: no cover - depends on build environment
 # structure encoding
 # ---------------------------------------------------------------------------
 
+def _dtype_str(dt: np.dtype) -> str:
+    """Wire name for a dtype.  ml_dtypes types (bfloat16 & friends) print as
+    opaque void strs ('<V2'), so ship their registered *name* instead."""
+    return dt.name if dt.str.lstrip("<>|=").startswith("V") else dt.str
+
+
+def _dtype_of(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registers bfloat16/float8 etc. with numpy
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def _encode_node(obj: Any, buffers: List[np.ndarray]):
     """Recursively replace ndarray leaves with buffer descriptors."""
     if isinstance(obj, np.ndarray):
         idx = len(buffers)
         buffers.append(np.ascontiguousarray(obj))
-        return {"__nd__": idx, "dtype": obj.dtype.str,
+        return {"__nd__": idx, "dtype": _dtype_str(obj.dtype),
                 "shape": list(obj.shape)}
     if isinstance(obj, (np.integer,)):
         return int(obj)
@@ -79,7 +93,7 @@ def _decode_node(node: Any, buffers: List[bytes]):
     if isinstance(node, dict):
         if "__nd__" in node:
             arr = np.frombuffer(buffers[node["__nd__"]],
-                                dtype=np.dtype(node["dtype"]))
+                                dtype=_dtype_of(node["dtype"]))
             return arr.reshape(node["shape"]).copy()
         if "__dict__" in node:
             return {k: _decode_node(v, buffers)
@@ -113,7 +127,7 @@ def _expected_buffer_sizes(tree: Any, out: dict):
     so buffer lengths on the wire can be validated *before* allocation."""
     if isinstance(tree, dict):
         if "__nd__" in tree:
-            size = int(np.dtype(tree["dtype"]).itemsize)
+            size = int(_dtype_of(tree["dtype"]).itemsize)
             for d in tree["shape"]:
                 size *= int(d)
             out[int(tree["__nd__"])] = size

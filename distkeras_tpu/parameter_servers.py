@@ -32,6 +32,12 @@ from .core.model import FittedModel, deserialize_model, serialize_model
 from .workers import WORKER_CLASSES, share_compiled_state
 
 
+def _as_f32(delta):
+    """Upcast wire deltas (possibly bf16-compressed by the worker's
+    ``wire_dtype`` — see ``workers.PSWorker.commit``) to the center's f32."""
+    return [np.asarray(d).astype(np.float32, copy=False) for d in delta]
+
+
 class ParameterServer:
     """Base PS (reference: ``parameter_servers.py :: ParameterServer``):
     holds the center weights + the update clock."""
@@ -72,7 +78,7 @@ class DeltaParameterServer(ParameterServer):
     term, so the same rule applies)."""
 
     def handle_commit(self, msg):
-        delta = msg["delta"]
+        delta = _as_f32(msg["delta"])
         with self._lock:
             for c, d in zip(self.center, delta):
                 c += d
@@ -90,7 +96,7 @@ class ADAGParameterServer(ParameterServer):
         self.num_workers = max(int(num_workers), 1)
 
     def handle_commit(self, msg):
-        delta = msg["delta"]
+        delta = _as_f32(msg["delta"])
         scale = 1.0 / self.num_workers
         with self._lock:
             for c, d in zip(self.center, delta):
@@ -105,7 +111,7 @@ class DynSGDParameterServer(ParameterServer):
     ``rules.dynsgd_commit``."""
 
     def handle_commit(self, msg):
-        delta = msg["delta"]
+        delta = _as_f32(msg["delta"])
         with self._lock:
             staleness = max(self.num_updates - int(msg.get("clock", 0)), 0)
             scale = 1.0 / (staleness + 1.0)
@@ -264,7 +270,8 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False
         communication_window=trainer.communication_window,
         features_col=trainer.features_col, label_col=trainer.label_col,
         batch_size=trainer.batch_size, num_epoch=trainer.num_epoch,
-        learning_rate=trainer.learning_rate, seed=trainer.seed)
+        learning_rate=trainer.learning_rate, seed=trainer.seed,
+        wire_dtype=getattr(trainer, "wire_dtype", None))
     if worker_cls.ALGORITHM in ("aeasgd", "eamsgd"):
         kw["rho"] = getattr(trainer, "rho", 5.0)
 

@@ -180,7 +180,8 @@ class DistributedTrainer(Trainer):
                  execution: str = "spmd", mesh=None, seed: int = 0,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1,
-                 metrics_path: Optional[str] = None):
+                 metrics_path: Optional[str] = None,
+                 wire_dtype: Optional[str] = None):
         super().__init__(keras_model, loss, worker_optimizer, learning_rate,
                          seed)
         self.mesh = mesh if mesh is not None else mesh_lib.get_mesh(num_workers)
@@ -193,6 +194,9 @@ class DistributedTrainer(Trainer):
             communication_window if communication_window is not None
             else self.DEFAULT_WINDOW)
         self.execution = execution
+        # host_ps wire compression for commits (e.g. "bfloat16"); the SPMD
+        # path has no wire — deltas ride ICI inside the XLA program
+        self.wire_dtype = wire_dtype
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = max(int(checkpoint_every), 1)
         self.metrics_path = metrics_path

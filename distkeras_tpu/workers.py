@@ -157,11 +157,16 @@ class PSWorker(Worker):
     ALGORITHM = "downpour"
 
     def __init__(self, model_blob, worker_optimizer, loss, ps_host: str,
-                 ps_port: int, communication_window: int = 5, **kw):
+                 ps_port: int, communication_window: int = 5,
+                 wire_dtype: Optional[str] = None, **kw):
         super().__init__(model_blob, worker_optimizer, loss, **kw)
         self.ps_host = ps_host
         self.ps_port = ps_port
         self.window = int(communication_window)
+        # e.g. "bfloat16": halve commit bytes.  Resolved eagerly so a bad
+        # name fails at construction, not mid-training in a worker thread.
+        self.wire_dtype = (networking._dtype_of(wire_dtype)
+                           if wire_dtype is not None else None)
         self._sock: Optional[socket.socket] = None
         self._last_clock = 0
 
@@ -186,7 +191,14 @@ class PSWorker(Worker):
         return msg["weights"]
 
     def commit(self, delta: List[np.ndarray], worker_id: int):
-        """'c': push a weight-shaped delta (reference: Worker.commit)."""
+        """'c': push a weight-shaped delta (reference: Worker.commit).
+
+        With ``wire_dtype="bfloat16"`` the delta is rounded to bf16 on the
+        wire (half the DCN bytes; the PS upcasts before applying) — lossy
+        compression the reference's pickle transport had no counterpart for.
+        """
+        if self.wire_dtype is not None:
+            delta = [d.astype(self.wire_dtype) for d in delta]
         networking.send_opcode(self._sock, b"c")
         networking.send_data(self._sock, {
             "delta": delta,

@@ -83,3 +83,34 @@ def test_synthetic_datasets_learnable_structure():
     htrain, _ = load_atlas_higgs(n_train=256, n_test=64)
     assert htrain["features"].shape == (256, 28)
     assert set(np.unique(htrain["label"])) <= {0, 1}
+
+
+def test_read_csv(tmp_path):
+    p = tmp_path / "higgs.csv"
+    p.write_text("f1,f2,label,f3\n"
+                 "1.0,2.0,0,3.5\n"
+                 "4.0,5.0,1,6.5\n"
+                 "7.0,8.0,0,9.5\n")
+    from distkeras_tpu.data.datasets import read_csv
+    ds = read_csv(str(p), label_column="label")
+    assert ds["features"].shape == (3, 3)
+    np.testing.assert_allclose(ds["features"][1], [4.0, 5.0, 6.5])
+    np.testing.assert_array_equal(ds["label"], [0, 1, 0])
+
+    sub = read_csv(str(p), label_column="label", feature_columns=["f3", "f1"])
+    np.testing.assert_allclose(sub["features"][0], [3.5, 1.0])
+
+    import pytest
+    with pytest.raises(ValueError, match="label column"):
+        read_csv(str(p), label_column="nope")
+
+
+def test_read_csv_edge_cases(tmp_path):
+    import pytest
+    from distkeras_tpu.data.datasets import read_csv
+    single = tmp_path / "one.csv"
+    single.write_text("a,b,label\n1.0,2.0,1\n")
+    ds = read_csv(str(single), label_column="label")
+    assert ds["features"].shape == (1, 2)
+    with pytest.raises(ValueError, match="empty"):
+        read_csv(str(single), label_column="label", feature_columns=[])

@@ -212,3 +212,47 @@ def test_host_ps_rejects_non_ps_trainer():
                          label_col="label_encoded", execution="host_ps")
     with pytest.raises(ValueError, match="host_ps"):
         t.train(ds)
+
+
+def test_wire_dtype_bfloat16_roundtrip():
+    """bf16 ndarrays survive the codec (ml_dtypes name-based dtype wire)."""
+    import ml_dtypes
+    a = np.arange(6, dtype=np.float32).reshape(2, 3).astype(ml_dtypes.bfloat16)
+    out = networking.decode_message(networking.encode_message({"d": a}))
+    assert out["d"].dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(out["d"].astype(np.float32),
+                                  a.astype(np.float32))
+
+
+def test_host_ps_bf16_wire_compression_learns():
+    """ADAG over host_ps with bf16-compressed commits still trains, and the
+    PS center stays f32."""
+    ds = make_dataset()
+    t = ADAG(make_model(), num_workers=2, batch_size=32, num_epoch=2,
+             communication_window=4, label_col="label_encoded",
+             learning_rate=0.1, execution="host_ps", wire_dtype="bfloat16")
+    fitted = t.train(ds)
+    preds = fitted.predict(ds["features"][:256])
+    acc = float(np.mean(np.argmax(preds, axis=1) == ds["label"][:256]))
+    assert acc > 0.6, acc
+    assert all(w.dtype == np.float32 for w in fitted.get_weights())
+
+
+def test_wire_dtype_resolves_eagerly():
+    """float16 (numpy-native) and bad names resolve/fail at construction."""
+    from distkeras_tpu.workers import DOWNPOURWorker
+    import pytest
+    blob = {"model": "{}", "weights": []}
+    w = DOWNPOURWorker.__new__(DOWNPOURWorker)  # bypass model deserialization
+    # constructor path: use the real init with a stub blob via PSWorker args
+    from distkeras_tpu.core.model import Sequential, serialize_model
+    from distkeras_tpu.core.layers import Dense
+    import jax
+    m = Sequential([Dense(2)], input_shape=(3,), compute_dtype="float32")
+    blob = serialize_model(m, m.init(jax.random.PRNGKey(0)))
+    wk = DOWNPOURWorker(blob, "sgd", "mse", "127.0.0.1", 1,
+                        wire_dtype="float16")
+    assert wk.wire_dtype == np.dtype(np.float16)
+    with pytest.raises((TypeError, AttributeError)):
+        DOWNPOURWorker(blob, "sgd", "mse", "127.0.0.1", 1,
+                       wire_dtype="not_a_dtype")
