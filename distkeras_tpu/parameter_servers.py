@@ -134,7 +134,10 @@ class SocketParameterServer:
         self.host = host
         self.port = port  # 0 → ephemeral; real port set by start()
         self._server: Optional[socket.socket] = None
-        self._threads: List[threading.Thread] = []
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conn_threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._state_lock = threading.Lock()  # guards _conns/_conn_threads/_running
         self._running = False
 
     # -- lifecycle (reference: initialize/start/stop) ------------------------
@@ -146,19 +149,49 @@ class SocketParameterServer:
         self.port = self._server.getsockname()[1]
         self._server.listen(128)
         self._running = True
-        t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name="dkt-ps-accept")
-        t.start()
-        self._threads.append(t)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="dkt-ps-accept")
+        self._accept_thread.start()
 
     def stop(self):
-        self._running = False
+        """Idempotent shutdown that actually unblocks every thread.
+
+        Closing an fd from another thread does not reliably interrupt a
+        blocked ``accept()`` on Linux, so we wake the accept loop with a
+        self-connection, join it, then ``shutdown(SHUT_RDWR)`` every accepted
+        connection to kick handler threads out of ``recv`` before joining
+        them.
+        """
+        with self._state_lock:
+            was_running = self._running
+            self._running = False
+        if was_running and self._server is not None:
+            try:  # wake the blocked accept(); loop sees _running=False
+                wake = socket.create_connection((self.host, self.port),
+                                                timeout=1.0)
+                wake.close()
+            except OSError:
+                pass  # server socket already dead — accept has returned
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
         if self._server is not None:
             try:
                 self._server.close()
             except OSError:
                 pass
-        for t in self._threads[1:]:
+        with self._state_lock:
+            conns, threads = list(self._conns), list(self._conn_threads)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in threads:
             t.join(timeout=5.0)
 
     def get_model(self) -> FittedModel:
@@ -166,16 +199,25 @@ class SocketParameterServer:
 
     # -- service loops -------------------------------------------------------
     def _accept_loop(self):
-        while self._running:
+        while True:
             try:
                 conn, _ = self._server.accept()
             except OSError:
                 return  # socket closed by stop()
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            t = threading.Thread(target=self._handle_connection, args=(conn,),
-                                 daemon=True, name="dkt-ps-conn")
+            with self._state_lock:
+                if not self._running:  # stop()'s wake connection, or late join
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                t = threading.Thread(
+                    target=self._handle_connection, args=(conn,),
+                    daemon=True, name="dkt-ps-conn")
+                self._conns.append(conn)
+                self._conn_threads.append(t)
             t.start()
-            self._threads.append(t)
 
     def _handle_connection(self, conn: socket.socket):
         """Reference: ``handle_connection`` — loop on 1-byte actions until
@@ -206,6 +248,12 @@ class SocketParameterServer:
                 conn.close()
             except OSError:
                 pass
+            me = threading.current_thread()
+            with self._state_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+                if me in self._conn_threads:
+                    self._conn_threads.remove(me)
 
 
 PS_CLASSES = {

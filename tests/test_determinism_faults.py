@@ -123,8 +123,32 @@ def test_ps_concurrent_commits_all_land():
 
 def test_stop_is_idempotent_and_unblocks():
     ps, server = _start_ps()
-    accept_thread = server._threads[0]
+    accept_thread = server._accept_thread
     server.stop()
     server.stop()  # second stop must not raise
     accept_thread.join(timeout=5.0)
     assert not accept_thread.is_alive()
+
+
+def test_stop_unblocks_idle_connected_handlers():
+    """stop() must not hang or leak when workers are connected but idle
+    (handler threads blocked in recv) — the round-1 flaky failure mode."""
+    ps, server = _start_ps()
+    conns = [networking.connect("127.0.0.1", server.port) for _ in range(3)]
+    try:
+        # let the accept loop register all three handler threads
+        import time
+        deadline = time.time() + 5.0
+        while len(server._conn_threads) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        threads = list(server._conn_threads)
+        assert len(threads) == 3
+        t0 = time.time()
+        server.stop()
+        assert time.time() - t0 < 5.0  # no per-thread join timeout burn
+        for t in threads:
+            assert not t.is_alive()
+    finally:
+        server.stop()
+        for c in conns:
+            c.close()
