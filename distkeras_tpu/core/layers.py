@@ -401,10 +401,13 @@ class Dropout(Layer):
 class BatchNormalization(Layer):
     """Batch norm with functional running stats.
 
-    The running (mean, var) live in the params pytree under ``"stats"`` and are
-    updated *outside* apply by the train step (returned as aux) so apply stays
-    pure.  For simplicity in v1 the train path normalizes with batch statistics
-    and the eval path with stored stats.
+    The running (mean, var) live in the params pytree under ``"stats"``.
+    Apply stays pure: in train mode the layer normalizes with *batch*
+    statistics and, through ``apply_with_stats``, returns the EMA-updated
+    running stats as aux; the train step merges them back into the params
+    pytree after the optimizer update (``Sequential.apply(..., stats_out=)``
+    collects them, ``model.merge_stats`` writes them).  The optimizer masks
+    the ``"stats"`` subtree out, so stats are carried, never trained.
     """
 
     def __init__(self, momentum: float = 0.99, epsilon: float = 1e-3):
@@ -424,19 +427,36 @@ class BatchNormalization(Layer):
         }
         return params, tuple(in_shape)
 
-    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
-              rng=None):
+    def _norm(self, params, x, train: bool):
+        """Returns (y, new_stats); new_stats is None in eval mode."""
         x32 = x.astype(jnp.float32)
+        new_stats = None
         if train:
             axes = tuple(range(x.ndim - 1))
             mean = jnp.mean(x32, axis=axes)
             var = jnp.var(x32, axis=axes)
+            m = self.momentum
+            new_stats = jax.lax.stop_gradient({
+                "mean": m * params["stats"]["mean"] + (1.0 - m) * mean,
+                "var": m * params["stats"]["var"] + (1.0 - m) * var,
+            })
         else:
             mean = params["stats"]["mean"]
             var = params["stats"]["var"]
         y = (x32 - mean) * jax.lax.rsqrt(var + self.epsilon)
         y = y * params["scale"] + params["offset"]
-        return y.astype(x.dtype)
+        return y.astype(x.dtype), new_stats
+
+    def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
+              rng=None):
+        return self._norm(params, x, train)[0]
+
+    def apply_with_stats(self, params, x, *, compute_dtype=jnp.bfloat16,
+                         rng=None):
+        """Train-mode forward that also returns the EMA-updated running
+        stats (keras semantics: moving = momentum·moving + (1−momentum)·batch,
+        biased batch variance)."""
+        return self._norm(params, x, True)
 
 
 class LayerNormalization(Layer):

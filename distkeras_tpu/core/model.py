@@ -61,16 +61,44 @@ class Sequential:
         self.output_shape = shape
         return params
 
-    def apply(self, params: Params, x, *, train: bool = False, rng=None):
-        """Pure forward pass. Safe to jit / grad / vmap / shard_map."""
+    def apply(self, params: Params, x, *, train: bool = False, rng=None,
+              stats_out: Optional[dict] = None):
+        """Pure forward pass. Safe to jit / grad / vmap / shard_map.
+
+        ``stats_out``: optional dict filled (at trace time) with
+        ``{layer_index: new_stats}`` for stat-carrying layers (BatchNorm) when
+        ``train=True`` — the train step merges these back into params via
+        ``merge_stats`` after the optimizer update.
+        """
         cdtype = self._cdtype
         for i, layer in enumerate(self.layers):
             sub = None
             if rng is not None:
                 rng, sub = jax.random.split(rng)
-            x = layer.apply(params[i], x, compute_dtype=cdtype, train=train,
-                            rng=sub)
+            if (train and stats_out is not None
+                    and hasattr(layer, "apply_with_stats")):
+                x, new_stats = layer.apply_with_stats(
+                    params[i], x, compute_dtype=cdtype, rng=sub)
+                stats_out[i] = new_stats
+            else:
+                x = layer.apply(params[i], x, compute_dtype=cdtype,
+                                train=train, rng=sub)
         return x
+
+    @staticmethod
+    def merge_stats(params: Params, stats: dict) -> Params:
+        """Write ``{layer_index: new_stats}`` (from ``apply(stats_out=...)``)
+        into a params pytree, leaving trained leaves untouched."""
+        if not stats:
+            return params
+        out = list(params)
+        for i, s in stats.items():
+            out[i] = {**out[i], "stats": s}
+        return out
+
+    def has_stats(self) -> bool:
+        return any(hasattr(layer, "apply_with_stats")
+                   for layer in self.layers)
 
     def __call__(self, params, x, **kw):
         return self.apply(params, x, **kw)

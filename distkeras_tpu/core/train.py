@@ -31,11 +31,15 @@ class TrainState(NamedTuple):
 
 
 def make_loss_fn(model: Sequential, loss) -> Callable:
+    """(params, x, y, rng) -> (loss, stats_aux) — stats_aux is the
+    ``{layer_index: new_stats}`` dict of EMA-updated BatchNorm running stats
+    (empty for stat-free models)."""
     loss_fn = get_loss(loss)
 
     def compute(params, x, y, rng):
-        pred = model.apply(params, x, train=True, rng=rng)
-        return loss_fn(y, pred)
+        stats: dict = {}
+        pred = model.apply(params, x, train=True, rng=rng, stats_out=stats)
+        return loss_fn(y, pred), stats
 
     return compute
 
@@ -47,9 +51,11 @@ def make_train_step(model: Sequential, loss, tx: optax.GradientTransformation,
 
     def step(state: TrainState, batch, rng) -> Tuple[TrainState, jnp.ndarray]:
         x, y = batch
-        loss_val, grads = jax.value_and_grad(compute)(state.params, x, y, rng)
+        (loss_val, stats), grads = jax.value_and_grad(compute, has_aux=True)(
+            state.params, x, y, rng)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
+        params = Sequential.merge_stats(params, stats)
         return TrainState(params, opt_state, state.step + 1), loss_val
 
     return step

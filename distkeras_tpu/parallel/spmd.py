@@ -113,23 +113,45 @@ class SPMDEngine:
     # -- the per-round SPMD body ---------------------------------------------
     def _local_window(self, params, opt_state, xw, yw, rng):
         """Run ``window`` minibatch steps on one worker's shard (in-graph)."""
-
-        def loss_of(p, x, y, key):
-            pred = self.model.apply(p, x, train=True, rng=key)
-            return self.loss_fn(y, pred)
+        from ..core.train import make_loss_fn
+        loss_of = make_loss_fn(self.model, self.loss_fn)
 
         def body(carry, inp):
             p, s, key = carry
             x, y = inp
             key, sub = jax.random.split(key)
-            l, g = jax.value_and_grad(loss_of)(p, x, y, sub)
+            (l, stats), g = jax.value_and_grad(loss_of, has_aux=True)(
+                p, x, y, sub)
             upd, s = self.tx.update(g, s, p)
             p = optax.apply_updates(p, upd)
+            p = Sequential.merge_stats(p, stats)
             return (p, s, key), l
 
         (params, opt_state, _), losses = jax.lax.scan(
             body, (params, opt_state, rng), (xw, yw))
         return params, opt_state, jnp.mean(losses)
+
+    def _sync_stats(self, new_p, center):
+        """psum-mean each worker's EMA'd BatchNorm stats and write the mean
+        into both the worker params and the center, so (a) eval on the center
+        model uses real running stats and (b) the stats leaves contribute
+        exactly zero to every delta/elastic exchange below (worker == center
+        ⇒ tree_sub is 0 there, and each commit rule adds 0)."""
+        n = self.num_workers
+        out_p, out_c = [], []
+        for p, c in zip(new_p, center):
+            if isinstance(p, dict) and "stats" in p:
+                mean = tmap(lambda v: jax.lax.psum(v, WORKER_AXIS) / n,
+                            p["stats"])
+                # worker-side copy must stay device-varying for the
+                # P(WORKER_AXIS) out_spec; the center copy stays unvarying
+                p = {**p, "stats": tmap(
+                    lambda v: jax.lax.pcast(v, WORKER_AXIS, to="varying"),
+                    mean)}
+                c = {**c, "stats": mean}
+            out_p.append(p)
+            out_c.append(c)
+        return out_p, out_c
 
     def _make_round_fn(self) -> Callable:
         n = self.num_workers
@@ -138,12 +160,15 @@ class SPMDEngine:
 
         def round_fn(center, local, opt_state, round_idx, xw, yw, rngs):
             # Block shapes inside shard_map: local/opt_state leaves and the
-            # batch data carry a leading worker axis of size 1 — squeeze it.
+            # rng carry a leading worker axis of size 1; the batch data is
+            # (window, workers=1, batch, ...) — squeeze the *worker* axis in
+            # each (xw[:, 0], NOT xw[0]: that would squeeze the window axis
+            # and silently train on only the first batch of every window).
             squeeze = lambda t: tmap(lambda v: v[0], t)
             local_p = squeeze(local)
             opt_s = squeeze(opt_state)
-            x = xw[0]
-            y = yw[0]
+            x = xw[:, 0]
+            y = yw[:, 0]
             rng = rngs[0]
 
             if algo in ("adag", "downpour", "dynsgd"):
@@ -155,6 +180,9 @@ class SPMDEngine:
             else:  # EASGD family + 'local' keep persistent local params
                 start = local_p
             new_p, new_s, loss = self._local_window(start, opt_s, x, y, rng)
+            if algo != "local" and self.model.has_stats():
+                # 'local' = independent training: per-worker stats persist
+                new_p, center = self._sync_stats(new_p, center)
 
             if algo == "adag":
                 delta = rules.tree_sub(new_p, center)

@@ -29,7 +29,7 @@ import optax
 
 from .core import optimizers as opt_lib
 from .core.model import Sequential, deserialize_model
-from .core.losses import get_loss
+from .core.train import make_loss_fn
 from . import networking
 
 
@@ -72,21 +72,19 @@ class Worker:
         if self._window_fn is not None:
             return self._window_fn
         model = self._ensure_model()
-        loss_fn = get_loss(self.loss)
         tx = self._tx
-
-        def loss_of(p, x, y, key):
-            pred = model.apply(p, x, train=True, rng=key)
-            return loss_fn(y, pred)
+        loss_of = make_loss_fn(model, self.loss)
 
         def window(params, opt_state, xw, yw, rng):
             def body(carry, inp):
                 p, s, key = carry
                 x, y = inp
                 key, sub = jax.random.split(key)
-                l, g = jax.value_and_grad(loss_of)(p, x, y, sub)
+                (l, stats), g = jax.value_and_grad(loss_of, has_aux=True)(
+                    p, x, y, sub)
                 upd, s = tx.update(g, s, p)
                 p = optax.apply_updates(p, upd)
+                p = Sequential.merge_stats(p, stats)
                 return (p, s, key), l
 
             (params, opt_state, _), losses = jax.lax.scan(

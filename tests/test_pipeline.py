@@ -82,3 +82,23 @@ def test_streamed_epoch_with_shuffle_differs_but_learns(eight_devices):
             st, round_stream(x, y, 8, 4, 8, shuffle_seed=epoch), rngs)
         all_losses.extend(losses.tolist())
     assert all_losses[-1] < all_losses[0]
+
+
+def test_round_consumes_every_window_batch(eight_devices):
+    """Regression for the round-fn axis bug (round 3): the per-worker window
+    scan must run ``window`` optimizer steps per round — squeezing the wrong
+    axis of the (window, workers, batch) block trained on only the first
+    batch of every window and silently discarded the rest."""
+    mesh = get_mesh(8)
+    eng = SPMDEngine(make_model(), "categorical_crossentropy", "adam", mesh,
+                     "adag", communication_window=4, learning_rate=1e-3)
+    state = eng.init_state(jax.random.PRNGKey(0), (16,))
+    ds = make_dataset(n=2048)
+    xb, yb, rounds = shape_epoch_data(
+        np.asarray(ds["features"]), np.asarray(ds["label_encoded"]), 8, 4, 16)
+    state, _ = eng.run_epoch(state, xb, yb, eng.worker_rngs(0))
+    counts = [np.asarray(l) for l in jax.tree_util.tree_leaves(state.opt_state)
+              if np.asarray(l).dtype == np.int32 and np.asarray(l).ndim == 1]
+    assert counts, "adam opt state should carry per-worker step counts"
+    for c in counts:
+        np.testing.assert_array_equal(c, rounds * 4)
