@@ -88,3 +88,14 @@ def get_loss(name):
     except KeyError:
         raise ValueError(
             f"Unknown loss {name!r}; known: {sorted(_LOSSES)}") from None
+
+
+def per_example(loss_fn):
+    """Lift any mean-reducing loss to per-example form: vmap it over
+    singleton batches, giving a (batch,) vector of losses.  Works for custom
+    callables too, so the padding/masking path (``shape_epoch_data`` pads the
+    tail round; padded rows get weight 0) needs no per-loss rewrites."""
+    def fn(y_true, y_pred):
+        return jax.vmap(lambda yt, yp: loss_fn(yt[None], yp[None]))(
+            y_true, y_pred)
+    return fn

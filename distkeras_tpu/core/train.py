@@ -16,9 +16,10 @@ from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
-from .losses import get_loss
+from .losses import get_loss, per_example
 from .model import Sequential
 from . import optimizers as opt_lib
 
@@ -44,6 +45,25 @@ def make_loss_fn(model: Sequential, loss) -> Callable:
     return compute
 
 
+def make_masked_loss_fn(model: Sequential, loss) -> Callable:
+    """(params, x, y, w, rng) -> (masked-mean loss, stats_aux).
+
+    ``w`` is a per-example weight vector (1 real, 0 padding): the loss is
+    Σ wᵢ·lossᵢ / max(Σ w, 1), so padded examples contribute exactly zero to
+    value and gradient (``shape_epoch_data`` pads the tail round by wrapping
+    real rows, keeping BatchNorm batch statistics sane)."""
+    per_ex = per_example(get_loss(loss))
+
+    def compute(params, x, y, w, rng):
+        stats: dict = {}
+        pred = model.apply(params, x, train=True, rng=rng, stats_out=stats)
+        losses = per_ex(y, pred)
+        w = w.astype(jnp.float32)
+        return jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0), stats
+
+    return compute
+
+
 def make_train_step(model: Sequential, loss, tx: optax.GradientTransformation,
                     ) -> Callable:
     """Single-device SGD step: grad + optax update. Pure; jit at call site."""
@@ -62,24 +82,49 @@ def make_train_step(model: Sequential, loss, tx: optax.GradientTransformation,
 
 
 def make_epoch_runner(model: Sequential, loss, tx) -> Callable:
-    """Scan a stacked batch array through train steps inside one XLA program.
+    """Scan stacked batch arrays through train steps inside one XLA program.
 
-    ``xs`` has shape (num_batches, batch, ...) for both features and labels.
-    Returns (state, per-batch losses).
+    ``xb``/``yb``/``mb`` have shape (num_batches, batch, ...); ``mb`` is the
+    per-example real/padding mask (``batch_epoch_data``) so the tail batch
+    is padded+masked instead of dropped.  Returns (state, per-batch losses);
+    each loss is the exact mean over that batch's real examples.
     """
-    step = make_train_step(model, loss, tx)
+    compute = make_masked_loss_fn(model, loss)
 
-    def epoch(state: TrainState, xb, yb, rng):
+    def epoch(state: TrainState, xb, yb, mb, rng):
         def body(carry, inp):
             st, key = carry
+            x, y, w = inp
             key, sub = jax.random.split(key)
-            st, l = step(st, (inp[0], inp[1]), sub)
+            (l, stats), grads = jax.value_and_grad(compute, has_aux=True)(
+                st.params, x, y, w, sub)
+            updates, opt_state = tx.update(grads, st.opt_state, st.params)
+            params = optax.apply_updates(st.params, updates)
+            params = Sequential.merge_stats(params, stats)
+            st = TrainState(params, opt_state, st.step + 1)
             return (st, key), l
 
-        (state, _), losses = jax.lax.scan(body, (state, rng), (xb, yb))
+        (state, _), losses = jax.lax.scan(body, (state, rng), (xb, yb, mb))
         return state, losses
 
     return jax.jit(epoch)
+
+
+def batch_epoch_data(x: np.ndarray, y: np.ndarray, batch_size: int):
+    """Stack a flat epoch into (num_batches, batch, ...) + mask, wrap-padding
+    the tail batch instead of dropping it (single-device analogue of
+    ``parallel.spmd.shape_epoch_data``)."""
+    n_rows = len(x)
+    if n_rows == 0:
+        raise ValueError("empty dataset")
+    nb = -(-n_rows // batch_size)  # ceil: pad up, never drop
+    rows = nb * batch_size
+    idx = np.arange(rows) % n_rows
+    mask = (np.arange(rows) < n_rows).astype(np.float32)
+    shape = (nb, batch_size)
+    return (np.asarray(x)[idx].reshape(shape + x.shape[1:]),
+            np.asarray(y)[idx].reshape(shape + y.shape[1:]),
+            mask.reshape(shape), nb)
 
 
 def init_state(model: Sequential, rng, input_shape, optimizer,

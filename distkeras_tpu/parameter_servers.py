@@ -305,11 +305,16 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False
     server = SocketParameterServer(ps)
     server.start()
 
-    # shard rows contiguously per worker (Spark repartition analogue)
+    # deal rows round-robin per worker (Spark round-robin repartition
+    # analogue): every row lands on exactly one worker, nothing dropped;
+    # shard sizes differ by at most one row and the workers' own
+    # window-padding absorbs the raggedness (one shared compilation)
     n = trainer.num_workers
-    rows = (len(x) // n) * n
-    xs = x[:rows].reshape((n, rows // n) + x.shape[1:])
-    ys = y[:rows].reshape((n, rows // n) + y.shape[1:])
+    if len(x) < n:
+        raise ValueError(
+            f"dataset of {len(x)} rows has fewer rows than workers ({n})")
+    xs = [x[i::n] for i in range(n)]
+    ys = [y[i::n] for i in range(n)]
 
     worker_cls = WORKER_CLASSES[algorithm]
     kw = dict(

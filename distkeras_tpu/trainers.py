@@ -30,7 +30,7 @@ import numpy as np
 
 from .core.model import Sequential, FittedModel, serialize_model
 from .core import optimizers as opt_lib
-from .core.train import init_state, make_epoch_runner
+from .core.train import batch_epoch_data, init_state, make_epoch_runner
 from .data.dataset import Dataset
 from .parallel import mesh as mesh_lib
 from .parallel.spmd import SPMDEngine, DistState, shape_epoch_data
@@ -145,17 +145,11 @@ class SingleTrainer(Trainer):
                 xe, ye = ds["x"], ds["y"]
             else:
                 xe, ye = x, y
-            nb = len(xe) // self.batch_size
-            if nb == 0:
-                raise ValueError(
-                    f"batch_size {self.batch_size} exceeds dataset size "
-                    f"{len(xe)}")
-            rows = nb * self.batch_size
-            xb = xe[:rows].reshape((nb, self.batch_size) + xe.shape[1:])
-            yb = ye[:rows].reshape((nb, self.batch_size) + ye.shape[1:])
+            xb, yb, mb, nb = batch_epoch_data(np.asarray(xe), np.asarray(ye),
+                                              self.batch_size)
             rng, sub = jax.random.split(rng)
             state, losses = runner(state, jnp.asarray(xb), jnp.asarray(yb),
-                                   sub)
+                                   jnp.asarray(mb), sub)
             self.history.extend(np.asarray(losses).tolist())
         self._fitted = FittedModel(self.master_model, state.params)
         self.record_training_stop()
@@ -263,16 +257,16 @@ class DistributedTrainer(Trainer):
                     xe, ye = x[perm], y[perm]
                 else:
                     xe, ye = x, y
-                xb, yb, rounds = shape_epoch_data(xe, ye, self.num_workers,
-                                                  self.communication_window,
-                                                  self.batch_size)
+                xb, yb, mb, rounds = shape_epoch_data(
+                    xe, ye, self.num_workers, self.communication_window,
+                    self.batch_size)
                 self._state, losses = engine.run_epoch(self._state, xb, yb,
-                                                       rngs)
+                                                       mb, rngs)
                 losses = np.asarray(losses)
                 self.history.extend(losses.tolist())
-                examples = (rounds * self.communication_window
-                            * self.batch_size * self.num_workers)
-                metrics.epoch(epoch, examples, time.time() - t0,
+                # every real row trains exactly once (tail is padded+masked,
+                # not dropped), so the throughput metric counts len(xe)
+                metrics.epoch(epoch, len(xe), time.time() - t0,
                               float(losses.mean()))
                 if ckpt is not None and (
                         epoch + 1) % self.checkpoint_every == 0:

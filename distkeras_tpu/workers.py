@@ -29,7 +29,7 @@ import optax
 
 from .core import optimizers as opt_lib
 from .core.model import Sequential, deserialize_model
-from .core.train import make_loss_fn
+from .core.train import make_masked_loss_fn
 from . import networking
 
 
@@ -67,29 +67,32 @@ class Worker:
         return self._model
 
     def _build_window_fn(self):
-        """jitted (params, opt_state, xw, yw, rng) -> (params, opt_state, loss)
-        scanning a (window, batch, ...) stack of minibatches."""
+        """jitted (params, opt_state, xw, yw, mw, rng) -> (params, opt_state,
+        loss) scanning a (window, batch, ...) stack of minibatches.  ``mw``
+        is the per-example real/padding mask from ``_shard_to_windows``; the
+        returned loss is the exact mean over real examples."""
         if self._window_fn is not None:
             return self._window_fn
         model = self._ensure_model()
         tx = self._tx
-        loss_of = make_loss_fn(model, self.loss)
+        loss_of = make_masked_loss_fn(model, self.loss)
 
-        def window(params, opt_state, xw, yw, rng):
+        def window(params, opt_state, xw, yw, mw, rng):
             def body(carry, inp):
                 p, s, key = carry
-                x, y = inp
+                x, y, w = inp
                 key, sub = jax.random.split(key)
                 (l, stats), g = jax.value_and_grad(loss_of, has_aux=True)(
-                    p, x, y, sub)
+                    p, x, y, w, sub)
                 upd, s = tx.update(g, s, p)
                 p = optax.apply_updates(p, upd)
                 p = Sequential.merge_stats(p, stats)
-                return (p, s, key), l
+                return (p, s, key), (l, jnp.sum(w.astype(jnp.float32)))
 
-            (params, opt_state, _), losses = jax.lax.scan(
-                body, (params, opt_state, rng), (xw, yw))
-            return params, opt_state, jnp.mean(losses)
+            (params, opt_state, _), (losses, wsums) = jax.lax.scan(
+                body, (params, opt_state, rng), (xw, yw, mw))
+            return (params, opt_state,
+                    jnp.sum(losses * wsums) / jnp.maximum(jnp.sum(wsums), 1.0))
 
         self._window_fn = jax.jit(window)
         return self._window_fn
@@ -102,23 +105,31 @@ class Worker:
         return self._ensure_model().get_weights(params)
 
     def _shard_to_windows(self, shard: Dict[str, np.ndarray], window: int,
-                          epoch_seed: int) -> Tuple[np.ndarray, np.ndarray]:
+                          epoch_seed: int
+                          ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Shape one epoch of this worker's shard into
-        (num_windows, window, batch, ...) stacks, shuffled per epoch."""
+        (num_windows, window, batch, ...) stacks, shuffled per epoch.
+
+        The tail is wrap-padded to a whole window and masked (same zero-drop
+        contract as the SPMD path's ``shape_epoch_data``): returns
+        ``(xw, yw, mw)`` where ``mw`` is 1.0 for real rows, 0.0 for padding.
+        """
         x = np.asarray(shard[self.features_col])
         y = np.asarray(shard[self.label_col])
+        if len(x) == 0:
+            raise ValueError("worker shard is empty")
         perm = np.random.default_rng(epoch_seed).permutation(len(x))
         x, y = x[perm], y[perm]
         per_window = window * self.batch_size
-        nwin = len(x) // per_window
-        if nwin == 0:
-            raise ValueError(
-                f"worker shard of {len(x)} rows < one communication window "
-                f"({window} batches × {self.batch_size})")
+        nwin = -(-len(x) // per_window)  # ceil: pad up, never drop
         rows = nwin * per_window
-        xw = x[:rows].reshape((nwin, window, self.batch_size) + x.shape[1:])
-        yw = y[:rows].reshape((nwin, window, self.batch_size) + y.shape[1:])
-        return xw, yw
+        idx = np.arange(rows) % len(x)
+        mask = (np.arange(rows) < len(x)).astype(np.float32)
+        shape = (nwin, window, self.batch_size)
+        xw = x[idx].reshape(shape + x.shape[1:])
+        yw = y[idx].reshape(shape + y.shape[1:])
+        mw = mask.reshape(shape)
+        return xw, yw, mw
 
 
 class SequentialWorker(Worker):
@@ -133,12 +144,12 @@ class SequentialWorker(Worker):
         rng = jax.random.PRNGKey(self.seed + index)
         for epoch in range(self.num_epoch):
             # window==1: every batch is its own scan step
-            xw, yw = self._shard_to_windows(shard, 1, self.seed + epoch)
+            xw, yw, mw = self._shard_to_windows(shard, 1, self.seed + epoch)
             for i in range(len(xw)):
                 rng, sub = jax.random.split(rng)
                 params, opt_state, loss = window_fn(
                     params, opt_state, jnp.asarray(xw[i]), jnp.asarray(yw[i]),
-                    sub)
+                    jnp.asarray(mw[i]), sub)
                 self.history.append(float(loss))
         return {"weights": self._params_to_weights(params),
                 "history": self.history}
@@ -213,19 +224,19 @@ class PSWorker(Worker):
             opt_state = self._tx.init(params)
             rng = jax.random.PRNGKey(self.seed + 100 + index)
             for epoch in range(self.num_epoch):
-                xw, yw = self._shard_to_windows(
+                xw, yw, mw = self._shard_to_windows(
                     shard, self.window, self.seed + 1000 * epoch + index)
                 for i in range(len(xw)):
                     rng, sub = jax.random.split(rng)
                     params, opt_state, loss = self._window_step(
-                        window_fn, params, opt_state, xw[i], yw[i], sub,
-                        index)
+                        window_fn, params, opt_state, xw[i], yw[i], mw[i],
+                        sub, index)
                     self.history.append(float(loss))
         finally:
             self.disconnect()
         return {"history": self.history}
 
-    def _window_step(self, window_fn, params, opt_state, xw, yw, rng,
+    def _window_step(self, window_fn, params, opt_state, xw, yw, mw, rng,
                      index: int):
         raise NotImplementedError
 
@@ -235,10 +246,12 @@ class DOWNPOURWorker(PSWorker):
     commit the raw accumulated window delta, then re-pull the center."""
     ALGORITHM = "downpour"
 
-    def _window_step(self, window_fn, params, opt_state, xw, yw, rng, index):
+    def _window_step(self, window_fn, params, opt_state, xw, yw, mw, rng,
+                     index):
         before = self._params_to_weights(params)
         params, opt_state, loss = window_fn(
-            params, opt_state, jnp.asarray(xw), jnp.asarray(yw), rng)
+            params, opt_state, jnp.asarray(xw), jnp.asarray(yw),
+            jnp.asarray(mw), rng)
         after = self._params_to_weights(params)
         delta = [a - b for a, b in zip(after, before)]
         self.commit(delta, index)
@@ -274,9 +287,11 @@ class AEASGDWorker(PSWorker):
         lr = self.learning_rate if self.learning_rate is not None else 0.1
         self.alpha = self.rho * lr
 
-    def _window_step(self, window_fn, params, opt_state, xw, yw, rng, index):
+    def _window_step(self, window_fn, params, opt_state, xw, yw, mw, rng,
+                     index):
         params, opt_state, loss = window_fn(
-            params, opt_state, jnp.asarray(xw), jnp.asarray(yw), rng)
+            params, opt_state, jnp.asarray(xw), jnp.asarray(yw),
+            jnp.asarray(mw), rng)
         center = self.pull()
         local = self._params_to_weights(params)
         elastic = [self.alpha * (l - c) for l, c in zip(local, center)]

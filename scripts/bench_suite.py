@@ -41,7 +41,8 @@ def bench_single_trainer(rows):
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from distkeras_tpu.core.train import init_state, make_epoch_runner
+    from distkeras_tpu.core.train import (batch_epoch_data, init_state,
+                                          make_epoch_runner)
     from distkeras_tpu.data.datasets import load_mnist
     from distkeras_tpu.models.zoo import mnist_mlp
 
@@ -49,21 +50,20 @@ def bench_single_trainer(rows):
     train, _ = load_mnist(n_train=rows)
     x = np.asarray(train["features"], np.float32) / 255.0
     y = np.eye(10, dtype=np.float32)[np.asarray(train["label"])]
-    nb = rows // batch
-    xb = jnp.asarray(x[:nb * batch].reshape(nb, batch, -1))
-    yb = jnp.asarray(y[:nb * batch].reshape(nb, batch, -1))
+    xb, yb, mb, nb = batch_epoch_data(x, y, batch)
+    xb, yb, mb = jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb)
 
     model = mnist_mlp()
     state, tx = init_state(model, jax.random.PRNGKey(0), (784,), "adam",
                            1e-3)
     runner = make_epoch_runner(model, "categorical_crossentropy", tx)
     rng = jax.random.PRNGKey(1)
-    state, losses = runner(state, xb, yb, rng)  # compile
+    state, losses = runner(state, xb, yb, mb, rng)  # compile
     jax.block_until_ready(losses)
     reps = 0
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < 2.0 and reps < 50:
-        state, losses = runner(state, xb, yb, rng)
+        state, losses = runner(state, xb, yb, mb, rng)
         jax.block_until_ready(losses)
         reps += 1
     dt = time.perf_counter() - t0
