@@ -64,6 +64,35 @@ def make_masked_loss_fn(model: Sequential, loss) -> Callable:
     return compute
 
 
+def make_masked_step(model: Sequential, loss,
+                     tx: optax.GradientTransformation) -> Callable:
+    """The one masked minibatch step shared by all three engines
+    (``make_epoch_runner``, the SPMD window scan, the host-PS worker window).
+
+    (params, opt_state, x, y, w, rng) -> (params, opt_state, loss, wsum).
+
+    A fully-padded batch (wsum == 0) is a TRUE no-op: the masked loss gives
+    zero gradient, but e.g. Adam still moves parameters on a zero gradient
+    (decayed momentum over sqrt(v)), so the whole update — params, optimizer
+    state, BatchNorm stats merge — is gated out with ``where`` in that case.
+    """
+    compute = make_masked_loss_fn(model, loss)
+
+    def step(params, opt_state, x, y, w, rng):
+        (l, stats), grads = jax.value_and_grad(compute, has_aux=True)(
+            params, x, y, w, rng)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_params = Sequential.merge_stats(new_params, stats)
+        wsum = jnp.sum(w.astype(jnp.float32))
+        keep = wsum > 0.0
+        pick = lambda new, old: jax.tree_util.tree_map(
+            lambda a, b: jnp.where(keep, a, b), new, old)
+        return pick(new_params, params), pick(new_opt, opt_state), l, wsum
+
+    return step
+
+
 def make_train_step(model: Sequential, loss, tx: optax.GradientTransformation,
                     ) -> Callable:
     """Single-device SGD step: grad + optax update. Pure; jit at call site."""
@@ -89,18 +118,15 @@ def make_epoch_runner(model: Sequential, loss, tx) -> Callable:
     is padded+masked instead of dropped.  Returns (state, per-batch losses);
     each loss is the exact mean over that batch's real examples.
     """
-    compute = make_masked_loss_fn(model, loss)
+    step = make_masked_step(model, loss, tx)
 
     def epoch(state: TrainState, xb, yb, mb, rng):
         def body(carry, inp):
             st, key = carry
             x, y, w = inp
             key, sub = jax.random.split(key)
-            (l, stats), grads = jax.value_and_grad(compute, has_aux=True)(
-                st.params, x, y, w, sub)
-            updates, opt_state = tx.update(grads, st.opt_state, st.params)
-            params = optax.apply_updates(st.params, updates)
-            params = Sequential.merge_stats(params, stats)
+            params, opt_state, l, _ = step(st.params, st.opt_state, x, y, w,
+                                           sub)
             st = TrainState(params, opt_state, st.step + 1)
             return (st, key), l
 

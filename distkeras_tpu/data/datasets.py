@@ -25,6 +25,13 @@ _DATA_DIRS = [
 ]
 
 
+def has_real_data(name: str) -> bool:
+    """Cheap provenance check (no load): is a real ``<name>.npz`` present
+    under ``DISTKERAS_TPU_DATA`` / ``~/.distkeras_tpu/data``?"""
+    return any(d and os.path.exists(os.path.join(d, name + ".npz"))
+               for d in _DATA_DIRS)
+
+
 def _try_load_npz(name: str) -> Optional[dict]:
     for d in _DATA_DIRS:
         if not d:

@@ -20,37 +20,41 @@ import jax
 import numpy as np
 
 
-def round_layout(n_rows: int, num_workers: int, window: int,
-                 batch_size: int) -> Tuple[int, np.ndarray, np.ndarray]:
+def num_rounds(n_rows: int, num_workers: int, window: int,
+               batch_size: int) -> int:
+    """Rounds per epoch: ceil — the tail is padded up, never dropped."""
+    if n_rows == 0:
+        raise ValueError("empty dataset")
+    if n_rows < num_workers:
+        raise ValueError(
+            f"dataset of {n_rows} rows has fewer rows than workers "
+            f"({num_workers}); some workers would train on padding only")
+    return -(-n_rows // (num_workers * window * batch_size))
+
+
+def round_block(n_rows: int, num_workers: int, window: int, batch_size: int,
+                r: int) -> Tuple[np.ndarray, np.ndarray]:
     """The one source of truth for the epoch data layout, shared by
     ``shape_epoch_data`` (all-at-once) and ``round_stream`` (streaming).
 
-    Returns ``(rounds, sel, mask)`` where ``sel``/``mask`` are flat arrays of
-    length ``rounds * workers * window * batch`` in worker-major slot order
-    (slot ``s = worker_i * stride + j``, ``stride = rounds*window*batch``).
-    Real rows are dealt *round-robin* across workers (slot j of worker i
-    holds row ``j*n + i``), so the wrap-padding that fills the tail round is
-    spread evenly over all workers — no worker ever trains on 100% padding,
+    Returns ``(sel, mask)`` shaped (window, workers, batch) for round ``r``
+    — closed form, O(one round) memory.  Worker i's slot ``j = r·w·b + t·b
+    + p`` (window step t, batch position p) holds row ``j·n + i``: real rows
+    are dealt *round-robin* across workers, so the wrap-padding that fills
+    the tail round is spread evenly — no worker ever trains on 100% padding,
     which matters for the algorithms whose result blends per-worker params
     (Averaging/Ensemble/EASGD).  ``mask`` is 1.0 for real rows, 0.0 for
-    padding; every real row appears exactly once with mask 1.
+    padding; over a whole epoch every real row appears exactly once with
+    mask 1.
     """
     n, w, b = num_workers, window, batch_size
-    if n_rows == 0:
-        raise ValueError("empty dataset")
-    if n_rows < n:
-        raise ValueError(
-            f"dataset of {n_rows} rows has fewer rows than workers ({n}); "
-            "some workers would train on padding only")
-    per_round = n * w * b
-    rounds = -(-n_rows // per_round)  # ceil: pad up, never drop
-    stride = rounds * w * b
-    i = np.repeat(np.arange(n), stride)
-    j = np.tile(np.arange(stride), n)
-    k = j * n + i  # round-robin deal of rows to (worker, slot)
+    t = np.arange(w)[:, None, None]
+    i = np.arange(n)[None, :, None]
+    p = np.arange(b)[None, None, :]
+    k = (r * w * b + t * b + p) * n + i  # (window, workers, batch)
     mask = (k < n_rows).astype(np.float32)
     sel = k % n_rows  # wrap-pad with real rows
-    return rounds, sel, mask
+    return sel, mask
 
 
 def round_stream(x: np.ndarray, y: np.ndarray, num_workers: int,
@@ -60,33 +64,21 @@ def round_stream(x: np.ndarray, y: np.ndarray, num_workers: int,
     """Yield per-round (x, y, mask) triples shaped (window, workers, batch,
     ...).
 
-    Row layout comes from ``round_layout`` — identical to
+    Row layout comes from ``round_block`` — identical to
     ``shape_epoch_data``, so a streamed epoch visits exactly the same
     batches/masks as the all-at-once path (verified bit-for-bit in
-    tests/test_pipeline.py) while materializing only one round at a time.
+    tests/test_pipeline.py) while materializing only one round at a time
+    (plus an optional epoch-length permutation index for shuffling).
     """
     n, w, b = num_workers, window, batch_size
-    rounds, sel, mask = round_layout(len(x), n, w, b)
-    # only the index vectors are materialized up front; rows are gathered one
-    # round at a time, so peak extra host memory is one round, not a full
-    # shuffled copy of the dataset
+    rounds = num_rounds(len(x), n, w, b)
     perm = (np.random.default_rng(shuffle_seed).permutation(len(x))
             if shuffle_seed is not None else None)
-    stride = rounds * w * b  # slots per worker shard (incl. padding)
     for r in range(rounds):
-        # worker i, round r owns slots [i*stride + r*w*b, i*stride+(r+1)*w*b)
-        block = np.concatenate([
-            np.arange(i * stride + r * w * b, i * stride + (r + 1) * w * b)
-            for i in range(n)])
-        sel_r, mask_r = sel[block], mask[block]
+        sel, mask = round_block(len(x), n, w, b, r)
         if perm is not None:
-            sel_r = perm[sel_r]
-        xr = x[sel_r].reshape((n, w, b) + x.shape[1:])
-        yr = y[sel_r].reshape((n, w, b) + y.shape[1:])
-        mr = mask_r.reshape((n, w, b))
-        yield (np.ascontiguousarray(np.moveaxis(xr, 0, 1)),
-               np.ascontiguousarray(np.moveaxis(yr, 0, 1)),
-               np.ascontiguousarray(np.moveaxis(mr, 0, 1)))
+            sel = perm[sel]
+        yield x[sel], y[sel], mask
 
 
 def prefetch_to_device(iterator: Iterator, shardings, buffer_size: int = 2):

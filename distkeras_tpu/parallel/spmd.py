@@ -119,19 +119,15 @@ class SPMDEngine:
         Returns the example-weighted loss sum and the weight sum so the
         caller can form an exact mean over *real* examples only.
         """
-        from ..core.train import make_masked_loss_fn
-        loss_of = make_masked_loss_fn(self.model, self.loss_fn)
+        from ..core.train import make_masked_step
+        step = make_masked_step(self.model, self.loss_fn, self.tx)
 
         def body(carry, inp):
             p, s, key = carry
             x, y, w = inp
             key, sub = jax.random.split(key)
-            (l, stats), g = jax.value_and_grad(loss_of, has_aux=True)(
-                p, x, y, w, sub)
-            upd, s = self.tx.update(g, s, p)
-            p = optax.apply_updates(p, upd)
-            p = Sequential.merge_stats(p, stats)
-            return (p, s, key), (l, jnp.sum(w.astype(jnp.float32)))
+            p, s, l, wsum = step(p, s, x, y, w, sub)
+            return (p, s, key), (l, wsum)
 
         (params, opt_state, _), (losses, wsums) = jax.lax.scan(
             body, (params, opt_state, rng), (xw, yw, mw))
@@ -336,20 +332,15 @@ def shape_epoch_data(columns_x: np.ndarray, columns_y: np.ndarray,
     and gradients (``make_masked_loss_fn``) while keeping BatchNorm batch
     statistics over real data values.  The layout itself (round-robin deal
     of rows to workers so padding never concentrates on one worker) lives in
-    ``data.pipeline.round_layout``, shared with the streaming path.
+    ``data.pipeline.round_block``, shared with the streaming path.
 
     Returns ``(xb, yb, mask, rounds)``; every real row appears exactly once.
     """
-    from ..data.pipeline import round_layout
+    from ..data.pipeline import num_rounds, round_block
     n, w, b = num_workers, window, batch_size
-    rounds, sel, mask = round_layout(len(columns_x), n, w, b)
-
-    def reshape(a):
-        # slots laid out worker-major:
-        # (workers, rounds, window, batch, ...) then moved to
-        # (rounds, window, workers, batch, ...)
-        a = a.reshape((n, rounds, w, b) + a.shape[1:])
-        return np.moveaxis(a, 0, 2)
-
-    return (reshape(columns_x[sel]), reshape(columns_y[sel]), reshape(mask),
-            rounds)
+    rounds = num_rounds(len(columns_x), n, w, b)
+    sel = np.empty((rounds, w, n, b), np.int64)
+    mask = np.empty((rounds, w, n, b), np.float32)
+    for r in range(rounds):
+        sel[r], mask[r] = round_block(len(columns_x), n, w, b, r)
+    return columns_x[sel], columns_y[sel], mask, rounds

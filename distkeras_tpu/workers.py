@@ -29,7 +29,7 @@ import optax
 
 from .core import optimizers as opt_lib
 from .core.model import Sequential, deserialize_model
-from .core.train import make_masked_loss_fn
+from .core.train import batch_epoch_data, make_masked_step
 from . import networking
 
 
@@ -74,20 +74,15 @@ class Worker:
         if self._window_fn is not None:
             return self._window_fn
         model = self._ensure_model()
-        tx = self._tx
-        loss_of = make_masked_loss_fn(model, self.loss)
+        step = make_masked_step(model, self.loss, self._tx)
 
         def window(params, opt_state, xw, yw, mw, rng):
             def body(carry, inp):
                 p, s, key = carry
                 x, y, w = inp
                 key, sub = jax.random.split(key)
-                (l, stats), g = jax.value_and_grad(loss_of, has_aux=True)(
-                    p, x, y, w, sub)
-                upd, s = tx.update(g, s, p)
-                p = optax.apply_updates(p, upd)
-                p = Sequential.merge_stats(p, stats)
-                return (p, s, key), (l, jnp.sum(w.astype(jnp.float32)))
+                p, s, l, wsum = step(p, s, x, y, w, sub)
+                return (p, s, key), (l, wsum)
 
             (params, opt_state, _), (losses, wsums) = jax.lax.scan(
                 body, (params, opt_state, rng), (xw, yw, mw))
@@ -116,20 +111,14 @@ class Worker:
         """
         x = np.asarray(shard[self.features_col])
         y = np.asarray(shard[self.label_col])
-        if len(x) == 0:
-            raise ValueError("worker shard is empty")
         perm = np.random.default_rng(epoch_seed).permutation(len(x))
         x, y = x[perm], y[perm]
-        per_window = window * self.batch_size
-        nwin = -(-len(x) // per_window)  # ceil: pad up, never drop
-        rows = nwin * per_window
-        idx = np.arange(rows) % len(x)
-        mask = (np.arange(rows) < len(x)).astype(np.float32)
+        # one window = one "batch" of the shared padder, then split it
+        xw, yw, mw, nwin = batch_epoch_data(x, y, window * self.batch_size)
         shape = (nwin, window, self.batch_size)
-        xw = x[idx].reshape(shape + x.shape[1:])
-        yw = y[idx].reshape(shape + y.shape[1:])
-        mw = mask.reshape(shape)
-        return xw, yw, mw
+        return (xw.reshape(shape + x.shape[1:]),
+                yw.reshape(shape + y.shape[1:]),
+                mw.reshape(shape))
 
 
 class SequentialWorker(Worker):

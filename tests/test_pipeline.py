@@ -176,16 +176,44 @@ def test_round_layout_spreads_padding_across_workers():
     worker — a pad-only worker would blend untrained init params into
     Averaging/Ensemble/EASGD results.  The round-robin deal gives every
     worker its fair share of real rows."""
-    from distkeras_tpu.data.pipeline import round_layout
+    from distkeras_tpu.data.pipeline import num_rounds, round_block
 
-    rounds, sel, mask = round_layout(10, 4, 2, 4)  # 32 slots, 22 padding
-    assert rounds == 1
-    stride = rounds * 2 * 4
-    per_worker = mask.reshape(4, stride).sum(axis=1)
+    assert num_rounds(10, 4, 2, 4) == 1
+    sel, mask = round_block(10, 4, 2, 4, 0)  # 32 slots, 22 padding
+    assert sel.shape == mask.shape == (2, 4, 4)  # (window, workers, batch)
+    per_worker = mask.sum(axis=(0, 2))
     assert per_worker.min() >= 2 and per_worker.max() <= 3
     real = sel[mask.astype(bool)]
     assert sorted(real.tolist()) == list(range(10))
     # fewer rows than workers is refused, not silently degraded
     import pytest
     with pytest.raises(ValueError):
-        round_layout(3, 4, 2, 4)
+        num_rounds(3, 4, 2, 4)
+
+
+def test_fully_padded_batch_is_true_noop():
+    """Code-review finding (round 3): a wsum==0 batch must not move params
+    or optimizer state (Adam moves on a zero gradient otherwise)."""
+    import jax.numpy as jnp
+    from distkeras_tpu.core.train import make_masked_step, init_state
+
+    model = make_model()
+    state, tx = init_state(model, jax.random.PRNGKey(0), (16,), "adam", 1e-3)
+    step = jax.jit(make_masked_step(model, "categorical_crossentropy", tx))
+    x = jnp.zeros((8, 16), jnp.float32)
+    y = jnp.zeros((8, 4), jnp.float32)
+
+    # one real step so adam momentum is non-trivial
+    p1, s1, _, _ = step(state.params, state.opt_state,
+                        jnp.ones((8, 16)), jnp.eye(4)[jnp.zeros(8, int)],
+                        jnp.ones(8), jax.random.PRNGKey(1))
+    # fully padded step: everything must come back bit-identical
+    p2, s2, loss, wsum = step(p1, s1, x, y, jnp.zeros(8),
+                              jax.random.PRNGKey(2))
+    assert float(wsum) == 0.0 and float(loss) == 0.0
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(s1),
+                    jax.tree_util.tree_leaves(s2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
