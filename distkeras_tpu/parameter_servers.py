@@ -301,7 +301,10 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False
     params = trainer._initial_params(input_shape)
     blob = serialize_model(trainer.master_model, params)
 
-    ps = allocate_parameter_server(algorithm, blob, trainer.num_workers)
+    # reference parity (SURVEY §2.1 row 6): async trainers may run
+    # parallelism_factor x num_workers concurrent tasks against the PS
+    n = trainer.num_workers * getattr(trainer, "parallelism_factor", 1)
+    ps = allocate_parameter_server(algorithm, blob, n)
     server = SocketParameterServer(ps)
     server.start()
 
@@ -309,7 +312,6 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False
     # analogue): every row lands on exactly one worker, nothing dropped;
     # shard sizes differ by at most one row and the workers' own
     # window-padding absorbs the raggedness (one shared compilation)
-    n = trainer.num_workers
     if len(x) < n:
         raise ValueError(
             f"dataset of {len(x)} rows has fewer rows than workers ({n})")

@@ -27,7 +27,9 @@ setup(
     license="MIT",
     packages=find_packages(include=["distkeras_tpu", "distkeras_tpu.*"]),
     python_requires=">=3.10",
-    install_requires=["jax", "numpy", "optax"],
+    # jax >= 0.9: the SPMD engine uses jax.shard_map and jax.lax.pcast
+    # (older jax installs fine but AttributeErrors at runtime)
+    install_requires=["jax>=0.9", "numpy", "optax"],
     extras_require={"test": ["pytest"], "keras": ["keras>=3"]},
     ext_modules=ext_modules,
 )

@@ -159,3 +159,60 @@ def test_trainer_serialize_and_reuse(eight_devices):
     t2 = SingleTrainer(fm, batch_size=32, num_epoch=1,
                        label_col="label_encoded", learning_rate=0.05)
     t2.train(ds)
+
+
+def test_adag_accuracy_parity_with_single(eight_devices):
+    """SURVEY §6 north-star: ADAG's final validation accuracy matches the
+    single-worker baseline within epsilon on identical data/model/seed.
+    The committed PARITY.json artifact (scripts/accuracy_parity.py) is the
+    full-size version of this assertion."""
+    train, test = make_dataset(n=2560, seed=11).split(0.8, seed=3)
+
+    s = SingleTrainer(make_model(), batch_size=16, num_epoch=6,
+                      label_col="label_encoded", worker_optimizer="adam",
+                      learning_rate=1e-3, seed=0)
+    single_acc = eval_accuracy(s.train(train, shuffle=True), test)
+
+    a = ADAG(make_model(), num_workers=8, batch_size=16, num_epoch=6,
+             communication_window=4, label_col="label_encoded",
+             worker_optimizer="adam", learning_rate=1e-3, seed=0)
+    adag_acc = eval_accuracy(a.train(train, shuffle=True), test)
+
+    assert single_acc > 0.9 and adag_acc > 0.9
+    assert abs(single_acc - adag_acc) < 0.05, (single_acc, adag_acc)
+
+
+def test_parallelism_factor(eight_devices):
+    """Reference parity (SURVEY §2.1 row 6): async trainers accept
+    parallelism_factor; host_ps runs factor x num_workers true-async
+    workers, SPMD rejects a factor > 1 instead of silently ignoring it."""
+    ds = make_dataset(n=512)
+    t = ADAG(make_model(), num_workers=2, parallelism_factor=2, batch_size=8,
+             num_epoch=4, communication_window=2, label_col="label_encoded",
+             worker_optimizer="adam", learning_rate=5e-3,
+             execution="host_ps")
+    fitted = t.train(ds)
+    assert t.parallelism_factor == 2
+    assert eval_accuracy(fitted, ds) > 0.5
+    with pytest.raises(ValueError):
+        ADAG(make_model(), num_workers=2, parallelism_factor=2)
+    with pytest.raises(ValueError):
+        ADAG(make_model(), num_workers=2, parallelism_factor=0)
+
+
+def test_ensemble_serialize_returns_all_members(eight_devices):
+    from distkeras_tpu.core.model import FittedModel
+
+    ds = make_dataset(n=512)
+    e = EnsembleTrainer(make_model(), num_models=4, batch_size=8, num_epoch=1,
+                        label_col="label_encoded", worker_optimizer="sgd",
+                        learning_rate=0.1)
+    with pytest.raises(ValueError):
+        e.serialize()
+    models = e.train(ds)
+    blobs = e.serialize()["ensemble"]
+    assert len(blobs) == 4
+    x = ds["features"][:8]
+    for blob, m in zip(blobs, models):
+        np.testing.assert_allclose(FittedModel.deserialize(blob).predict(x),
+                                   m.predict(x), rtol=1e-6)
