@@ -59,12 +59,17 @@ class Checkpointer:
         return os.path.join(self.directory, f"ckpt_{step}.npz")
 
     # -- save/restore ---------------------------------------------------------
-    def save(self, step: int, state: Any) -> str:
-        """Atomically write the state pytree for ``step``."""
+    def save(self, step: int, state: Any,
+             meta: Optional[dict] = None) -> str:
+        """Atomically write the state pytree for ``step``.  ``meta`` is an
+        arbitrary JSON dict recorded in the manifest (e.g. the trainer's
+        checkpoint unit) — read it back with ``read_meta`` to validate that
+        a resume interprets the step number the way the save meant it."""
         leaves = jax.tree_util.tree_leaves(state)
         arrays = {f"leaf_{i}": np.asarray(jax.device_get(l))
                   for i, l in enumerate(leaves)}
-        manifest = json.dumps({"step": int(step), "num_leaves": len(leaves)})
+        manifest = json.dumps({"step": int(step), "num_leaves": len(leaves),
+                               "meta": meta or {}})
         path = self._path(step)
         fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
         try:
@@ -78,6 +83,15 @@ class Checkpointer:
             raise
         self._retain()
         return path
+
+    def read_meta(self, step: Optional[int] = None) -> dict:
+        """The ``meta`` dict recorded at save time ({} for old checkpoints)."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"No checkpoints in {self.directory}")
+        with np.load(self._path(step)) as z:
+            return json.loads(bytes(z["manifest"]).decode()).get("meta", {})
 
     def restore(self, target: Any, step: Optional[int] = None) -> Any:
         """Refill ``target``'s leaves from the checkpoint at ``step`` (default

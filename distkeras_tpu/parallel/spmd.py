@@ -281,6 +281,19 @@ class SPMDEngine:
         state, losses = self._epoch_fn(state, xb, yb, mb, rngs)
         return state, losses
 
+    def run_round(self, state: DistState, x, y, m, rngs
+                  ) -> Tuple[DistState, jnp.ndarray]:
+        """One jitted round from host arrays shaped (window, workers, batch,
+        ...) — the round-granular checkpointing path.  Same math as the
+        epoch scan (both execute the one shard_map'd round program), at the
+        cost of one jit call + device_put per round."""
+        if self._round_step is None:
+            self._round_step = self._build_round_step()
+        sh = NamedSharding(self.mesh, P(None, WORKER_AXIS))
+        return self._round_step(state, jax.device_put(x, sh),
+                                jax.device_put(y, sh),
+                                jax.device_put(m, sh), rngs)
+
     # -- streaming epoch (datasets larger than HBM) ---------------------------
     def _build_round_step(self) -> Callable:
         shmapped = self._shmapped_round()

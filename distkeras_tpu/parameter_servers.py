@@ -25,6 +25,7 @@ import socket
 import threading
 from typing import Any, Dict, List, Optional
 
+import jax
 import numpy as np
 
 from . import networking
@@ -274,8 +275,8 @@ def allocate_parameter_server(algorithm: str, model_blob: dict,
     return cls(model_blob)
 
 
-def run_host_ps_training(trainer, dataset, shuffle: bool = False
-                         ) -> FittedModel:
+def run_host_ps_training(trainer, dataset, shuffle: bool = False,
+                         resume: bool = False) -> FittedModel:
     """Execute a DistributedTrainer with true async semantics: a live socket
     PS + one worker thread per "executor", each driving jitted window steps.
 
@@ -283,6 +284,14 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False
     the analogue of Spark ``local[*]`` — and the same code path a multi-host
     DCN deployment uses with workers on other hosts pointing at
     ``determine_host_address()``.
+
+    Checkpoint/resume (epoch granularity): training runs as epoch *waves* —
+    all worker threads are joined between epochs, at which point the full
+    async state (PS center weights + update clock + every worker's params
+    and optimizer state) is consistent and serialized via ``Checkpointer``.
+    Within an epoch commits stay truly asynchronous; bit-exact resume is a
+    non-goal here (commit interleaving is scheduler-dependent by design —
+    the deterministic path is ``execution='spmd'``).
     """
     algorithm = trainer.ALGORITHM
     if algorithm not in WORKER_CLASSES:
@@ -290,6 +299,12 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False
             f"execution='host_ps' supports PS algorithms "
             f"{sorted(WORKER_CLASSES)}, not {algorithm!r} "
             f"({type(trainer).__name__})")
+    if getattr(trainer, "checkpoint_unit", "epoch") == "round":
+        raise ValueError(
+            "checkpoint_unit='round' requires execution='spmd'; the host_ps "
+            "path checkpoints at epoch waves")
+    if resume and trainer.checkpoint_dir is None:
+        raise ValueError("train(resume=True) needs checkpoint_dir")
 
     trainer.record_training_start()
     x = np.asarray(dataset[trainer.features_col])
@@ -332,29 +347,88 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False
 
     workers = [worker_cls(blob, **kw) for _ in range(n)]
     share_compiled_state(workers)  # compile the window program once, not N×
-    results: List[Optional[dict]] = [None] * n
-    errors: List[BaseException] = []
 
-    def run(i):
-        try:
-            results[i] = workers[i].train(
-                i, {trainer.features_col: xs[i], trainer.label_col: ys[i]})
-        except BaseException as e:  # propagate to the driver thread
-            errors.append(e)
+    ckpt = None
+    start_epoch = 0
+    states: List[Any] = [None] * n
 
-    threads = [threading.Thread(target=run, args=(i,), name=f"dkt-worker-{i}")
-               for i in range(n)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    server.stop()
-    if errors:
-        raise errors[0]
+    def full_state():
+        """The complete async-training state as one host pytree."""
+        with ps._lock:
+            center = [w.copy() for w in ps.center]
+            clock = ps.num_updates
+        return {"center": center, "clock": np.int64(clock),
+                "workers": [jax.tree_util.tree_map(np.asarray, s)
+                            for s in states]}
 
-    for r in results:
-        if r:
-            trainer.history.extend(r["history"])
+    if trainer.checkpoint_dir is not None:
+        from .checkpoint import Checkpointer
+        ckpt = Checkpointer(trainer.checkpoint_dir)
+        latest = ckpt.latest_step()
+        if resume and latest is not None:
+            meta = ckpt.read_meta(latest)
+            if meta.get("engine", "host_ps") != "host_ps":
+                raise ValueError(
+                    f"checkpoint at {trainer.checkpoint_dir} was saved by "
+                    f"engine={meta.get('engine')!r}; this trainer is "
+                    "host_ps — resume with the same configuration")
+            # template with the right pytree structure, then refill
+            head = workers[0]
+            p0 = head._weights_to_params(ps.center)
+            states = [(p0, head._tx.init(p0)) for _ in range(n)]
+            restored = ckpt.restore(full_state(), latest)
+            with ps._lock:
+                ps.center = [np.asarray(w, np.float32)
+                             for w in restored["center"]]
+                ps.num_updates = int(restored["clock"])
+            states = [tuple(s) for s in restored["workers"]]
+            start_epoch = latest
+
+    # Without checkpointing there is no reason to barrier between epochs:
+    # each worker runs all its epochs in one fully-async wave (one connect,
+    # no stragglers at epoch joins) — the reference execution model.  With
+    # a checkpoint_dir, epochs run as waves and the joined state is saved.
+    if ckpt is None:
+        waves = [None]  # one wave, all epochs (worker default)
+    else:
+        waves = [(e, e + 1) for e in range(start_epoch, trainer.num_epoch)]
+
+    try:
+        for epoch_range in waves:
+            results: List[Optional[dict]] = [None] * n
+            errors: List[BaseException] = []
+
+            def run(i, epoch_range=epoch_range):
+                try:
+                    results[i] = workers[i].train(
+                        i,
+                        {trainer.features_col: xs[i],
+                         trainer.label_col: ys[i]},
+                        initial_state=states[i],
+                        epoch_range=epoch_range)
+                except BaseException as e:  # propagate to the driver thread
+                    errors.append(e)
+
+            threads = [threading.Thread(target=run, args=(i,),
+                                        name=f"dkt-worker-{i}")
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+            states = [r["state"] for r in results]
+            if ckpt is not None and (
+                    epoch_range[1] % trainer.checkpoint_every == 0):
+                ckpt.save(epoch_range[1], full_state(),
+                          meta={"engine": "host_ps", "unit": "epoch"})
+    finally:
+        server.stop()
+
+    trainer.history.clear()
+    for w in workers:
+        trainer.history.extend(w.history)
     fitted = server.get_model()
     trainer._fitted = fitted
     trainer.record_training_stop()

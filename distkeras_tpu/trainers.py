@@ -174,6 +174,7 @@ class DistributedTrainer(Trainer):
                  execution: str = "spmd", mesh=None, seed: int = 0,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1,
+                 checkpoint_unit: str = "epoch",
                  metrics_path: Optional[str] = None,
                  wire_dtype: Optional[str] = None):
         super().__init__(keras_model, loss, worker_optimizer, learning_rate,
@@ -193,6 +194,12 @@ class DistributedTrainer(Trainer):
         self.wire_dtype = wire_dtype
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = max(int(checkpoint_every), 1)
+        if checkpoint_unit not in ("epoch", "round"):
+            raise ValueError("checkpoint_unit must be 'epoch' or 'round'")
+        # 'round' = mid-epoch granularity on the SPMD engine: steps are the
+        # global round clock (DistState.round_idx); 'epoch' keeps the whole
+        # epoch as one XLA program (fastest) and checkpoints between epochs
+        self.checkpoint_unit = checkpoint_unit
         self.metrics_path = metrics_path
         self._engine: Optional[SPMDEngine] = None
         self._state: Optional[DistState] = None
@@ -214,21 +221,20 @@ class DistributedTrainer(Trainer):
     def train(self, dataset: Dataset, shuffle: bool = False,
               resume: bool = False) -> FittedModel:
         if self.execution == "host_ps":
-            if self.checkpoint_dir is not None or resume:
-                raise NotImplementedError(
-                    "checkpoint/resume is not supported on the host_ps "
-                    "execution path (async PS state is not serialized); "
-                    "use execution='spmd'")
             from .parameter_servers import run_host_ps_training
-            return run_host_ps_training(self, dataset, shuffle)
+            return run_host_ps_training(self, dataset, shuffle, resume=resume)
         self.record_training_start()
         x = np.asarray(dataset[self.features_col])
         y = np.asarray(dataset[self.label_col])
         self._input_shape = x.shape[1:]
         engine = self.service(self._input_shape)
         self._engine = engine
+        from .data.pipeline import num_rounds
+        rpe = num_rounds(len(x), self.num_workers, self.communication_window,
+                         self.batch_size)  # rounds per epoch (constant)
         ckpt = None
         start_epoch = 0
+        skip_rounds = 0  # rounds of start_epoch already done (round unit)
         if resume and self.checkpoint_dir is None:
             raise ValueError("train(resume=True) needs checkpoint_dir")
         if self.checkpoint_dir is not None:
@@ -236,10 +242,26 @@ class DistributedTrainer(Trainer):
             ckpt = Checkpointer(self.checkpoint_dir)
             latest = ckpt.latest_step()
             if resume and latest is not None:
-                # epoch checkpoints: step k = state after k epochs
+                # a step number only means what the saving run meant by it:
+                # refuse to reinterpret epoch-steps as rounds or vice versa
+                meta = ckpt.read_meta(latest)
+                saved_unit = meta.get("unit", self.checkpoint_unit)
+                if meta.get("engine", "spmd") != "spmd" \
+                        or saved_unit != self.checkpoint_unit:
+                    raise ValueError(
+                        f"checkpoint at {self.checkpoint_dir} was saved by "
+                        f"engine={meta.get('engine', 'spmd')!r} with "
+                        f"checkpoint_unit={saved_unit!r}; this trainer is "
+                        f"spmd/{self.checkpoint_unit!r} — resume with the "
+                        "same configuration")
                 self._state = engine.put_state(
                     ckpt.restore(jax.device_get(self._state), latest))
-                start_epoch = latest
+                if self.checkpoint_unit == "round":
+                    # step k = global round clock after k rounds
+                    start_epoch, skip_rounds = divmod(latest, rpe)
+                else:
+                    # step k = state after k epochs
+                    start_epoch = latest
         from .metrics import EpochMetrics, MetricsLogger
         metrics = EpochMetrics(MetricsLogger(self.metrics_path),
                                num_chips=self.num_workers)
@@ -260,17 +282,44 @@ class DistributedTrainer(Trainer):
                 xb, yb, mb, rounds = shape_epoch_data(
                     xe, ye, self.num_workers, self.communication_window,
                     self.batch_size)
-                self._state, losses = engine.run_epoch(self._state, xb, yb,
-                                                       mb, rngs)
-                losses = np.asarray(losses)
+                first = skip_rounds if epoch == start_epoch else 0
+                if self.checkpoint_unit == "round":
+                    # per-round stepping: same round program as the epoch
+                    # scan (bit-identical), checkpointable mid-epoch on the
+                    # global round clock.  Losses stay on device until the
+                    # epoch ends so rounds without a checkpoint dispatch
+                    # without a host sync.
+                    losses = []
+                    done = int(self._state.round_idx)
+                    for r in range(first, rounds):
+                        self._state, loss = engine.run_round(
+                            self._state, xb[r], yb[r], mb[r], rngs)
+                        losses.append(loss)
+                        done += 1
+                        if ckpt is not None and (
+                                done % self.checkpoint_every == 0):
+                            ckpt.save(done, jax.device_get(self._state),
+                                      meta={"engine": "spmd",
+                                            "unit": "round"})
+                    losses = (np.asarray(jax.device_get(jnp.stack(losses)),
+                                         np.float32)
+                              if losses else np.zeros((0,), np.float32))
+                else:
+                    self._state, losses = engine.run_epoch(
+                        self._state, xb, yb, mb, rngs)
+                    losses = np.asarray(losses)
                 self.history.extend(losses.tolist())
                 # every real row trains exactly once (tail is padded+masked,
-                # not dropped), so the throughput metric counts len(xe)
-                metrics.epoch(epoch, len(xe), time.time() - t0,
-                              float(losses.mean()))
-                if ckpt is not None and (
-                        epoch + 1) % self.checkpoint_every == 0:
-                    ckpt.save(epoch + 1, jax.device_get(self._state))
+                # not dropped); a resumed partial epoch counts exactly the
+                # real rows of its remaining rounds (mask sum)
+                examples = (len(xe) if first == 0
+                            else int(mb[first:].sum()))
+                metrics.epoch(epoch, examples, time.time() - t0,
+                              float(losses.mean()) if len(losses) else 0.0)
+                if (ckpt is not None and self.checkpoint_unit == "epoch"
+                        and (epoch + 1) % self.checkpoint_every == 0):
+                    ckpt.save(epoch + 1, jax.device_get(self._state),
+                              meta={"engine": "spmd", "unit": "epoch"})
         finally:
             metrics.logger.close()
         center = jax.device_get(self._state.center)

@@ -205,16 +205,34 @@ class PSWorker(Worker):
         })
 
     # -- the training loop ---------------------------------------------------
-    def train(self, index: int, shard: Dict[str, np.ndarray]) -> dict:
+    def train(self, index: int, shard: Dict[str, np.ndarray],
+              initial_state=None, epoch_range=None) -> dict:
+        """Run the PS-connected minibatch loop.
+
+        ``initial_state``: optional ``(params, opt_state)`` to continue from
+        (checkpoint resume / epoch-wave execution); default is the reference
+        behavior — pull the center and start a fresh optimizer.
+        ``epoch_range``: optional ``(start, stop)`` slice of the epoch loop
+        so the driver can checkpoint between epoch waves.  Per-epoch RNG is
+        derived by folding the epoch index, so a resumed run sees the same
+        dropout/shuffle randomness as an uninterrupted one.
+        """
         window_fn = self._build_window_fn()
         self.connect()
         try:
-            params = self._weights_to_params(self.pull())
-            opt_state = self._tx.init(params)
-            rng = jax.random.PRNGKey(self.seed + 100 + index)
-            for epoch in range(self.num_epoch):
+            if initial_state is None:
+                params = self._weights_to_params(self.pull())
+                opt_state = self._tx.init(params)
+            else:
+                params, opt_state = initial_state
+                self.pull()  # sync the PS clock (DynSGD staleness baseline)
+            start, stop = (epoch_range if epoch_range is not None
+                           else (0, self.num_epoch))
+            for epoch in range(start, stop):
                 xw, yw, mw = self._shard_to_windows(
                     shard, self.window, self.seed + 1000 * epoch + index)
+                rng = jax.random.fold_in(
+                    jax.random.PRNGKey(self.seed + 100 + index), epoch)
                 for i in range(len(xw)):
                     rng, sub = jax.random.split(rng)
                     params, opt_state, loss = self._window_step(
@@ -223,7 +241,7 @@ class PSWorker(Worker):
                     self.history.append(float(loss))
         finally:
             self.disconnect()
-        return {"history": self.history}
+        return {"history": self.history, "state": (params, opt_state)}
 
     def _window_step(self, window_fn, params, opt_state, xw, yw, mw, rng,
                      index: int):
