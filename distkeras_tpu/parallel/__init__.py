@@ -7,6 +7,7 @@ from .tp import (MODEL_AXIS, column_parallel_dense, row_parallel_dense,
 from .moe import moe_mlp, top1_routing
 from .pipeline import STAGE_AXIS, pipeline_apply
 from .transformer import ParallelTransformerLM
+from .pp_transformer import PipelineTransformerLM
 from . import rules
 
 __all__ = [
@@ -17,4 +18,5 @@ __all__ = [
     "MODEL_AXIS", "column_parallel_dense", "row_parallel_dense",
     "tp_mlp", "tp_self_attention", "moe_mlp", "top1_routing",
     "STAGE_AXIS", "pipeline_apply", "ParallelTransformerLM",
+    "PipelineTransformerLM",
 ]

@@ -42,9 +42,13 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
     perm = [(i, (i + 1) % n) for i in range(n)]  # send forward
     micro_shape = x_micro.shape[1:]
 
+    # carry zeros derive from x_micro so they inherit its varying axes (e.g.
+    # 'data' when the pipeline composes with data parallelism inside one
+    # shard_map), plus the stage axis the ring introduces.  stage_fn must
+    # not make its output vary over further mesh axes beyond these.
     varying = lambda a: jax.lax.pcast(a, axis_name, to="varying")
-    buf0 = varying(jnp.zeros(micro_shape, x_micro.dtype))
-    out0 = varying(jnp.zeros((m,) + micro_shape, jnp.float32))
+    buf0 = varying(jnp.zeros_like(x_micro[0]))
+    out0 = varying(jnp.zeros_like(x_micro, jnp.float32))
 
     def tick(carry, t):
         buf, outputs = carry

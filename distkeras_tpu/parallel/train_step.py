@@ -1,0 +1,71 @@
+"""Shared train-step machinery for the model-parallel transformers.
+
+Both ``ParallelTransformerLM`` (dp × sp × tp + ep) and
+``PipelineTransformerLM`` (dp × pp) compile the same shape of program: a
+``shard_map``'d value_and_grad + optax update over mesh-sharded params, with
+the optimizer state sharded like the params it tracks.  This module holds
+that machinery once, in a model-agnostic place.
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+tmap = jax.tree_util.tree_map
+
+
+def opt_partition_specs(optimizer, params, param_specs):
+    """PartitionSpecs for an optax state over sharded params.
+
+    Optax moment trees (mu/nu/trace...) embed the full param tree, so every
+    state leaf's key path *ends with* some param's key path — match on that
+    suffix to inherit the param's spec; leaves with no param suffix (step
+    counters, scalars) replicate."""
+    opt_shape = jax.eval_shape(optimizer.init, params)
+    spec_leaves = jax.tree_util.tree_leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P))
+    path_to_spec = {
+        tuple(str(k) for k in path): sp
+        for (path, _), sp in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0], spec_leaves)}
+
+    def leaf_spec(path, leaf):
+        keys = tuple(str(k) for k in path)
+        for start in range(len(keys)):
+            sp = path_to_spec.get(keys[start:])
+            if sp is not None:
+                return sp
+        return P()
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, opt_shape)
+
+
+def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
+                     optimizer: optax.GradientTransformation, params):
+    """(opt_state, jitted step): step(params, opt, tokens, labels) ->
+    (params, opt, loss).
+
+    ``local_loss(params, tokens, labels)`` runs *inside* shard_map over
+    ``mesh`` — it sees local shards and is responsible for its own
+    collectives.  State buffers are donated.
+    """
+    opt_sp = opt_partition_specs(optimizer, params, param_specs)
+
+    def local_step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(local_loss)(params, tokens, labels)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    opt_state = jax.jit(
+        optimizer.init,
+        out_shardings=tmap(lambda s: NamedSharding(mesh, s), opt_sp,
+                           is_leaf=lambda x: isinstance(x, P)))(params)
+    step = jax.jit(jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(param_specs, opt_sp, batch_spec, batch_spec),
+        out_specs=(param_specs, opt_sp, P())),
+        donate_argnums=(0, 1))
+    return opt_state, step

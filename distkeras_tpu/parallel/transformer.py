@@ -223,58 +223,15 @@ class ParallelTransformerLM:
         return jax.lax.pmean(total / count, self.axes[2])
 
     # -- train step -----------------------------------------------------------
-    def _opt_specs(self, optimizer, params):
-        """PartitionSpecs for the optimizer state.
-
-        Optax moment trees (mu/nu/trace...) embed the full param tree, so
-        every state leaf's key path *ends with* some param's key path — match
-        on that suffix to inherit the param's spec; leaves with no param
-        suffix (step counters, scalars) replicate."""
-        opt_shape = jax.eval_shape(optimizer.init, params)
-        spec_leaves = jax.tree_util.tree_leaves(
-            self.param_specs(), is_leaf=lambda x: isinstance(x, P))
-        path_to_spec = {
-            tuple(str(k) for k in path): sp
-            for (path, _), sp in zip(
-                jax.tree_util.tree_flatten_with_path(params)[0], spec_leaves)}
-
-        def leaf_spec(path, leaf):
-            keys = tuple(str(k) for k in path)
-            for start in range(len(keys)):
-                sp = path_to_spec.get(keys[start:])
-                if sp is not None:
-                    return sp
-            return P()
-
-        return jax.tree_util.tree_map_with_path(leaf_spec, opt_shape)
-
     def compile_train_step(self, optimizer: optax.GradientTransformation,
                            params):
         """Build (opt_state, jitted step): step(params, opt, tokens, labels)
         -> (params, opt, loss).  tokens/labels are (B, S) int32 sharded
         ``P('data', 'seq')``."""
+        from .train_step import build_train_step
         data_axis, seq_axis, _ = self.axes
-        specs = self.param_specs()
-        batch_spec = P(data_axis, seq_axis)
-        opt_sp = self._opt_specs(optimizer, params)
-
-        def local_step(params, opt_state, tokens, labels):
-            loss, grads = jax.value_and_grad(self._loss)(params, tokens,
-                                                         labels)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
-
-        opt_state = jax.jit(
-            optimizer.init,
-            out_shardings=tmap(lambda s: NamedSharding(self.mesh, s), opt_sp,
-                               is_leaf=lambda x: isinstance(x, P)))(params)
-        step = jax.jit(jax.shard_map(
-            local_step, mesh=self.mesh,
-            in_specs=(specs, opt_sp, batch_spec, batch_spec),
-            out_specs=(specs, opt_sp, P())),
-            donate_argnums=(0, 1))
-        return opt_state, step
+        return build_train_step(self.mesh, self._loss, self.param_specs(),
+                                P(data_axis, seq_axis), optimizer, params)
 
     def batch_sharding(self) -> NamedSharding:
         data_axis, seq_axis, _ = self.axes
