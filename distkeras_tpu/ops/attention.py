@@ -46,7 +46,7 @@ def attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
               impl: Optional[str] = None):
     """Dispatching entry point used by the MultiHeadAttention layer."""
     if impl is None:
-        impl = "pallas" if _pallas_eligible(q) else "xla"
+        impl = "pallas" if _pallas_eligible(q, k) else "xla"
     if impl == "xla":
         return dot_product_attention(q, k, v, causal=causal, scale=scale)
     if impl == "pallas":
@@ -55,9 +55,17 @@ def attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
     raise ValueError(f"unknown attention impl {impl!r}")
 
 
-def _pallas_eligible(q) -> bool:
-    """Fused kernel wants TPU + lane-aligned head_dim + tileable seq."""
+def _pallas_eligible(q, k) -> bool:
+    """Fused kernel wants TPU, self-attention lengths (the kernel folds k/v
+    with q's sequence length — cross-attention falls back to XLA), and a
+    block-tileable sequence: a multiple of the 128-lane block, or a single
+    block whose rows satisfy the strictest (bf16: 16) sublane tile.
+    head_dim is unconstrained — the kernel's blocks span the whole (d) dim,
+    which TPU tiling always allows (d=64 exercised by the hardware smoke
+    test, tests/test_tpu_smoke.py)."""
     if jax.default_backend() != "tpu":
         return False
-    b, s, h, d = q.shape
-    return d % 128 == 0 and s % 128 == 0
+    if q.shape[1] != k.shape[1]:
+        return False
+    s = q.shape[1]
+    return s % 128 == 0 or (s <= 128 and s % 16 == 0)

@@ -34,16 +34,25 @@ SEQ_AXIS = "seq"
 
 
 def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   block_k: Optional[int] = None):
     """Collective attention over sequence shards — call *inside* shard_map.
 
     q, k, v: local shards (B, S_local, H, Dh), sequence-sharded on
     ``axis_name``.  Returns the local (B, S_local, H, Dh) output in q.dtype.
+
+    ``block_k``: chunk each rotation's local attend over k sub-blocks of
+    this size (blockwise attention), bounding the score tensor at
+    (B, H, S_local, block_k) instead of (B, H, S_local, S_local) — the
+    long-context memory knob when local shards are themselves large.  The
+    math is identical (same online-softmax recurrence, finer grain).
     """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    if block_k is not None and s_loc % block_k:
+        raise ValueError(f"S_local {s_loc} % block_k {block_k} != 0")
 
     q32 = q.astype(jnp.float32) * scale
     q_pos = idx * s_loc + jnp.arange(s_loc)
@@ -51,12 +60,14 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
     # ring position (idx + r) mod n
     perm = [(i, (i - 1) % n) for i in range(n)]
 
-    def attend(acc, k_blk, v_blk, src):
+    def attend_chunk(acc, k_blk, v_blk, k0):
+        """One online-softmax update; ``k0`` = global position of
+        k_blk[:, 0]."""
         num, den, mx = acc
         scores = jnp.einsum("bqhd,bkhd->bhqk", q32,
                             k_blk.astype(jnp.float32))
         if causal:
-            k_pos = src * s_loc + jnp.arange(s_loc)
+            k_pos = k0 + jnp.arange(k_blk.shape[1])
             hide = k_pos[None, :] > q_pos[:, None]
             scores = jnp.where(hide[None, None], -jnp.inf, scores)
         blk_max = jnp.max(scores, axis=-1)                     # (B,H,Sq)
@@ -64,12 +75,26 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
         # fully-masked-so-far rows keep mx = -inf; shift by 0 there so the
         # exps below stay NaN-free (e^{-inf-0} = 0)
         safe = jnp.where(jnp.isneginf(new_mx), 0.0, new_mx)
-        p = jnp.exp(scores - safe[..., None])                  # (B,H,Sq,Sk)
+        p = jnp.exp(scores - safe[..., None])                  # (B,H,Sq,Bk)
         corr = jnp.exp(mx - safe)                              # (B,H,Sq)
         num = num * corr[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
         den = den * corr + jnp.sum(p, axis=-1)
         return num, den, new_mx
+
+    def attend(acc, k_blk, v_blk, src):
+        if block_k is None:
+            return attend_chunk(acc, k_blk, v_blk, src * s_loc)
+
+        def chunk(acc, c):
+            kb = jax.lax.dynamic_slice_in_dim(k_blk, c * block_k, block_k,
+                                              axis=1)
+            vb = jax.lax.dynamic_slice_in_dim(v_blk, c * block_k, block_k,
+                                              axis=1)
+            return attend_chunk(acc, kb, vb, src * s_loc + c * block_k), None
+
+        acc, _ = jax.lax.scan(chunk, acc, jnp.arange(s_loc // block_k))
+        return acc
 
     def body(carry, r):
         # rotate first, then attend — n-1 rotations total, none wasted
@@ -97,13 +122,15 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
 
 def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
                         causal: bool = False,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None,
+                        block_k: Optional[int] = None):
     """Convenience wrapper: global (B, S, H, Dh) arrays in, sequence sharded
     over ``mesh[axis_name]``, ring attention, global array out.  For models
     already running under shard_map, call ``ring_attention`` directly."""
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
-        lambda a, b_, c: ring_attention(a, b_, c, axis_name, causal, scale),
+        lambda a, b_, c: ring_attention(a, b_, c, axis_name, causal, scale,
+                                        block_k),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     sharding = NamedSharding(mesh, spec)
     return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),

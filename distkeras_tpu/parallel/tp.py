@@ -69,7 +69,8 @@ def tp_mlp(x, w1, b1, w2, b2, *, axis_name: str = MODEL_AXIS,
 def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
                       head_dim: int, axis_name: str = MODEL_AXIS,
                       seq_axis: Optional[str] = None, causal: bool = True,
-                      compute_dtype=jnp.bfloat16):
+                      compute_dtype=jnp.bfloat16,
+                      ring_block_k: Optional[int] = None):
     """Head-parallel self-attention: each model-axis shard owns
     ``num_local_heads`` heads end to end (qkv column-split by head, local
     attention, output row-split) — one psum per block.  With ``seq_axis``
@@ -80,7 +81,7 @@ def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
     shards; wo: (local_heads·Dh, D) shard.
     """
     from .ring import ring_attention
-    from ..ops.attention import dot_product_attention
+    from ..ops.attention import attention
 
     b, s, _ = x.shape
     h, dh = num_local_heads, head_dim
@@ -91,9 +92,14 @@ def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
 
     q, k, v = proj(wq), proj(wk), proj(wv)
     if seq_axis is not None:
-        out = ring_attention(q, k, v, seq_axis, causal=causal)
+        # ring_block_k: blockwise chunking of each rotation's local attend —
+        # the long-context memory knob when local shards are large
+        out = ring_attention(q, k, v, seq_axis, causal=causal,
+                             block_k=ring_block_k)
     else:
-        out = dot_product_attention(q, k, v, causal=causal)
+        # dispatcher: the fused Pallas flash kernel on TPU when the local
+        # shapes qualify, the XLA reference otherwise
+        out = attention(q, k, v, causal=causal)
     out = out.reshape(b, s, h * dh)
     return row_parallel_dense(out, wo, axis_name=axis_name,
                               compute_dtype=compute_dtype)

@@ -44,6 +44,7 @@ class ParallelTransformerLM:
                  num_experts: Optional[int] = None,
                  capacity_factor: float = 2.0,
                  compute_dtype=jnp.bfloat16, remat: bool = False,
+                 ring_block_k: Optional[int] = None,
                  data_axis: str = "data", seq_axis: str = "seq",
                  model_axis: str = "model"):
         self.vocab_size = vocab_size
@@ -57,6 +58,9 @@ class ParallelTransformerLM:
         self.capacity_factor = capacity_factor
         self.compute_dtype = compute_dtype
         self.remat = bool(remat)
+        # blockwise chunking of ring attention's local attend (memory knob
+        # for long per-device sequence shards); None = unchunked
+        self.ring_block_k = ring_block_k
         self.axes = (data_axis, seq_axis, model_axis)
         self.tp = mesh.shape[model_axis]
         self.sp = mesh.shape[seq_axis]
@@ -179,7 +183,8 @@ class ParallelTransformerLM:
                     h, lp["wq"], lp["wk"], lp["wv"], lp["wo"],
                     num_local_heads=self.num_heads // self.tp,
                     head_dim=self.head_dim, axis_name=model_axis,
-                    seq_axis=seq_axis, causal=True, compute_dtype=cdt)
+                    seq_axis=seq_axis, causal=True, compute_dtype=cdt,
+                    ring_block_k=self.ring_block_k)
                 x = x + attn.astype(cdt)
                 h = ln(lp["ln2"], x)
                 if i in self.moe_layers:

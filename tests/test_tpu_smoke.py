@@ -83,14 +83,19 @@ from distkeras_tpu.ops.attention import attention, dot_product_attention
 from distkeras_tpu.ops.flash_attention import flash_attention
 
 rng = np.random.default_rng(0)
-shape = (2, 256, 4, 128)  # (batch, seq, heads, head_dim) — kernel-eligible
+# cover the eligibility envelope: the classic lane-aligned shape, a small
+# head_dim, and a single sub-128 block (bf16 sublane-tiled)
+for shape in ((2, 256, 4, 128), (2, 256, 4, 64), (2, 112, 4, 64)):
+    q, k, v = (jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+               for _ in range(3))
+    flash = attention(q, k, v, causal=True, impl="pallas")
+    ref = attention(q, k, v, causal=True, impl="xla")
+    err = float(jnp.max(jnp.abs(flash.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    assert err < 0.05, (shape, err)  # bf16 tolerance
+shape = (2, 256, 4, 128)
 q, k, v = (jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
            for _ in range(3))
-flash = attention(q, k, v, causal=True, impl="pallas")
-ref = attention(q, k, v, causal=True, impl="xla")
-err = float(jnp.max(jnp.abs(flash.astype(jnp.float32)
-                            - ref.astype(jnp.float32))))
-assert err < 0.05, err  # bf16 tolerance
 print("SMOKE-FLASH-OK", err)
 
 def loss_flash(q, k, v):
