@@ -38,6 +38,17 @@ def mnist_convnet(compute_dtype: str = "bfloat16") -> Sequential:
     ], input_shape=(784,), compute_dtype=compute_dtype, name="mnist_convnet")
 
 
+def digits_mlp(compute_dtype: str = "bfloat16") -> Sequential:
+    """MLP on the REAL sklearn-digits workload (64-dim 8x8 images — see
+    ``data.datasets.load_digits``): the accuracy-parity artifact's real-data
+    model, sized down from ``mnist_mlp`` for the smaller input."""
+    return Sequential([
+        Dense(128, activation="relu"),
+        Dense(128, activation="relu"),
+        Dense(10, activation="softmax"),
+    ], input_shape=(64,), compute_dtype=compute_dtype, name="digits_mlp")
+
+
 def cifar10_convnet(compute_dtype: str = "bfloat16") -> Sequential:
     """Small ConvNet on 32x32x3 CIFAR-10 (reference DOWNPOUR config)."""
     return Sequential([

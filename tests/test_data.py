@@ -85,6 +85,21 @@ def test_synthetic_datasets_learnable_structure():
     assert set(np.unique(htrain["label"])) <= {0, 1}
 
 
+def test_load_digits_real_data():
+    from distkeras_tpu.data.datasets import load_digits
+    train, test = load_digits(n_train=1500)
+    assert train["features"].shape == (1500, 64)
+    assert test["features"].shape == (297, 64)  # 1797 total, real sklearn set
+    assert 0.0 <= train["features"].min() and train["features"].max() <= 255.0
+    assert set(np.unique(train["label"])) <= set(range(10))
+    # deterministic split, disjoint-by-construction halves
+    t2, _ = load_digits(n_train=1500)
+    np.testing.assert_array_equal(train["features"], t2["features"])
+    # n_test caps the test split
+    _, small = load_digits(n_train=1500, n_test=100)
+    assert small["features"].shape == (100, 64)
+
+
 def test_read_csv(tmp_path):
     p = tmp_path / "higgs.csv"
     p.write_text("f1,f2,label,f3\n"

@@ -22,20 +22,34 @@ pytestmark = pytest.mark.tpu
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_clean(code: str, timeout: float = 420.0):
-    """Run ``code`` in a subprocess on the ambient (non-cpu-forced) backend."""
+def _run_clean(code: str, timeout: float = 420.0, skip_on_timeout=False):
+    """Run ``code`` in a subprocess on the ambient (non-cpu-forced) backend.
+
+    ``skip_on_timeout`` is for the availability PROBE only: a hung probe
+    means the accelerator tunnel is down (it comes and goes in this
+    sandbox), which is unreachable hardware, not a code regression.  Test
+    payloads keep the default — once the probe proved the chip reachable, a
+    hang there is a real on-chip regression and must fail, not skip.
+    """
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-    return subprocess.run([sys.executable, "-c", code], capture_output=True,
-                          text=True, timeout=timeout, env=env)
+    try:
+        return subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=timeout,
+                              env=env)
+    except subprocess.TimeoutExpired:
+        if skip_on_timeout:
+            pytest.skip(f"accelerator probe stalled (> {timeout:.0f}s): "
+                        "tunnel down or backend hung")
+        raise
 
 
 @pytest.fixture(scope="module")
 def tpu_available():
     out = _run_clean(
         "import jax; d = jax.devices()[0]; print('PLATFORM=' + d.platform)",
-        timeout=180.0)
+        timeout=120.0, skip_on_timeout=True)
     if out.returncode != 0 or "PLATFORM=" not in out.stdout:
         pytest.skip("no jax backend reachable for the smoke subprocess")
     platform = out.stdout.rsplit("PLATFORM=", 1)[1].strip()
