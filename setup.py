@@ -18,6 +18,12 @@ if os.environ.get("DISTKERAS_TPU_NO_NATIVE", "0") != "1":
         extra_compile_args=["-O3", "-std=c++17"],
         optional=True,  # fall back to pure Python if the build fails
     ))
+    ext_modules.append(Extension(
+        "distkeras_tpu._csvloader",
+        sources=["csrc/csvloader.cpp"],
+        extra_compile_args=["-O3", "-std=c++17"],
+        optional=True,  # datasets.read_csv falls back to np.genfromtxt
+    ))
 
 setup(
     name="distkeras_tpu",
