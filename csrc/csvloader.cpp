@@ -18,9 +18,10 @@
  * to genfromtxt, so observable behavior never changes — only speed.
  *
  * Parallelism: the buffer is split at line boundaries into one chunk per
- * hardware thread; each chunk is counted and parsed independently (two
- * passes: count rows for exact allocation, then fill).  No Python API calls
- * inside worker threads; the GIL is released for the whole parse.
+ * hardware thread; each chunk parses independently in a single pass into a
+ * growing per-chunk vector, concatenated into the output bytes at the end
+ * (peak memory ~2x output size).  No Python API calls inside worker
+ * threads; the GIL is released for the whole parse.
  *
  * Built by setup.py as distkeras_tpu._csvloader (optional, like the wire
  * codec).  CPython C API only — no pybind11 dependency.

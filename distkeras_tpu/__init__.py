@@ -29,5 +29,5 @@ from . import parameter_servers
 from . import job_deployment
 from . import checkpoint
 from . import metrics
-from .checkpoint import Checkpointer
+from .checkpoint import Checkpointer, OrbaxCheckpointer, make_checkpointer
 from .metrics import MetricsLogger

@@ -235,6 +235,8 @@ def _native_parse(raw: bytes, names: list, delimiter: str,
         return None  # non-ASCII: genfromtxt's decode/naming territory
     if b'"' in raw or b"'" in raw or b"#" in raw or b"\t" in raw:
         return None  # quoting/comments/tabs: genfromtxt semantics territory
+    if b"\x0b" in raw or b"\x0c" in raw:
+        return None  # \v/\f: float() strips them, strtod does not
     if (raw.find(b"x", body_start) != -1 or raw.find(b"X", body_start) != -1
             or raw.find(b"_", body_start) != -1):
         return None  # strtod hex floats / float('1_5') underscore literals

@@ -363,9 +363,19 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
 
     try:
         if trainer.checkpoint_dir is not None:
-            from .checkpoint import Checkpointer
-            ckpt = Checkpointer(trainer.checkpoint_dir)
+            from .checkpoint import foreign_checkpoints, make_checkpointer
+            backend = trainer.checkpoint_backend
+            ckpt = make_checkpointer(trainer.checkpoint_dir, backend)
             latest = ckpt.latest_step()
+            if resume and latest is None:
+                foreign = foreign_checkpoints(trainer.checkpoint_dir, backend)
+                if foreign:
+                    raise ValueError(
+                        f"resume=True with checkpoint_backend={backend!r}, "
+                        f"but {trainer.checkpoint_dir} holds steps {foreign} "
+                        "written by the other backend — resuming now would "
+                        "silently retrain from scratch; use the backend that "
+                        "wrote the checkpoints")
             if resume and latest is not None:
                 # legacy pre-meta checkpoints were all spmd saves (host_ps
                 # checkpointing used to raise NotImplementedError)
@@ -430,6 +440,8 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
                           meta={"engine": "host_ps", "unit": "epoch"})
     finally:
         server.stop()
+        if ckpt is not None:
+            ckpt.wait()  # async (orbax) saves must be durable on return
 
     trainer.history.clear()
     for w in workers:

@@ -175,6 +175,7 @@ class DistributedTrainer(Trainer):
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1,
                  checkpoint_unit: str = "epoch",
+                 checkpoint_backend: str = "npz",
                  metrics_path: Optional[str] = None,
                  wire_dtype: Optional[str] = None):
         super().__init__(keras_model, loss, worker_optimizer, learning_rate,
@@ -200,6 +201,9 @@ class DistributedTrainer(Trainer):
         # global round clock (DistState.round_idx); 'epoch' keeps the whole
         # epoch as one XLA program (fastest) and checkpoints between epochs
         self.checkpoint_unit = checkpoint_unit
+        if checkpoint_backend not in ("npz", "orbax"):
+            raise ValueError("checkpoint_backend must be 'npz' or 'orbax'")
+        self.checkpoint_backend = checkpoint_backend
         self.metrics_path = metrics_path
         self._engine: Optional[SPMDEngine] = None
         self._state: Optional[DistState] = None
@@ -238,9 +242,20 @@ class DistributedTrainer(Trainer):
         if resume and self.checkpoint_dir is None:
             raise ValueError("train(resume=True) needs checkpoint_dir")
         if self.checkpoint_dir is not None:
-            from .checkpoint import Checkpointer
-            ckpt = Checkpointer(self.checkpoint_dir)
+            from .checkpoint import foreign_checkpoints, make_checkpointer
+            ckpt = make_checkpointer(self.checkpoint_dir,
+                                     self.checkpoint_backend)
             latest = ckpt.latest_step()
+            if resume and latest is None:
+                foreign = foreign_checkpoints(self.checkpoint_dir,
+                                              self.checkpoint_backend)
+                if foreign:
+                    raise ValueError(
+                        f"resume=True with checkpoint_backend="
+                        f"{self.checkpoint_backend!r}, but {self.checkpoint_dir}"
+                        f" holds steps {foreign} written by the other backend"
+                        " — resuming now would silently retrain from scratch;"
+                        " use the backend that wrote the checkpoints")
             if resume and latest is not None:
                 # a step number only means what the saving run meant by it:
                 # refuse to reinterpret epoch-steps as rounds or vice versa.
@@ -331,6 +346,8 @@ class DistributedTrainer(Trainer):
                               meta={"engine": "spmd", "unit": "epoch"})
         finally:
             metrics.logger.close()
+            if ckpt is not None:
+                ckpt.wait()  # async (orbax) saves must be durable on return
         center = jax.device_get(self._state.center)
         self._fitted = FittedModel(self.master_model, center)
         self.record_training_stop()

@@ -72,6 +72,87 @@ def test_trainer_checkpoint_resume_exact(eight_devices, tmp_path):
         np.testing.assert_allclose(a, b, atol=1e-6)
 
 
+def _orbax_or_skip():
+    try:
+        import orbax.checkpoint  # noqa: F401
+    except ImportError:
+        pytest.skip("orbax not installed")
+
+
+def test_orbax_checkpointer_roundtrip(tmp_path):
+    """OrbaxCheckpointer honors the Checkpointer interface: save/restore/
+    latest_step/read_meta/retention, including async-save durability."""
+    _orbax_or_skip()
+    from distkeras_tpu.checkpoint import OrbaxCheckpointer
+    ck = OrbaxCheckpointer(str(tmp_path), max_to_keep=2)
+    state = {"params": [np.arange(6, dtype=np.float32).reshape(2, 3),
+                        np.ones((4,), np.float32)],
+             "step": np.int32(7)}
+    for s in (1, 2, 3):
+        ck.save(s, state, meta={"unit": "epoch", "k": s})
+    ck.wait()
+    assert ck.latest_step() == 3
+    assert ck.all_steps() == [2, 3]  # retention
+    assert ck.read_meta(3) == {"unit": "epoch", "k": 3}
+    target = {"params": [np.zeros((2, 3), np.float32),
+                         np.zeros((4,), np.float32)],
+              "step": np.int32(0)}
+    restored = ck.restore(target)
+    np.testing.assert_array_equal(restored["params"][0], state["params"][0])
+    assert int(restored["step"]) == 7
+    ck.close()
+
+
+def test_orbax_backend_resume_matches_npz(eight_devices, tmp_path):
+    """checkpoint_backend='orbax' resumes to the same weights as the npz
+    backend (same interrupted-then-resumed schedule, same data/seed)."""
+    _orbax_or_skip()
+    ds = make_dataset(n=256)
+    kw = dict(num_workers=8, batch_size=8, num_epoch=2,
+              communication_window=2, label_col="label_encoded",
+              worker_optimizer="sgd", learning_rate=0.1, seed=3)
+
+    weights = {}
+    for backend in ("npz", "orbax"):
+        ck_dir = str(tmp_path / backend)
+        first = ADAG(make_model(), checkpoint_dir=ck_dir,
+                     checkpoint_backend=backend, **dict(kw, num_epoch=1))
+        first.train(ds)
+        second = ADAG(make_model(), checkpoint_dir=ck_dir,
+                      checkpoint_backend=backend, **kw)
+        weights[backend] = second.train(ds, resume=True).get_weights()
+
+    for a, b in zip(weights["npz"], weights["orbax"]):
+        np.testing.assert_allclose(a, b, atol=0)
+
+
+def test_unknown_checkpoint_backend_rejected():
+    with pytest.raises(ValueError, match="checkpoint_backend"):
+        ADAG(make_model(), num_workers=8, checkpoint_backend="s3")
+
+
+def test_resume_with_wrong_backend_refused(eight_devices, tmp_path):
+    """resume=True must not silently retrain from scratch when the
+    directory holds the other backend's checkpoints."""
+    _orbax_or_skip()
+    ds = make_dataset(n=128)
+    kw = dict(num_workers=8, batch_size=4, num_epoch=1,
+              communication_window=2, label_col="label_encoded",
+              worker_optimizer="sgd", learning_rate=0.1, seed=3)
+    ck_dir = str(tmp_path / "ck")
+    ADAG(make_model(), checkpoint_dir=ck_dir, **kw).train(ds)  # npz save
+    wrong = ADAG(make_model(), checkpoint_dir=ck_dir,
+                 checkpoint_backend="orbax", **dict(kw, num_epoch=2))
+    with pytest.raises(ValueError, match="other backend"):
+        wrong.train(ds, resume=True)
+    # host_ps path refuses the same way
+    wrong_ps = ADAG(make_model(), checkpoint_dir=ck_dir,
+                    checkpoint_backend="orbax", execution="host_ps",
+                    **dict(kw, num_epoch=2))
+    with pytest.raises(ValueError, match="other backend"):
+        wrong_ps.train(ds, resume=True)
+
+
 def test_metrics_logger_jsonl(tmp_path):
     path = str(tmp_path / "metrics.jsonl")
     m = EpochMetrics(MetricsLogger(path), num_chips=4)
