@@ -120,6 +120,9 @@ class Checkpointer:
         """No-op: npz saves are synchronous (interface parity with
         ``OrbaxCheckpointer.wait``)."""
 
+    def close(self):
+        """No-op (interface parity with ``OrbaxCheckpointer.close``)."""
+
     def _retain(self):
         steps = self.all_steps()
         for s in steps[:-self.max_to_keep]:

@@ -515,30 +515,51 @@ class MultiHeadAttention(Layer):
     The score/softmax path runs through ``ops.attention`` (XLA fusion or the
     Pallas flash kernel on TPU).  No reference counterpart — part of the
     long-context layer (SURVEY.md §2.3 marks SP/attention absent upstream).
+
+    ``num_kv_heads`` < ``num_heads`` gives grouped-query attention (GQA;
+    ``num_kv_heads=1`` is multi-query): the k/v projections shrink to
+    ``num_kv_heads * key_dim`` columns, cutting KV projection FLOPs/params
+    and the decode-time KV cache by ``num_heads / num_kv_heads``.
     """
 
+    #: class-level default so pre-GQA serialized configs (which lack the
+    #: field; from_config bypasses __init__) deserialize as classic MHA
+    num_kv_heads: Optional[int] = None  # None = same as num_heads
+
     def __init__(self, num_heads: int, key_dim: int, causal: bool = False,
-                 use_bias: bool = True, attention_impl: Optional[str] = None):
+                 use_bias: bool = True, attention_impl: Optional[str] = None,
+                 num_kv_heads: Optional[int] = None):
         self.num_heads = int(num_heads)
         self.key_dim = int(key_dim)  # per-head dim
         self.causal = bool(causal)
         self.use_bias = bool(use_bias)
         self.attention_impl = attention_impl
+        if num_kv_heads is not None:
+            self.num_kv_heads = int(num_kv_heads)
+            if self.num_heads % self.num_kv_heads:
+                raise ValueError(
+                    f"num_heads={self.num_heads} not divisible by "
+                    f"num_kv_heads={self.num_kv_heads}")
+
+    def _kv_heads(self) -> int:
+        return (self.num_kv_heads if self.num_kv_heads is not None
+                else self.num_heads)
 
     def init(self, rng, in_shape):
         s, d = in_shape
         inner = self.num_heads * self.key_dim
+        inner_kv = self._kv_heads() * self.key_dim
         ks = jax.random.split(rng, 4)
         params = {
             "wq": init_weight(ks[0], (d, inner)),
-            "wk": init_weight(ks[1], (d, inner)),
-            "wv": init_weight(ks[2], (d, inner)),
+            "wk": init_weight(ks[1], (d, inner_kv)),
+            "wv": init_weight(ks[2], (d, inner_kv)),
             "wo": init_weight(ks[3], (inner, d)),
         }
         if self.use_bias:
             params.update(bq=jnp.zeros((inner,), jnp.float32),
-                          bk=jnp.zeros((inner,), jnp.float32),
-                          bv=jnp.zeros((inner,), jnp.float32),
+                          bk=jnp.zeros((inner_kv,), jnp.float32),
+                          bv=jnp.zeros((inner_kv,), jnp.float32),
                           bo=jnp.zeros((d,), jnp.float32))
         return params, tuple(in_shape)
 
@@ -546,16 +567,18 @@ class MultiHeadAttention(Layer):
               rng=None):
         from ..ops.attention import attention
         b, s, _ = x.shape
-        h, dh = self.num_heads, self.key_dim
+        dh = self.key_dim
 
-        def proj(name):
+        def proj(name, heads):
             bias = params.get("b" + name[1]) if self.use_bias else None
             y = _project(x, params[name], bias, compute_dtype)
-            return y.astype(compute_dtype).reshape(b, s, h, dh)
+            return y.astype(compute_dtype).reshape(b, s, heads, dh)
 
-        out = attention(proj("wq"), proj("wk"), proj("wv"),
+        out = attention(proj("wq", self.num_heads),
+                        proj("wk", self._kv_heads()),
+                        proj("wv", self._kv_heads()),
                         causal=self.causal, impl=self.attention_impl)
-        out = out.reshape(b, s, h * dh)
+        out = out.reshape(b, s, self.num_heads * dh)
         bias_o = params.get("bo") if self.use_bias else None
         return _project(out, params["wo"], bias_o, compute_dtype)
 
@@ -567,10 +590,14 @@ class TransformerBlock(Layer):
     JSON-serializable like every other layer.
     """
 
+    #: class-level default mirrors MultiHeadAttention (pre-GQA configs)
+    num_kv_heads: Optional[int] = None
+
     def __init__(self, num_heads: int, key_dim: int, mlp_dim: int,
                  dropout: float = 0.0, causal: bool = False,
                  activation: str = "gelu",
-                 attention_impl: Optional[str] = None):
+                 attention_impl: Optional[str] = None,
+                 num_kv_heads: Optional[int] = None):
         self.num_heads = int(num_heads)
         self.key_dim = int(key_dim)
         self.mlp_dim = int(mlp_dim)
@@ -578,11 +605,14 @@ class TransformerBlock(Layer):
         self.causal = bool(causal)
         self.activation = activation
         self.attention_impl = attention_impl
+        if num_kv_heads is not None:
+            self.num_kv_heads = int(num_kv_heads)
 
     def _mha(self) -> MultiHeadAttention:
         return MultiHeadAttention(self.num_heads, self.key_dim,
                                   causal=self.causal,
-                                  attention_impl=self.attention_impl)
+                                  attention_impl=self.attention_impl,
+                                  num_kv_heads=self.num_kv_heads)
 
     def init(self, rng, in_shape):
         s, d = in_shape

@@ -27,19 +27,30 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
                           scale: Optional[float] = None):
     """Softmax(q·kᵀ)·v with f32 softmax arithmetic.
 
-    q: (B, Sq, H, Dh); k, v: (B, Sk, H, Dh) → (B, Sq, H, Dh), in q.dtype.
+    q: (B, Sq, H, Dh); k, v: (B, Sk, Hkv, Dh) → (B, Sq, H, Dh), in q.dtype.
+    Hkv may divide H (grouped-query / multi-query attention): each group of
+    H/Hkv query heads shares one k/v head, shrinking the KV projection and —
+    at decode time — the KV cache by the same factor.  Hkv == H is classic
+    MHA; the grouped einsum below reduces to it at G == 1.
     """
     *_, d = q.shape
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    b, sq, h, _ = q.shape
+    hkv = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"num_heads {h} not divisible by kv heads {hkv}")
+    g = h // hkv
+    qg = q.reshape(b, sq, hkv, g, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
-        q_pos = jnp.arange(q.shape[1])
+        q_pos = jnp.arange(sq)
         k_pos = jnp.arange(k.shape[1])
         mask = k_pos[None, :] > q_pos[:, None]  # (Sq, Sk): True = hide
-        scores = jnp.where(mask[None, None], NEG_INF, scores)
+        scores = jnp.where(mask[None, None, None], NEG_INF, scores)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v)
+    return out.reshape(b, sq, h, d)
 
 
 def attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
@@ -51,6 +62,18 @@ def attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
         return dot_product_attention(q, k, v, causal=causal, scale=scale)
     if impl == "pallas":
         from .flash_attention import flash_attention
+        if k.shape[2] != q.shape[2]:
+            # GQA/MQA: the kernel is written for equal head counts; repeat
+            # k/v up to H.  The flash win (no S×S materialization) is
+            # head-count independent, and the repeat is HBM-cheap next to
+            # the scores it avoids; the GQA KV-cache/projection savings
+            # live in the layer, not the kernel.
+            if q.shape[2] % k.shape[2]:
+                raise ValueError(f"num_heads {q.shape[2]} not divisible "
+                                 f"by kv heads {k.shape[2]}")
+            g = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, g, axis=2)
+            v = jnp.repeat(v, g, axis=2)
         return flash_attention(q, k, v, causal=causal, scale=scale)
     raise ValueError(f"unknown attention impl {impl!r}")
 

@@ -441,7 +441,9 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     finally:
         server.stop()
         if ckpt is not None:
-            ckpt.wait()  # async (orbax) saves must be durable on return
+            # durable async (orbax) saves + release the manager's
+            # background threads — one leaks per train() otherwise
+            ckpt.close()
 
     trainer.history.clear()
     for w in workers:

@@ -347,7 +347,9 @@ class DistributedTrainer(Trainer):
         finally:
             metrics.logger.close()
             if ckpt is not None:
-                ckpt.wait()  # async (orbax) saves must be durable on return
+                # durable async (orbax) saves + release the manager's
+                # background threads — one leaks per train() otherwise
+                ckpt.close()
         center = jax.device_get(self._state.center)
         self._fitted = FittedModel(self.master_model, center)
         self.record_training_stop()

@@ -101,6 +101,87 @@ def test_mha_layer_shapes_and_serialization():
         atol=1e-6)
 
 
+@pytest.mark.parametrize("kv_heads", [1, 2])
+def test_gqa_matches_repeated_kv_mha(kv_heads):
+    """GQA == classic MHA with the kv heads explicitly repeated per group
+    (exact: same f32 arithmetic, just grouped einsums)."""
+    rng = jax.random.PRNGKey(7)
+    ks = jax.random.split(rng, 3)
+    b, s, h, d = 2, 16, 4, 8
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, kv_heads, d))
+    v = jax.random.normal(ks[2], (b, s, kv_heads, d))
+    for causal in (False, True):
+        got = dot_product_attention(q, k, v, causal=causal)
+        want = dot_product_attention(q, jnp.repeat(k, h // kv_heads, axis=2),
+                                     jnp.repeat(v, h // kv_heads, axis=2),
+                                     causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-6)
+    # gradients flow to the shared kv heads
+    g = jax.grad(lambda k_: dot_product_attention(
+        q, k_, v, causal=True).sum())(k)
+    assert g.shape == k.shape and float(jnp.abs(g).sum()) > 0
+
+
+def test_gqa_head_mismatch_rejected():
+    q, k, v = rand_qkv(jax.random.PRNGKey(8), h=4)
+    with pytest.raises(ValueError, match="divisible"):
+        dot_product_attention(q, k[:, :, :3], v[:, :, :3])
+    with pytest.raises(ValueError, match="divisible"):
+        MultiHeadAttention(num_heads=4, key_dim=8, num_kv_heads=3)
+
+
+def test_gqa_layer_params_and_serialization():
+    """num_kv_heads shrinks wk/wv; spec round-trips; pre-GQA configs (no
+    num_kv_heads key) deserialize as classic MHA."""
+    layer = MultiHeadAttention(num_heads=4, key_dim=8, num_kv_heads=2)
+    params, _ = layer.init(jax.random.PRNGKey(0), (16, 32))
+    assert params["wq"].shape == (32, 32)
+    assert params["wk"].shape == (32, 16)  # 2 kv heads * key_dim 8
+    assert params["bv"].shape == (16,)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 32))
+    assert layer.apply(params, x, compute_dtype=jnp.float32).shape == \
+        (2, 16, 32)
+
+    model = Sequential(
+        [TransformerBlock(4, 8, 32, num_kv_heads=2), LayerNormalization()],
+        input_shape=(16, 32), compute_dtype="float32")
+    p = model.init(jax.random.PRNGKey(0))
+    clone = Sequential.from_json(model.to_json())
+    p2 = clone.init(jax.random.PRNGKey(0))
+    np.testing.assert_allclose(
+        np.asarray(model.apply(p, x)), np.asarray(clone.apply(p2, x)),
+        atol=1e-6)
+
+    # legacy config without the field -> classic MHA
+    from distkeras_tpu.core.layers import Layer
+    cfg = MultiHeadAttention(num_heads=4, key_dim=8).get_config()
+    cfg.pop("num_kv_heads", None)
+    legacy = Layer.from_config(cfg)
+    lp, _ = legacy.init(jax.random.PRNGKey(0), (16, 32))
+    assert lp["wk"].shape == (32, 32)
+
+
+def test_gqa_transformer_lm_trains():
+    """A GQA (2 kv heads / 4 q heads) tiny LM learns next-token like the
+    full-MHA one (same harness as test_transformer_lm_trains)."""
+    model = transformer_lm(vocab_size=16, seq_len=12, d_model=32,
+                           num_heads=4, num_layers=1, mlp_dim=64,
+                           compute_dtype="float32", num_kv_heads=2)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 16, (256, 12)).astype(np.int32)
+    y = (x + 1) % 16
+    ds = Dataset({"features": x, "label": y})
+    tr = SingleTrainer(model, batch_size=32, num_epoch=30,
+                       loss="sparse_categorical_crossentropy_from_logits",
+                       worker_optimizer="adam", learning_rate=3e-3)
+    fitted = tr.train(ds)
+    logits = fitted.predict(x[:64])
+    acc = (np.argmax(logits, -1) == y[:64]).mean()
+    assert acc > 0.9, acc
+
+
 def test_transformer_lm_trains():
     """Tiny causal LM learns a deterministic next-token rule (y = x+1 mod V)
     via SingleTrainer — the long-context model family rides the standard

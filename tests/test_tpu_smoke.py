@@ -107,6 +107,15 @@ for shape in ((2, 256, 4, 128), (2, 256, 4, 64), (2, 112, 4, 64)):
     err = float(jnp.max(jnp.abs(flash.astype(jnp.float32)
                                 - ref.astype(jnp.float32))))
     assert err < 0.05, (shape, err)  # bf16 tolerance
+
+# GQA through the pallas dispatch (k/v repeated up to H inside attention())
+q = jnp.asarray(rng.standard_normal((2, 256, 4, 64)), jnp.bfloat16)
+k, v = (jnp.asarray(rng.standard_normal((2, 256, 2, 64)), jnp.bfloat16)
+        for _ in range(2))
+gerr = float(jnp.max(jnp.abs(
+    attention(q, k, v, causal=True, impl="pallas").astype(jnp.float32)
+    - attention(q, k, v, causal=True, impl="xla").astype(jnp.float32))))
+assert gerr < 0.05, gerr
 print("SMOKE-FLASH-OK", err)
 
 def loss_flash(q, k, v):
