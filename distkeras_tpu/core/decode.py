@@ -1,0 +1,205 @@
+"""Autoregressive decoding with a KV cache for Sequential causal LMs.
+
+No reference counterpart (SURVEY.md §2.3: the reference has no sequence
+models at all) — this completes the long-context layer's inference story.
+Training materializes attention over the full sequence; decoding re-runs
+one token at a time against cached k/v, so each step is O(S) instead of
+O(S²), and with grouped-query attention (``MultiHeadAttention
+num_kv_heads``) the cache shrinks by ``num_heads / num_kv_heads``.
+
+Design: rather than adding an incremental-apply method to every layer, one
+walker here understands the sequence-model layer kinds (``Embedding``,
+``PositionalEmbedding``, ``TransformerBlock``, ``LayerNormalization``,
+``Dense``) and reuses their own helpers (``_project``,
+``LayerNormalization.apply``) plus ``ops.attention.dot_product_attention``
+(via its ``q_offset``/``kv_length`` hooks), so decode numerics ARE the
+full-forward numerics — there is no forked attention implementation.
+
+The walker is length-generic: ``generate`` prefills the whole prompt in
+ONE batched forward (MXU-shaped (B, P, D) matmuls, all P cache slots
+written in parallel), then scans single-token steps for the continuation.
+``decode_step`` is the jittable single-token form.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .layers import (Dense, Embedding, LayerNormalization,
+                     MultiHeadAttention, PositionalEmbedding,
+                     TransformerBlock, _apply_activation, _project)
+
+_STATELESS = (LayerNormalization, Dense)
+
+
+def _check_supported(model) -> None:
+    for layer in model.layers:
+        if not isinstance(layer, (Embedding, PositionalEmbedding,
+                                  TransformerBlock) + _STATELESS):
+            raise ValueError(
+                f"decode: unsupported layer kind {layer.kind!r} — KV-cache "
+                "decoding walks Embedding/PositionalEmbedding/"
+                "TransformerBlock/LayerNormalization/Dense sequences "
+                "(the transformer_lm family)")
+
+
+def _context_limit(model) -> Optional[int]:
+    for layer in model.layers:
+        if isinstance(layer, PositionalEmbedding):
+            return layer.max_len
+    return None
+
+
+def init_cache(model, batch: int, max_len: int) -> List[Any]:
+    """One cache slot per layer: ``{"k", "v"}`` of shape
+    (batch, max_len, num_kv_heads, key_dim) for TransformerBlocks, None
+    elsewhere.  Cache dtype = the model's compute dtype (bf16 on TPU)."""
+    _check_supported(model)
+    limit = _context_limit(model)
+    if limit is not None and max_len > limit:
+        raise ValueError(
+            f"cache max_len {max_len} exceeds the model's positional-"
+            f"embedding range {limit} — positions past it have no trained "
+            "embedding (the full forward rejects such sequences too)")
+    dtype = model._cdtype
+    caches: List[Any] = []
+    for layer in model.layers:
+        if isinstance(layer, TransformerBlock):
+            mha = layer._mha()
+            shape = (batch, max_len, mha._kv_heads(), mha.key_dim)
+            caches.append({"k": jnp.zeros(shape, dtype),
+                           "v": jnp.zeros(shape, dtype)})
+        else:
+            caches.append(None)
+    return caches
+
+
+def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype):
+    """Cached attention over (B, L, D) queries starting at position
+    ``pos``; writes k/v for those L positions into the cache and attends
+    through ``ops.attention.dot_product_attention`` (same numerics as the
+    training forward)."""
+    from ..ops.attention import dot_product_attention
+    b, length = h.shape[0], h.shape[1]
+    dh = mha.key_dim
+
+    def proj(name, heads):
+        bias = params.get("b" + name[1]) if mha.use_bias else None
+        y = _project(h, params[name], bias, cdtype)
+        return y.astype(cdtype).reshape(b, length, heads, dh)
+
+    q = proj("wq", mha.num_heads)
+    k_t = proj("wk", mha._kv_heads())
+    v_t = proj("wv", mha._kv_heads())
+    k = jax.lax.dynamic_update_slice(cache["k"], k_t, (0, pos, 0, 0))
+    v = jax.lax.dynamic_update_slice(cache["v"], v_t, (0, pos, 0, 0))
+    out = dot_product_attention(q, k, v, causal=True, q_offset=pos,
+                                kv_length=pos + length)
+    out = out.reshape(b, length, mha.num_heads * dh)
+    bias_o = params.get("bo") if mha.use_bias else None
+    y = _project(out, params["wo"], bias_o, cdtype)
+    return y, {"k": k, "v": v}
+
+
+def _block_forward(block: TransformerBlock, params, x, cache, pos, cdtype):
+    """Mirrors ``TransformerBlock.apply`` (train=False) with cached MHA."""
+    ln = LayerNormalization()
+    h = ln.apply(params["ln1"], x, compute_dtype=cdtype)
+    h, cache = _mha_forward(block._mha(), params["attn"], h, cache, pos,
+                            cdtype)
+    x = x + h.astype(x.dtype)
+    h = ln.apply(params["ln2"], x, compute_dtype=cdtype)
+    h = _project(h, params["mlp_w1"], params["mlp_b1"], cdtype)
+    h = _apply_activation(block.activation, h).astype(cdtype)
+    h = _project(h, params["mlp_w2"], params["mlp_b2"], cdtype)
+    return x + h.astype(x.dtype), cache
+
+
+def _forward(model, params, caches, toks, pos):
+    """Walk the layer stack over (B, L) tokens starting at position
+    ``pos``; returns ((B, L, V) f32 logits, new caches).  L == 1 is a
+    decode step, L == P is the batched prompt prefill."""
+    cdtype = model._cdtype
+    x = None
+    new_caches: List[Any] = []
+    for layer, p, cache in zip(model.layers, params, caches):
+        if isinstance(layer, Embedding):
+            # jnp.asarray: trained params may live as host numpy arrays
+            # (FittedModel), which tracer-indexing rejects
+            x = jnp.asarray(p["embedding"]).astype(cdtype)[toks]
+        elif isinstance(layer, PositionalEmbedding):
+            pe = jax.lax.dynamic_slice_in_dim(
+                jnp.asarray(p["embedding"]), pos, toks.shape[1])
+            x = x + pe.astype(x.dtype)[None]
+        elif isinstance(layer, TransformerBlock):
+            x, cache = _block_forward(layer, p, x, cache, pos, cdtype)
+        else:  # LayerNormalization / Dense: position-independent
+            x = layer.apply(p, x, compute_dtype=cdtype, train=False)
+        new_caches.append(cache)
+    return x.astype(jnp.float32), new_caches
+
+
+def decode_step(model, params, caches, tok, pos):
+    """Advance one position.  tok: (B,) int32 current tokens; pos: scalar
+    int32 position (0-based).  Returns (logits (B, V) f32, new caches).
+    Jittable — wrap in ``jax.jit`` (or let ``generate`` do it) for real
+    use."""
+    logits, caches = _forward(model, params, caches, tok[:, None], pos)
+    return logits[:, 0], caches
+
+
+def generate(model, params, prompt, num_steps: int,
+             temperature: float = 0.0, rng: Optional[jax.Array] = None,
+             max_len: Optional[int] = None) -> jnp.ndarray:
+    """Continue ``prompt`` (B, P) int tokens by ``num_steps`` tokens.
+
+    temperature 0 = greedy argmax; > 0 = softmax sampling (needs ``rng``).
+    Returns (B, P + num_steps) tokens.  Prefill is one batched forward;
+    the continuation is one compiled ``lax.scan`` of single-token steps.
+    """
+    _check_supported(model)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p_len = prompt.shape
+    total = p_len + int(num_steps)
+    if max_len is None:
+        max_len = total
+    if max_len < total:
+        raise ValueError(f"max_len {max_len} < prompt+steps {total}")
+    limit = _context_limit(model)
+    if limit is not None and total > limit:
+        raise ValueError(
+            f"prompt ({p_len}) + num_steps ({num_steps}) = {total} exceeds "
+            f"the model's positional-embedding range {limit}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 sampling needs rng")
+    caches = init_cache(model, b, max_len)
+
+    def sample(logits, pos):
+        if temperature > 0.0:
+            step_rng = jax.random.fold_in(rng, pos)
+            nxt = jax.random.categorical(step_rng, logits / temperature)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        return nxt.astype(jnp.int32)
+
+    # prefill: all P prompt positions in one batched forward
+    logits, caches = _forward(model, params, caches, prompt, 0)
+    first = sample(logits[:, -1], p_len - 1)
+    if num_steps <= 0:
+        return prompt
+
+    def body(carry, i):
+        caches, tok = carry
+        pos = p_len + i
+        logits, caches = decode_step(model, params, caches, tok, pos)
+        return (caches, sample(logits, pos)), tok
+
+    (caches, last), toks = jax.lax.scan(
+        body, (caches, first), jnp.arange(int(num_steps) - 1))
+    gen = jnp.concatenate(
+        [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1) \
+        if num_steps > 1 else first[:, None]
+    return jnp.concatenate([prompt, gen], axis=1)

@@ -24,7 +24,8 @@ NEG_INF = float("-inf")
 
 
 def dot_product_attention(q, k, v, *, causal: bool = False,
-                          scale: Optional[float] = None):
+                          scale: Optional[float] = None,
+                          q_offset=None, kv_length=None):
     """Softmax(q·kᵀ)·v with f32 softmax arithmetic.
 
     q: (B, Sq, H, Dh); k, v: (B, Sk, Hkv, Dh) → (B, Sq, H, Dh), in q.dtype.
@@ -32,6 +33,12 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     H/Hkv query heads shares one k/v head, shrinking the KV projection and —
     at decode time — the KV cache by the same factor.  Hkv == H is classic
     MHA; the grouped einsum below reduces to it at G == 1.
+
+    KV-cache decoding hooks (``core/decode.py`` — keeps decode on this
+    exact numerics path): ``q_offset`` places query i at absolute position
+    ``q_offset + i`` for the causal mask (queries continuing a cached
+    prefix); ``kv_length`` masks key slots >= it out of the softmax
+    (zero-filled tail of a preallocated cache).  Both accept tracers.
     """
     *_, d = q.shape
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
@@ -43,11 +50,14 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     qg = q.reshape(b, sq, hkv, g, d)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
                         preferred_element_type=jnp.float32) * scale
+    k_pos = jnp.arange(k.shape[1])
     if causal:
-        q_pos = jnp.arange(sq)
-        k_pos = jnp.arange(k.shape[1])
+        q_pos = jnp.arange(sq) + (0 if q_offset is None else q_offset)
         mask = k_pos[None, :] > q_pos[:, None]  # (Sq, Sk): True = hide
         scores = jnp.where(mask[None, None, None], NEG_INF, scores)
+    if kv_length is not None:
+        scores = jnp.where((k_pos < kv_length)[None, None, None, None],
+                           scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v)
     return out.reshape(b, sq, h, d)
