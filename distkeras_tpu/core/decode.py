@@ -44,6 +44,11 @@ def _check_supported(model) -> None:
                 "decoding walks Embedding/PositionalEmbedding/"
                 "TransformerBlock/LayerNormalization/Dense sequences "
                 "(the transformer_lm family)")
+        if isinstance(layer, TransformerBlock) and not layer.causal:
+            raise ValueError(
+                "decode: TransformerBlock(causal=False) — autoregressive "
+                "decoding is only meaningful for causal models, and the "
+                "cached step would silently diverge from the full forward")
 
 
 def _context_limit(model) -> Optional[int]:
@@ -163,6 +168,10 @@ def generate(model, params, prompt, num_steps: int,
     _check_supported(model)
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p_len = prompt.shape
+    if num_steps < 0:
+        raise ValueError(f"num_steps must be >= 0, got {num_steps}")
+    if num_steps == 0:
+        return prompt
     total = p_len + int(num_steps)
     if max_len is None:
         max_len = total
@@ -188,8 +197,6 @@ def generate(model, params, prompt, num_steps: int,
     # prefill: all P prompt positions in one batched forward
     logits, caches = _forward(model, params, caches, prompt, 0)
     first = sample(logits[:, -1], p_len - 1)
-    if num_steps <= 0:
-        return prompt
 
     def body(carry, i):
         caches, tok = carry

@@ -25,12 +25,90 @@ class AccuracyEvaluator(Evaluator):
         self.label_col = label_col
 
     def evaluate(self, dataset: Dataset) -> float:
-        pred = np.asarray(dataset[self.prediction_col]).reshape(-1)
+        pred, label = _pred_and_label(dataset, self.prediction_col,
+                                      self.label_col)
+        return float(np.mean(pred == label))
+
+
+def _pred_and_label(dataset: Dataset, prediction_col: str, label_col: str):
+    pred = np.asarray(dataset[prediction_col]).reshape(-1)
+    label = np.asarray(dataset[label_col])
+    if label.ndim > 1 and label.shape[-1] > 1:  # one-hot labels
+        label = np.argmax(label, axis=-1)
+    return pred.astype(np.int64), label.reshape(-1).astype(np.int64)
+
+
+class F1Evaluator(Evaluator):
+    """Precision / recall / F1 over predicted class indices (extra over the
+    reference, which ships accuracy only).
+
+    ``average``: ``"binary"`` (score class ``positive_label``), ``"macro"``
+    (unweighted mean of per-class scores over classes present in labels or
+    predictions), or ``"micro"`` (global counts — equals accuracy for
+    single-label classification).  ``metric`` picks ``"f1"`` (default),
+    ``"precision"`` or ``"recall"``; empty denominators score 0.
+    """
+
+    def __init__(self, average: str = "binary", metric: str = "f1",
+                 positive_label: int = 1,
+                 prediction_col: str = "prediction_index",
+                 label_col: str = "label"):
+        if average not in ("binary", "macro", "micro"):
+            raise ValueError(f"unknown average {average!r}")
+        if metric not in ("f1", "precision", "recall"):
+            raise ValueError(f"unknown metric {metric!r}")
+        self.average = average
+        self.metric = metric
+        self.positive_label = int(positive_label)
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    @staticmethod
+    def _scores(tp, fp, fn):
+        prec = tp / (tp + fp) if tp + fp else 0.0
+        rec = tp / (tp + fn) if tp + fn else 0.0
+        f1 = (2 * prec * rec / (prec + rec)) if prec + rec else 0.0
+        return {"precision": prec, "recall": rec, "f1": f1}
+
+    def evaluate(self, dataset: Dataset) -> float:
+        pred, label = _pred_and_label(dataset, self.prediction_col,
+                                      self.label_col)
+        if self.average == "binary":
+            classes = [self.positive_label]
+        else:
+            classes = np.union1d(np.unique(pred), np.unique(label))
+        per_class = []
+        total = np.zeros(3)
+        for c in classes:
+            tp = float(np.sum((pred == c) & (label == c)))
+            fp = float(np.sum((pred == c) & (label != c)))
+            fn = float(np.sum((pred != c) & (label == c)))
+            total += (tp, fp, fn)
+            per_class.append(self._scores(tp, fp, fn)[self.metric])
+        if self.average == "micro":
+            return float(self._scores(*total)[self.metric])
+        return float(np.mean(per_class))
+
+
+class TopKAccuracyEvaluator(Evaluator):
+    """Fraction of rows whose label is in the top-k of the predicted
+    probability/logit vector (``prediction`` column, not the argmax index)."""
+
+    def __init__(self, k: int = 5, prediction_col: str = "prediction",
+                 label_col: str = "label"):
+        self.k = int(k)
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataset: Dataset) -> float:
+        probs = np.asarray(dataset[self.prediction_col])
         label = np.asarray(dataset[self.label_col])
-        if label.ndim > 1 and label.shape[-1] > 1:  # one-hot labels
+        if label.ndim > 1 and label.shape[-1] > 1:
             label = np.argmax(label, axis=-1)
         label = label.reshape(-1)
-        return float(np.mean(pred == label))
+        k = min(self.k, probs.shape[-1])
+        topk = np.argpartition(-probs, k - 1, axis=-1)[:, :k]
+        return float(np.mean((topk == label[:, None]).any(axis=1)))
 
 
 class LossEvaluator(Evaluator):

@@ -96,6 +96,18 @@ def test_generate_sampling_and_validation():
     assert ((0 <= out) & (out < 16)).all()
     with pytest.raises(ValueError, match="max_len"):
         generate(model, params, prompt, 4, max_len=5)
+    with pytest.raises(ValueError, match="num_steps"):
+        generate(model, params, prompt, -2)
+    np.testing.assert_array_equal(
+        np.asarray(generate(model, params, prompt, 0)), prompt)
+    # encoder-style (non-causal) blocks are rejected: the cached step would
+    # silently diverge from the full bidirectional forward
+    from distkeras_tpu.core.layers import TransformerBlock, Embedding
+    from distkeras_tpu import Sequential
+    enc = Sequential([Embedding(16, 32), TransformerBlock(4, 8, 64)],
+                     input_shape=(8,), compute_dtype="float32")
+    with pytest.raises(ValueError, match="causal"):
+        init_cache(enc, 1, 8)
     # unsupported architectures are rejected up front
     from distkeras_tpu.core.layers import Conv2D
     from distkeras_tpu import Sequential

@@ -1,0 +1,73 @@
+"""Evaluator metrics (reference ships accuracy only — SURVEY.md §2.1 row
+20; F1/top-k are extras).  F1/precision/recall are cross-checked against
+scikit-learn's implementations on random predictions.
+"""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import (AccuracyEvaluator, Dataset, F1Evaluator,
+                           TopKAccuracyEvaluator)
+
+
+def make_ds(pred, label, **extra):
+    cols = {"prediction_index": np.asarray(pred),
+            "label": np.asarray(label)}
+    cols.update({k: np.asarray(v) for k, v in extra.items()})
+    return Dataset(cols)
+
+
+def test_f1_matches_sklearn():
+    sk = pytest.importorskip("sklearn.metrics")
+    rng = np.random.default_rng(0)
+    label = rng.integers(0, 4, 500)
+    pred = np.where(rng.random(500) < 0.7, label, rng.integers(0, 4, 500))
+    ds = make_ds(pred, label)
+    for average in ("macro", "micro"):
+        for metric, sk_fn in (("f1", sk.f1_score),
+                              ("precision", sk.precision_score),
+                              ("recall", sk.recall_score)):
+            got = F1Evaluator(average=average, metric=metric).evaluate(ds)
+            want = sk_fn(label, pred, average=average, zero_division=0)
+            np.testing.assert_allclose(got, want, atol=1e-9), (average,
+                                                               metric)
+    # binary on class 1
+    blabel = (label > 1).astype(int)
+    bpred = (pred > 1).astype(int)
+    bds = make_ds(bpred, blabel)
+    got = F1Evaluator(average="binary").evaluate(bds)
+    want = sk.f1_score(blabel, bpred, zero_division=0)
+    np.testing.assert_allclose(got, want, atol=1e-9)
+
+
+def test_f1_edge_cases():
+    # no positive predictions or labels -> 0, not NaN
+    ds = make_ds([0, 0, 0], [0, 0, 0])
+    assert F1Evaluator(average="binary").evaluate(ds) == 0.0
+    # micro == accuracy for single-label classification
+    ds2 = make_ds([0, 1, 2, 2], [0, 1, 1, 2], )
+    micro = F1Evaluator(average="micro").evaluate(ds2)
+    acc = AccuracyEvaluator().evaluate(ds2)
+    assert micro == acc == 0.75
+    # one-hot labels accepted
+    oh = np.eye(3)[[0, 1, 1, 2]]
+    ds3 = Dataset({"prediction_index": np.array([0, 1, 2, 2]), "label": oh})
+    assert F1Evaluator(average="micro").evaluate(ds3) == 0.75
+    with pytest.raises(ValueError, match="average"):
+        F1Evaluator(average="weighted")
+    with pytest.raises(ValueError, match="metric"):
+        F1Evaluator(metric="auc")
+
+
+def test_topk_accuracy():
+    probs = np.array([[0.5, 0.3, 0.2],    # top2 = {0, 1}
+                      [0.1, 0.2, 0.7],    # top2 = {2, 1}
+                      [0.4, 0.35, 0.25]])  # top2 = {0, 1}
+    label = np.array([1, 0, 2])
+    ds = Dataset({"prediction": probs, "label": label})
+    assert TopKAccuracyEvaluator(k=1).evaluate(ds) == 0.0
+    np.testing.assert_allclose(
+        TopKAccuracyEvaluator(k=2).evaluate(ds), 1 / 3)
+    assert TopKAccuracyEvaluator(k=3).evaluate(ds) == 1.0
+    # k larger than the class count clamps
+    assert TopKAccuracyEvaluator(k=10).evaluate(ds) == 1.0
