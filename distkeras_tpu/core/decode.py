@@ -102,7 +102,8 @@ def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype):
     k = jax.lax.dynamic_update_slice(cache["k"], k_t, (0, pos, 0, 0))
     v = jax.lax.dynamic_update_slice(cache["v"], v_t, (0, pos, 0, 0))
     out = dot_product_attention(q, k, v, causal=True, q_offset=pos,
-                                kv_length=pos + length)
+                                kv_length=pos + length,
+                                window=mha.attention_window)
     out = out.reshape(b, length, mha.num_heads * dh)
     bias_o = params.get("bo") if mha.use_bias else None
     y = _project(out, params["wo"], bias_o, cdtype)

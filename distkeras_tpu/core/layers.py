@@ -522,13 +522,15 @@ class MultiHeadAttention(Layer):
     and the decode-time KV cache by ``num_heads / num_kv_heads``.
     """
 
-    #: class-level default so pre-GQA serialized configs (which lack the
-    #: field; from_config bypasses __init__) deserialize as classic MHA
+    #: class-level defaults so older serialized configs (which lack these
+    #: fields; from_config bypasses __init__) deserialize as classic MHA
     num_kv_heads: Optional[int] = None  # None = same as num_heads
+    attention_window: Optional[int] = None  # None = full causal context
 
     def __init__(self, num_heads: int, key_dim: int, causal: bool = False,
                  use_bias: bool = True, attention_impl: Optional[str] = None,
-                 num_kv_heads: Optional[int] = None):
+                 num_kv_heads: Optional[int] = None,
+                 attention_window: Optional[int] = None):
         self.num_heads = int(num_heads)
         self.key_dim = int(key_dim)  # per-head dim
         self.causal = bool(causal)
@@ -540,6 +542,11 @@ class MultiHeadAttention(Layer):
                 raise ValueError(
                     f"num_heads={self.num_heads} not divisible by "
                     f"num_kv_heads={self.num_kv_heads}")
+        if attention_window is not None:
+            if not causal:
+                raise ValueError("attention_window (sliding window) "
+                                 "requires causal=True")
+            self.attention_window = int(attention_window)
 
     def _kv_heads(self) -> int:
         return (self.num_kv_heads if self.num_kv_heads is not None
@@ -577,7 +584,8 @@ class MultiHeadAttention(Layer):
         out = attention(proj("wq", self.num_heads),
                         proj("wk", self._kv_heads()),
                         proj("wv", self._kv_heads()),
-                        causal=self.causal, impl=self.attention_impl)
+                        causal=self.causal, impl=self.attention_impl,
+                        window=self.attention_window)
         out = out.reshape(b, s, self.num_heads * dh)
         bias_o = params.get("bo") if self.use_bias else None
         return _project(out, params["wo"], bias_o, compute_dtype)
@@ -590,14 +598,16 @@ class TransformerBlock(Layer):
     JSON-serializable like every other layer.
     """
 
-    #: class-level default mirrors MultiHeadAttention (pre-GQA configs)
+    #: class-level defaults mirror MultiHeadAttention (older configs)
     num_kv_heads: Optional[int] = None
+    attention_window: Optional[int] = None
 
     def __init__(self, num_heads: int, key_dim: int, mlp_dim: int,
                  dropout: float = 0.0, causal: bool = False,
                  activation: str = "gelu",
                  attention_impl: Optional[str] = None,
-                 num_kv_heads: Optional[int] = None):
+                 num_kv_heads: Optional[int] = None,
+                 attention_window: Optional[int] = None):
         self.num_heads = int(num_heads)
         self.key_dim = int(key_dim)
         self.mlp_dim = int(mlp_dim)
@@ -607,12 +617,18 @@ class TransformerBlock(Layer):
         self.attention_impl = attention_impl
         if num_kv_heads is not None:
             self.num_kv_heads = int(num_kv_heads)
+        if attention_window is not None:
+            if not causal:  # mirror MultiHeadAttention's eager check
+                raise ValueError("attention_window (sliding window) "
+                                 "requires causal=True")
+            self.attention_window = int(attention_window)
 
     def _mha(self) -> MultiHeadAttention:
         return MultiHeadAttention(self.num_heads, self.key_dim,
                                   causal=self.causal,
                                   attention_impl=self.attention_impl,
-                                  num_kv_heads=self.num_kv_heads)
+                                  num_kv_heads=self.num_kv_heads,
+                                  attention_window=self.attention_window)
 
     def init(self, rng, in_shape):
         s, d = in_shape

@@ -30,12 +30,16 @@ class AccuracyEvaluator(Evaluator):
         return float(np.mean(pred == label))
 
 
-def _pred_and_label(dataset: Dataset, prediction_col: str, label_col: str):
-    pred = np.asarray(dataset[prediction_col]).reshape(-1)
-    label = np.asarray(dataset[label_col])
+def _labels_1d(label: np.ndarray) -> np.ndarray:
     if label.ndim > 1 and label.shape[-1] > 1:  # one-hot labels
         label = np.argmax(label, axis=-1)
-    return pred.astype(np.int64), label.reshape(-1).astype(np.int64)
+    return label.reshape(-1).astype(np.int64)
+
+
+def _pred_and_label(dataset: Dataset, prediction_col: str, label_col: str):
+    pred = np.asarray(dataset[prediction_col]).reshape(-1)
+    label = _labels_1d(np.asarray(dataset[label_col]))
+    return pred.astype(np.int64), label
 
 
 class F1Evaluator(Evaluator):
@@ -96,16 +100,15 @@ class TopKAccuracyEvaluator(Evaluator):
 
     def __init__(self, k: int = 5, prediction_col: str = "prediction",
                  label_col: str = "label"):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
         self.k = int(k)
         self.prediction_col = prediction_col
         self.label_col = label_col
 
     def evaluate(self, dataset: Dataset) -> float:
         probs = np.asarray(dataset[self.prediction_col])
-        label = np.asarray(dataset[self.label_col])
-        if label.ndim > 1 and label.shape[-1] > 1:
-            label = np.argmax(label, axis=-1)
-        label = label.reshape(-1)
+        label = _labels_1d(np.asarray(dataset[self.label_col]))
         k = min(self.k, probs.shape[-1])
         topk = np.argpartition(-probs, k - 1, axis=-1)[:, :k]
         return float(np.mean((topk == label[:, None]).any(axis=1)))

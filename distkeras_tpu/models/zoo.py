@@ -81,7 +81,8 @@ def transformer_lm(vocab_size: int = 256, seq_len: int = 128,
                    d_model: int = 128, num_heads: int = 4,
                    num_layers: int = 2, mlp_dim: int = 512,
                    dropout: float = 0.0, compute_dtype: str = "bfloat16",
-                   attention_impl=None, num_kv_heads=None) -> Sequential:
+                   attention_impl=None, num_kv_heads=None,
+                   attention_window=None) -> Sequential:
     """Decoder-only causal transformer LM — the long-context flagship.
 
     No reference counterpart (SURVEY.md §2.3: attention/sequence models are
@@ -98,7 +99,7 @@ def transformer_lm(vocab_size: int = 256, seq_len: int = 128,
         layers.append(TransformerBlock(
             num_heads, d_model // num_heads, mlp_dim, dropout=dropout,
             causal=True, attention_impl=attention_impl,
-            num_kv_heads=num_kv_heads))
+            num_kv_heads=num_kv_heads, attention_window=attention_window))
     layers += [LayerNormalization(), Dense(vocab_size)]
     return Sequential(layers, input_shape=(seq_len,),
                       compute_dtype=compute_dtype, name="transformer_lm")

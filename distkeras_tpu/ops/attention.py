@@ -25,7 +25,8 @@ NEG_INF = float("-inf")
 
 def dot_product_attention(q, k, v, *, causal: bool = False,
                           scale: Optional[float] = None,
-                          q_offset=None, kv_length=None):
+                          q_offset=None, kv_length=None,
+                          window: Optional[int] = None):
     """Softmax(q·kᵀ)·v with f32 softmax arithmetic.
 
     q: (B, Sq, H, Dh); k, v: (B, Sk, Hkv, Dh) → (B, Sq, H, Dh), in q.dtype.
@@ -33,6 +34,12 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     H/Hkv query heads shares one k/v head, shrinking the KV projection and —
     at decode time — the KV cache by the same factor.  Hkv == H is classic
     MHA; the grouped einsum below reduces to it at G == 1.
+
+    ``window`` (requires ``causal``): sliding-window attention — query at
+    position p sees keys in (p - window, p], i.e. itself and the previous
+    ``window - 1`` tokens.  Long-context local attention with O(S·W)
+    effective work; information still propagates ``window`` tokens per
+    layer, so reach grows with depth.
 
     KV-cache decoding hooks (``core/decode.py`` — keeps decode on this
     exact numerics path): ``q_offset`` places query i at absolute position
@@ -46,6 +53,12 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     hkv = k.shape[2]
     if h % hkv:
         raise ValueError(f"num_heads {h} not divisible by kv heads {hkv}")
+    if window is not None:
+        if not causal:
+            raise ValueError("window (sliding-window attention) requires "
+                             "causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     g = h // hkv
     qg = q.reshape(b, sq, hkv, g, d)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
@@ -54,6 +67,8 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     if causal:
         q_pos = jnp.arange(sq) + (0 if q_offset is None else q_offset)
         mask = k_pos[None, :] > q_pos[:, None]  # (Sq, Sk): True = hide
+        if window is not None:
+            mask = mask | (k_pos[None, :] <= q_pos[:, None] - window)
         scores = jnp.where(mask[None, None, None], NEG_INF, scores)
     if kv_length is not None:
         scores = jnp.where((k_pos < kv_length)[None, None, None, None],
@@ -64,12 +79,19 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
 
 
 def attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
-              impl: Optional[str] = None):
+              impl: Optional[str] = None, window: Optional[int] = None):
     """Dispatching entry point used by the MultiHeadAttention layer."""
+    if window is not None and window >= k.shape[1]:
+        window = None  # covers every key: mathematically plain causal
+    if window is not None and impl != "xla":
+        # sliding-window masking isn't in the flash kernel (yet): route to
+        # XLA rather than silently ignoring the window
+        impl = "xla"
     if impl is None:
         impl = "pallas" if _pallas_eligible(q, k) else "xla"
     if impl == "xla":
-        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+        return dot_product_attention(q, k, v, causal=causal, scale=scale,
+                                     window=window)
     if impl == "pallas":
         from .flash_attention import flash_attention
         if k.shape[2] != q.shape[2]:

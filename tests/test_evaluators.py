@@ -71,3 +71,5 @@ def test_topk_accuracy():
     assert TopKAccuracyEvaluator(k=3).evaluate(ds) == 1.0
     # k larger than the class count clamps
     assert TopKAccuracyEvaluator(k=10).evaluate(ds) == 1.0
+    with pytest.raises(ValueError, match="k must be"):
+        TopKAccuracyEvaluator(k=0)
