@@ -509,6 +509,17 @@ def _project(x, kernel, bias, compute_dtype):
     return y
 
 
+def _validate_window(window: int, causal: bool) -> int:
+    """Eager attention_window validation shared by the attention layers
+    (the ops re-check at trace time with the same rule)."""
+    if not causal:
+        raise ValueError("attention_window (sliding window) requires "
+                         "causal=True")
+    if int(window) < 1:
+        raise ValueError(f"attention_window must be >= 1, got {window}")
+    return int(window)
+
+
 class MultiHeadAttention(Layer):
     """Multi-head self-attention on (B, S, D) inputs.
 
@@ -543,10 +554,8 @@ class MultiHeadAttention(Layer):
                     f"num_heads={self.num_heads} not divisible by "
                     f"num_kv_heads={self.num_kv_heads}")
         if attention_window is not None:
-            if not causal:
-                raise ValueError("attention_window (sliding window) "
-                                 "requires causal=True")
-            self.attention_window = int(attention_window)
+            self.attention_window = _validate_window(attention_window,
+                                                     causal)
 
     def _kv_heads(self) -> int:
         return (self.num_kv_heads if self.num_kv_heads is not None
@@ -618,10 +627,8 @@ class TransformerBlock(Layer):
         if num_kv_heads is not None:
             self.num_kv_heads = int(num_kv_heads)
         if attention_window is not None:
-            if not causal:  # mirror MultiHeadAttention's eager check
-                raise ValueError("attention_window (sliding window) "
-                                 "requires causal=True")
-            self.attention_window = int(attention_window)
+            self.attention_window = _validate_window(attention_window,
+                                                     causal)
 
     def _mha(self) -> MultiHeadAttention:
         return MultiHeadAttention(self.num_heads, self.key_dim,

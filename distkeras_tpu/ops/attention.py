@@ -37,9 +37,11 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
 
     ``window`` (requires ``causal``): sliding-window attention — query at
     position p sees keys in (p - window, p], i.e. itself and the previous
-    ``window - 1`` tokens.  Long-context local attention with O(S·W)
-    effective work; information still propagates ``window`` tokens per
-    layer, so reach grows with depth.
+    ``window - 1`` tokens.  Information still propagates ``window`` tokens
+    per layer, so reach grows with depth.  Here (the XLA path) the window
+    is mask-only — scores are computed then hidden; the flash kernel
+    (``flash_attention(window=...)``, used automatically on TPU) skips
+    out-of-window blocks outright for true O(S·W) compute.
 
     KV-cache decoding hooks (``core/decode.py`` — keeps decode on this
     exact numerics path): ``q_offset`` places query i at absolute position
@@ -81,12 +83,13 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
 def attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
               impl: Optional[str] = None, window: Optional[int] = None):
     """Dispatching entry point used by the MultiHeadAttention layer."""
+    if window is not None and not causal:
+        # validate before the window>=S normalization below, so the error
+        # doesn't depend on the window size
+        raise ValueError("window (sliding-window attention) requires "
+                         "causal=True")
     if window is not None and window >= k.shape[1]:
         window = None  # covers every key: mathematically plain causal
-    if window is not None and impl != "xla":
-        # sliding-window masking isn't in the flash kernel (yet): route to
-        # XLA rather than silently ignoring the window
-        impl = "xla"
     if impl is None:
         impl = "pallas" if _pallas_eligible(q, k) else "xla"
     if impl == "xla":
@@ -106,7 +109,8 @@ def attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
             g = q.shape[2] // k.shape[2]
             k = jnp.repeat(k, g, axis=2)
             v = jnp.repeat(v, g, axis=2)
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               window=window)
     raise ValueError(f"unknown attention impl {impl!r}")
 
 

@@ -52,15 +52,29 @@ NEG_INF = float("-inf")
 _LANES = 128  # TPU lane width: per-row stats are stored broadcast over it
 
 
-def _causal_mask(s, q0, k0, bq, bk):
+def _causal_mask(s, q0, k0, bq, bk, window=None):
     q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
     k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
-    return jnp.where(k_pos > q_pos, NEG_INF, s)
+    hide = k_pos > q_pos
+    if window is not None:  # sliding window: q sees (q_pos-window, q_pos]
+        hide = hide | (k_pos <= q_pos - window)
+    return jnp.where(hide, NEG_INF, s)
+
+
+def _live_kq(qi, kj, bq, bk, causal, window):
+    """Is k-block kj within reach of q-block qi?  Causal skips the future;
+    a sliding window additionally skips blocks entirely behind the window —
+    that drops compute to O(S·W) per head instead of the full causal
+    triangle."""
+    live = (kj * bk < (qi + 1) * bq) if causal else True
+    if window is not None:
+        live = live & ((kj + 1) * bk + window > qi * bq + 1)
+    return live
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   scale: float, causal: bool, block_q: int, block_k: int,
-                  num_k: int):
+                  num_k: int, window: Optional[int] = None):
     # outputs/scratch: [lse_ref,] m_scr, l_scr, acc_scr — the lse output only
     # exists on the training path (save_residuals); inference pays nothing
     lse_ref = rest[0] if len(rest) == 4 else None
@@ -75,8 +89,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     # causal: blocks entirely in the future of this q block contribute
-    # nothing — skip their compute (the standard flash causal saving)
-    live = (kj * bk < (qi + 1) * bq) if causal else True
+    # nothing — skip their compute (the standard flash causal saving);
+    # a window also skips blocks entirely behind it
+    live = _live_kq(qi, kj, bq, bk, causal, window)
 
     @pl.when(live)
     def _step():
@@ -86,7 +101,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(s, qi * bq, kj * bk, bq, bk)
+            s = _causal_mask(s, qi * bq, kj * bk, bq, bk, window)
         m = m_scr[:, 0:1]
         l = l_scr[:, 0:1]
         new_m = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -117,7 +132,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
 
 def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
                    block_k: int, interpret: bool,
-                   save_residuals: bool = True):
+                   save_residuals: bool = True,
+                   window: Optional[int] = None):
     b, s, h, d = q.shape
     bq = min(block_q, s)
     bk = min(block_k, s)
@@ -137,7 +153,8 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
 
     res = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, num_k=s // bk),
+                          block_q=bq, block_k=bk, num_k=s // bk,
+                          window=window),
         out_shape=tuple(out_shape),
         grid=(b * h, s // bq, s // bk),
         in_specs=[
@@ -159,7 +176,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
 
 def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr,
                *, scale: float, causal: bool, block_q: int, block_k: int,
-               num_k: int):
+               num_k: int, window: Optional[int] = None):
     qi, kj = pl.program_id(1), pl.program_id(2)
     bq, bk = block_q, block_k
 
@@ -167,7 +184,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    live = (kj * bk < (qi + 1) * bq) if causal else True
+    live = _live_kq(qi, kj, bq, bk, causal, window)
 
     @pl.when(live)
     def _step():
@@ -182,7 +199,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi * bq, kj * bk, bq, bk)
+            s = _causal_mask(s, qi * bq, kj * bk, bq, bk, window)
         p = jnp.exp(s - lse)                              # (bq, bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -198,7 +215,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_scr,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
                 dk_scr, dv_scr, *, scale: float, causal: bool, block_q: int,
-                block_k: int, num_q: int):
+                block_k: int, num_q: int, window: Optional[int] = None):
     ki, qi = pl.program_id(1), pl.program_id(2)
     bq, bk = block_q, block_k
 
@@ -207,8 +224,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    # causal: q blocks entirely before this k block see none of it
-    live = ((qi + 1) * bq > ki * bk) if causal else True
+    # causal: q blocks entirely before this k block see none of it; a
+    # window also skips q blocks entirely past this k block's reach
+    live = _live_kq(qi, ki, bq, bk, causal, window)
 
     @pl.when(live)
     def _step():
@@ -222,7 +240,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi * bq, ki * bk, bq, bk)
+            s = _causal_mask(s, qi * bq, ki * bk, bq, bk, window)
         p = jnp.exp(s - lse)                              # (bq, bk)
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -241,7 +259,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, scale: float, causal: bool,
-                    block_q: int, block_k: int, interpret: bool):
+                    block_q: int, block_k: int, interpret: bool,
+                    window: Optional[int] = None):
     b, s, h, d = q.shape
     bq = min(block_q, s)
     bk = min(block_k, s)
@@ -250,7 +269,8 @@ def _flash_backward(q, k, v, out, lse, g, scale: float, causal: bool,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, num_k=s // bk),
+                          block_q=bq, block_k=bk, num_k=s // bk,
+                          window=window),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
         grid=(b * h, s // bq, s // bk),
         in_specs=[
@@ -268,7 +288,8 @@ def _flash_backward(q, k, v, out, lse, g, scale: float, causal: bool,
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, num_q=s // bq),
+                          block_q=bq, block_k=bk, num_q=s // bq,
+                          window=window),
         out_shape=(jax.ShapeDtypeStruct(kf.shape, k.dtype),
                    jax.ShapeDtypeStruct(vf.shape, v.dtype)),
         grid=(b * h, s // bk, s // bq),
@@ -302,30 +323,39 @@ def _resolve(q, scale, interpret):
     return scale, interpret
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128, interpret: Optional[bool] = None):
+                    block_k: int = 128, interpret: Optional[bool] = None,
+                    window: Optional[int] = None):
     """Flash attention on (B, S, H, Dh) tensors; same contract as
-    ``ops.attention.dot_product_attention``."""
+    ``ops.attention.dot_product_attention``, including sliding-window
+    (``window``, requires causal) — out-of-window k blocks are skipped
+    entirely, so windowed compute is O(S·W) per head."""
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires "
+                         "causal=True")
     scale, interpret = _resolve(q, scale, interpret)
     out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                            interpret, save_residuals=False)
+                            interpret, save_residuals=False, window=window)
     return out
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret, window):
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires "
+                         "causal=True")
     scale, interpret = _resolve(q, scale, interpret)
     out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                              interpret)
+                              interpret, window=window)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _bwd(causal, scale, block_q, block_k, interpret, window, res, g):
     q, k, v, out, lse = res
     scale, interpret = _resolve(q, scale, interpret)
     return _flash_backward(q, k, v, out, lse, g, scale, causal,
-                           block_q, block_k, interpret)
+                           block_q, block_k, interpret, window=window)
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -38,8 +38,11 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
                    block_k: Optional[int] = None):
     """Collective attention over sequence shards — call *inside* shard_map.
 
-    q, k, v: local shards (B, S_local, H, Dh), sequence-sharded on
-    ``axis_name``.  Returns the local (B, S_local, H, Dh) output in q.dtype.
+    q: local shard (B, S_local, H, Dh); k, v: (B, S_local, Hkv, Dh) with
+    Hkv | H (grouped-query attention — k/v rotate the ring at Hkv heads,
+    so GQA shrinks the ppermute payload by H/Hkv too).  Sequence-sharded
+    on ``axis_name``; returns the local (B, S_local, H, Dh) output in
+    q.dtype.
 
     ``block_k``: chunk each rotation's local attend over k sub-blocks of
     this size (blockwise attention), bounding the score tensor at
@@ -50,11 +53,17 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"num_heads {h} not divisible by kv heads {hkv}")
+    g = h // hkv
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     if block_k is not None and s_loc % block_k:
         raise ValueError(f"S_local {s_loc} % block_k {block_k} != 0")
 
-    q32 = q.astype(jnp.float32) * scale
+    # grouped-query layout: accumulators carry (B, Hkv, G, Sq, ...) and
+    # collapse back to H = Hkv*G heads at the end; G == 1 is classic MHA
+    q32 = (q.astype(jnp.float32) * scale).reshape(b, s_loc, hkv, g, d)
     q_pos = idx * s_loc + jnp.arange(s_loc)
     # send-to-left rotation: after r steps the resident block originated at
     # ring position (idx + r) mod n
@@ -64,21 +73,21 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
         """One online-softmax update; ``k0`` = global position of
         k_blk[:, 0]."""
         num, den, mx = acc
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q32,
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", q32,
                             k_blk.astype(jnp.float32))
         if causal:
             k_pos = k0 + jnp.arange(k_blk.shape[1])
             hide = k_pos[None, :] > q_pos[:, None]
-            scores = jnp.where(hide[None, None], -jnp.inf, scores)
-        blk_max = jnp.max(scores, axis=-1)                     # (B,H,Sq)
+            scores = jnp.where(hide[None, None, None], -jnp.inf, scores)
+        blk_max = jnp.max(scores, axis=-1)                     # (B,Hkv,G,Sq)
         new_mx = jnp.maximum(mx, blk_max)
         # fully-masked-so-far rows keep mx = -inf; shift by 0 there so the
         # exps below stay NaN-free (e^{-inf-0} = 0)
         safe = jnp.where(jnp.isneginf(new_mx), 0.0, new_mx)
-        p = jnp.exp(scores - safe[..., None])                  # (B,H,Sq,Bk)
-        corr = jnp.exp(mx - safe)                              # (B,H,Sq)
+        p = jnp.exp(scores - safe[..., None])               # (B,Hkv,G,Sq,Bk)
+        corr = jnp.exp(mx - safe)                           # (B,Hkv,G,Sq)
         num = num * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+            "bhgqk,bkhd->bhgqd", p, v_blk.astype(jnp.float32))
         den = den * corr + jnp.sum(p, axis=-1)
         return num, den, new_mx
 
@@ -109,15 +118,17 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
     # over the ring axis so the scan carry types stay fixed once the online
     # update makes them data-dependent
     varying = lambda a: jax.lax.pcast(a, axis_name, to="varying")
-    acc0 = attend((varying(jnp.zeros((b, h, s_loc, d), jnp.float32)),
-                   varying(jnp.zeros((b, h, s_loc), jnp.float32)),
-                   varying(jnp.full((b, h, s_loc), -jnp.inf, jnp.float32))),
+    acc0 = attend((varying(jnp.zeros((b, hkv, g, s_loc, d), jnp.float32)),
+                   varying(jnp.zeros((b, hkv, g, s_loc), jnp.float32)),
+                   varying(jnp.full((b, hkv, g, s_loc), -jnp.inf,
+                                    jnp.float32))),
                   k, v, idx)                                    # own block
     (_, _, num, den, _), _ = jax.lax.scan(
         body, (k, v) + acc0, jnp.arange(1, n))
     den = jnp.where(den == 0.0, 1.0, den)
-    out = num / den[..., None]                                  # (B,H,Sq,Dh)
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    out = num / den[..., None]                               # (B,Hkv,G,Sq,Dh)
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, s_loc, h, d)
+    return out.astype(q.dtype)
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,

@@ -36,12 +36,15 @@ def run_point(batch: int, window: int, rows: int, timeout: float):
     line = None
     for cand in reversed((out.stdout or "").strip().splitlines()):
         try:
-            line = json.loads(cand)
-            break
+            parsed = json.loads(cand)
         except json.JSONDecodeError:
             continue
+        if isinstance(parsed, dict):  # a stray numeric line is not a result
+            line = parsed
+            break
     if line is None:
-        tail = (out.stderr or "").strip().splitlines()[-1:]
+        tail = (out.stderr or "").strip().splitlines()
+        tail = tail[-1] if tail else ""
         return {"batch": batch, "window": window,
                 "error": f"no JSON (rc={out.returncode} {tail})"}
     line.update(batch=batch, window=window)

@@ -45,6 +45,35 @@ def test_flash_gradients():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.parametrize("window", [1, 5, 16, 40])
+def test_flash_sliding_window_matches_reference(window):
+    """Windowed flash (multi-block: out-of-window k blocks skipped via
+    _live_kq) == windowed XLA reference, forward and all three grads."""
+    q, k, v = rand_qkv(7, b=1, s=64, h=2, d=8)
+    out = flash_attention(q, k, v, True, None, 16, 16, True, window)
+    want = dot_product_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-5)
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, True, None, 16, 16, True,
+                               window).sum()
+
+    def f_ref(q, k, v):
+        return dot_product_attention(q, k, v, causal=True,
+                                     window=window).sum()
+
+    gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_window_requires_causal():
+    q, k, v = rand_qkv(8, s=16)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, False, None, 16, 16, True, 4)
+
+
 def test_indivisible_seq_raises():
     q, k, v = rand_qkv(3, s=48)
     with pytest.raises(ValueError, match="not divisible"):

@@ -116,6 +116,14 @@ gerr = float(jnp.max(jnp.abs(
     attention(q, k, v, causal=True, impl="pallas").astype(jnp.float32)
     - attention(q, k, v, causal=True, impl="xla").astype(jnp.float32))))
 assert gerr < 0.05, gerr
+
+# sliding-window flash (out-of-window block skipping) on hardware
+werr = float(jnp.max(jnp.abs(
+    attention(q, k, v, causal=True, impl="pallas",
+              window=96).astype(jnp.float32)
+    - attention(q, k, v, causal=True, impl="xla",
+                window=96).astype(jnp.float32))))
+assert werr < 0.05, werr
 print("SMOKE-FLASH-OK", err)
 
 def loss_flash(q, k, v):
