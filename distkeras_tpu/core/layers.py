@@ -510,14 +510,11 @@ def _project(x, kernel, bias, compute_dtype):
 
 
 def _validate_window(window: int, causal: bool) -> int:
-    """Eager attention_window validation shared by the attention layers
-    (the ops re-check at trace time with the same rule)."""
-    if not causal:
-        raise ValueError("attention_window (sliding window) requires "
-                         "causal=True")
-    if int(window) < 1:
-        raise ValueError(f"attention_window must be >= 1, got {window}")
-    return int(window)
+    """Eager attention_window validation for the layers — delegates to the
+    one shared rule in ``ops.attention.validate_window`` (which the ops
+    re-apply at trace time)."""
+    from ..ops.attention import validate_window
+    return validate_window(window, causal)
 
 
 class MultiHeadAttention(Layer):

@@ -23,6 +23,19 @@ import jax.numpy as jnp
 NEG_INF = float("-inf")
 
 
+def validate_window(window: Optional[int], causal: bool) -> Optional[int]:
+    """The single sliding-window rule, shared by every attention entry
+    point (XLA, flash, ring, layers): requires causal, must be >= 1."""
+    if window is None:
+        return None
+    if not causal:
+        raise ValueError("window (sliding-window attention) requires "
+                         "causal=True")
+    if int(window) < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    return int(window)
+
+
 def dot_product_attention(q, k, v, *, causal: bool = False,
                           scale: Optional[float] = None,
                           q_offset=None, kv_length=None,
@@ -55,12 +68,7 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     hkv = k.shape[2]
     if h % hkv:
         raise ValueError(f"num_heads {h} not divisible by kv heads {hkv}")
-    if window is not None:
-        if not causal:
-            raise ValueError("window (sliding-window attention) requires "
-                             "causal=True")
-        if window < 1:
-            raise ValueError(f"window must be >= 1, got {window}")
+    window = validate_window(window, causal)
     g = h // hkv
     qg = q.reshape(b, sq, hkv, g, d)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
@@ -83,11 +91,9 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
 def attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
               impl: Optional[str] = None, window: Optional[int] = None):
     """Dispatching entry point used by the MultiHeadAttention layer."""
-    if window is not None and not causal:
-        # validate before the window>=S normalization below, so the error
-        # doesn't depend on the window size
-        raise ValueError("window (sliding-window attention) requires "
-                         "causal=True")
+    # validate before the window>=S normalization below, so the error
+    # doesn't depend on the window size
+    window = validate_window(window, causal)
     if window is not None and window >= k.shape[1]:
         window = None  # covers every key: mathematically plain causal
     if impl is None:

@@ -332,9 +332,8 @@ def flash_attention(q, k, v, causal: bool = False,
     ``ops.attention.dot_product_attention``, including sliding-window
     (``window``, requires causal) — out-of-window k blocks are skipped
     entirely, so windowed compute is O(S·W) per head."""
-    if window is not None and not causal:
-        raise ValueError("window (sliding-window attention) requires "
-                         "causal=True")
+    from .attention import validate_window
+    window = validate_window(window, causal)
     scale, interpret = _resolve(q, scale, interpret)
     out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
                             interpret, save_residuals=False, window=window)
@@ -342,9 +341,8 @@ def flash_attention(q, k, v, causal: bool = False,
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k, interpret, window):
-    if window is not None and not causal:
-        raise ValueError("window (sliding-window attention) requires "
-                         "causal=True")
+    from .attention import validate_window
+    window = validate_window(window, causal)
     scale, interpret = _resolve(q, scale, interpret)
     out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
                               interpret, window=window)

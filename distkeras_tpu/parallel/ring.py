@@ -35,7 +35,8 @@ SEQ_AXIS = "seq"
 
 def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
                    causal: bool = False, scale: Optional[float] = None,
-                   block_k: Optional[int] = None):
+                   block_k: Optional[int] = None,
+                   window: Optional[int] = None):
     """Collective attention over sequence shards — call *inside* shard_map.
 
     q: local shard (B, S_local, H, Dh); k, v: (B, S_local, Hkv, Dh) with
@@ -49,6 +50,12 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
     (B, H, S_local, block_k) instead of (B, H, S_local, S_local) — the
     long-context memory knob when local shards are themselves large.  The
     math is identical (same online-softmax recurrence, finer grain).
+
+    ``window`` (requires ``causal``): sliding-window masking on global
+    positions — query p sees keys in (p - window, p], consistent with
+    ``ops.attention.dot_product_attention(window=...)``.  Rotations whose
+    block is entirely out of window still run (SPMD-uniform schedule) but
+    contribute zeros.
     """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -56,6 +63,8 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
     hkv = k.shape[2]
     if h % hkv:
         raise ValueError(f"num_heads {h} not divisible by kv heads {hkv}")
+    from ..ops.attention import validate_window
+    window = validate_window(window, causal)
     g = h // hkv
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     if block_k is not None and s_loc % block_k:
@@ -78,6 +87,8 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
         if causal:
             k_pos = k0 + jnp.arange(k_blk.shape[1])
             hide = k_pos[None, :] > q_pos[:, None]
+            if window is not None:
+                hide = hide | (k_pos[None, :] <= q_pos[:, None] - window)
             scores = jnp.where(hide[None, None, None], -jnp.inf, scores)
         blk_max = jnp.max(scores, axis=-1)                     # (B,Hkv,G,Sq)
         new_mx = jnp.maximum(mx, blk_max)
@@ -134,14 +145,15 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
 def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
                         causal: bool = False,
                         scale: Optional[float] = None,
-                        block_k: Optional[int] = None):
+                        block_k: Optional[int] = None,
+                        window: Optional[int] = None):
     """Convenience wrapper: global (B, S, H, Dh) arrays in, sequence sharded
     over ``mesh[axis_name]``, ring attention, global array out.  For models
     already running under shard_map, call ``ring_attention`` directly."""
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
         lambda a, b_, c: ring_attention(a, b_, c, axis_name, causal, scale,
-                                        block_k),
+                                        block_k, window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     sharding = NamedSharding(mesh, spec)
     return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),

@@ -70,36 +70,44 @@ def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
                       head_dim: int, axis_name: str = MODEL_AXIS,
                       seq_axis: Optional[str] = None, causal: bool = True,
                       compute_dtype=jnp.bfloat16,
-                      ring_block_k: Optional[int] = None):
+                      ring_block_k: Optional[int] = None,
+                      num_local_kv_heads: Optional[int] = None,
+                      window: Optional[int] = None):
     """Head-parallel self-attention: each model-axis shard owns
     ``num_local_heads`` heads end to end (qkv column-split by head, local
     attention, output row-split) — one psum per block.  With ``seq_axis``
     set, attention itself runs as a ring over that mesh axis (sequence
     parallelism composing with tensor parallelism).
 
-    x: (B, S_local, D) replicated over 'model'; wq/wk/wv: (D, local_heads·Dh)
-    shards; wo: (local_heads·Dh, D) shard.
+    x: (B, S_local, D) replicated over 'model'; wq: (D, local_heads·Dh)
+    shard; wk/wv: (D, local_kv_heads·Dh) shards; wo: (local_heads·Dh, D)
+    shard.  ``num_local_kv_heads`` (default = ``num_local_heads``) gives
+    grouped-query attention per shard — each shard keeps whole kv-head
+    groups, so GQA composes with head parallelism as long as the global
+    kv head count divides by the model-axis size.  ``window``: sliding-
+    window masking (requires causal), same semantics as ``ops.attention``.
     """
     from .ring import ring_attention
     from ..ops.attention import attention
 
     b, s, _ = x.shape
     h, dh = num_local_heads, head_dim
+    hkv = num_local_kv_heads if num_local_kv_heads is not None else h
 
-    def proj(w):
+    def proj(w, heads):
         y = column_parallel_dense(x, w, compute_dtype=compute_dtype)
-        return y.astype(compute_dtype).reshape(b, s, h, dh)
+        return y.astype(compute_dtype).reshape(b, s, heads, dh)
 
-    q, k, v = proj(wq), proj(wk), proj(wv)
+    q, k, v = proj(wq, h), proj(wk, hkv), proj(wv, hkv)
     if seq_axis is not None:
         # ring_block_k: blockwise chunking of each rotation's local attend —
         # the long-context memory knob when local shards are large
         out = ring_attention(q, k, v, seq_axis, causal=causal,
-                             block_k=ring_block_k)
+                             block_k=ring_block_k, window=window)
     else:
         # dispatcher: the fused Pallas flash kernel on TPU when the local
         # shapes qualify, the XLA reference otherwise
-        out = attention(q, k, v, causal=causal)
+        out = attention(q, k, v, causal=causal, window=window)
     out = out.reshape(b, s, h * dh)
     return row_parallel_dense(out, wo, axis_name=axis_name,
                               compute_dtype=compute_dtype)

@@ -45,6 +45,8 @@ class ParallelTransformerLM:
                  capacity_factor: float = 2.0,
                  compute_dtype=jnp.bfloat16, remat: bool = False,
                  ring_block_k: Optional[int] = None,
+                 num_kv_heads: Optional[int] = None,
+                 attention_window: Optional[int] = None,
                  data_axis: str = "data", seq_axis: str = "seq",
                  model_axis: str = "model"):
         self.vocab_size = vocab_size
@@ -67,6 +69,20 @@ class ParallelTransformerLM:
         self.dp = mesh.shape[data_axis]
         if num_heads % self.tp:
             raise ValueError(f"num_heads {num_heads} % tp {self.tp} != 0")
+        # GQA over tensor parallelism: every shard keeps whole kv-head
+        # groups, so the kv head count must divide both H and the tp size
+        self.num_kv_heads = (int(num_kv_heads) if num_kv_heads is not None
+                             else num_heads)
+        if num_heads % self.num_kv_heads:
+            raise ValueError(f"num_heads {num_heads} % num_kv_heads "
+                             f"{self.num_kv_heads} != 0")
+        if self.num_kv_heads % self.tp:
+            raise ValueError(f"num_kv_heads {self.num_kv_heads} % tp "
+                             f"{self.tp} != 0 (each model shard needs whole "
+                             "kv heads)")
+        from ..ops.attention import validate_window
+        self.attention_window = validate_window(attention_window,
+                                                causal=True)
         if mlp_dim % self.tp:
             raise ValueError(f"mlp_dim {mlp_dim} % tp {self.tp} != 0")
         if seq_len % self.sp:
@@ -80,13 +96,14 @@ class ParallelTransformerLM:
     # -- params + specs -------------------------------------------------------
     def _layer_shapes(self, i: int):
         d, f, hd = self.d_model, self.mlp_dim, self.num_heads * self.head_dim
+        hd_kv = self.num_kv_heads * self.head_dim
         _, _, model = self.axes
         shapes = {
             "ln1": ((d,), P()),
             "ln2": ((d,), P()),
             "wq": ((d, hd), P(None, model)),
-            "wk": ((d, hd), P(None, model)),
-            "wv": ((d, hd), P(None, model)),
+            "wk": ((d, hd_kv), P(None, model)),
+            "wv": ((d, hd_kv), P(None, model)),
             "wo": ((hd, d), P(model, None)),
         }
         if i in self.moe_layers:
@@ -184,7 +201,9 @@ class ParallelTransformerLM:
                     num_local_heads=self.num_heads // self.tp,
                     head_dim=self.head_dim, axis_name=model_axis,
                     seq_axis=seq_axis, causal=True, compute_dtype=cdt,
-                    ring_block_k=self.ring_block_k)
+                    ring_block_k=self.ring_block_k,
+                    num_local_kv_heads=self.num_kv_heads // self.tp,
+                    window=self.attention_window)
                 x = x + attn.astype(cdt)
                 h = ln(lp["ln2"], x)
                 if i in self.moe_layers:

@@ -91,6 +91,22 @@ def test_ring_attention_gqa_matches_full(eight_devices, kv_heads):
                                atol=1e-4)
 
 
+def test_ring_attention_window_matches_full(eight_devices):
+    """Sliding window through the ring (global-position masking across
+    rotating blocks) == windowed full attention, incl. blockwise."""
+    mesh = get_mesh(8, axis_name="seq")
+    q, k, v = rand_qkv(jax.random.PRNGKey(13), b=2, s=64, h=2, d=16)
+    for block_k in (None, 4):
+        out = ring_self_attention(q, k, v, mesh, axis_name="seq",
+                                  causal=True, block_k=block_k, window=12)
+        want = dot_product_attention(q, k, v, causal=True, window=12)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=1e-5)
+    with pytest.raises(ValueError, match="causal"):
+        ring_self_attention(q, k, v, mesh, axis_name="seq", causal=False,
+                            window=12)
+
+
 def test_ring_attention_grads_match(eight_devices):
     """d(sum(out))/dq through the ring collective == through full attention."""
     mesh = get_mesh(8, axis_name="seq")

@@ -61,6 +61,27 @@ def test_sharded_matches_single_device(eight_devices, moe):
     np.testing.assert_allclose(l8, l1, rtol=2e-4)
 
 
+def test_gqa_window_sharded_matches_single_device(eight_devices):
+    """GQA (2 kv heads over 4 q heads) + sliding window on the 2×2×2 mesh
+    == the same model on a 1×1×1 mesh; kv shards are kv-head sized."""
+    kw = dict(num_heads=4, num_kv_heads=2, attention_window=8, d_model=16)
+    l8, p8 = run_steps(make_lm(mesh_of((2, 2, 2)), **kw), 3)
+    l1, _ = run_steps(make_lm(mesh_of((1, 1, 1)), **kw), 3)
+    np.testing.assert_allclose(l8, l1, rtol=2e-4)
+    wk = p8["layers"][0]["wk"]
+    # (d, Hkv·Dh) = (16, 2*4) split over tp=2 -> local (16, 4)
+    assert wk.addressable_shards[0].data.shape == (16, 4)
+
+
+def test_gqa_tp_divisibility_validated(eight_devices):
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        make_lm(mesh_of((2, 2, 2)), num_heads=4, num_kv_heads=3)
+    with pytest.raises(ValueError, match="kv heads"):
+        make_lm(mesh_of((2, 2, 2)), num_heads=4, num_kv_heads=1)
+    with pytest.raises(ValueError, match="window must be"):
+        make_lm(mesh_of((2, 2, 2)), attention_window=0)
+
+
 def test_training_converges(eight_devices):
     losses, _ = run_steps(
         make_lm(mesh_of((2, 2, 2)), moe_layers=(1,), num_experts=2), 30)
