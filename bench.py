@@ -58,7 +58,16 @@ def probe_backend(timeout_s: float = 150.0):
 
 
 def main():
+    t_start = time.perf_counter()
+    debug = os.environ.get("DISTKERAS_BENCH_DEBUG", "") == "1"
+
+    def stage(name):
+        if debug:
+            print(f"[bench {time.perf_counter() - t_start:7.1f}s] {name}",
+                  file=sys.stderr, flush=True)
+
     probed_platform, _, note = probe_backend()
+    stage(f"probe done: platform={probed_platform} note={note}")
     if note is not None:  # probe failed: force this process onto CPU
         os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -69,16 +78,13 @@ def main():
     import jax
     import numpy as np
 
-    # Persistent compilation cache: the epoch program is identical across
-    # bench runs, and XLA:CPU takes ~3 min to compile the conv train step
-    # (the TPU compile is ~30 s) — cache it so only the first-ever run
-    # pays.  Repo-local dir, gitignored.
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(_REPO, ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass  # older jax without the knobs: bench still runs, uncached
+    # NOTE deliberately NO persistent compilation cache: in this sandbox
+    # processes run with differing XLA target-machine flag sets (the
+    # accelerator plugin toggles cpu feature preferences), and a cached
+    # CPU AOT executable from one flag set loads under another with
+    # "machine type doesn't match" errors and then misbehaves (observed:
+    # hangs).  Compile cost is bounded instead by the small fallback
+    # configuration below.
 
     from distkeras_tpu.data.datasets import has_real_data, load_mnist
     from distkeras_tpu.metrics import flops_per_example, peak_flops
@@ -86,22 +92,26 @@ def main():
     from distkeras_tpu.parallel.mesh import get_mesh
     from distkeras_tpu.parallel.spmd import SPMDEngine, shape_epoch_data
 
-    batch = int(os.environ.get("DISTKERAS_BENCH_BATCH", "128"))
-    window = int(os.environ.get("DISTKERAS_BENCH_WINDOW", "12"))
-    # CPU fallback (accelerator probe failed): shrink the default epoch and
-    # run float32 (CPU emulates bf16 in software, several times slower and
-    # meaningless as a TPU proxy) so the bench still finishes within a
-    # driver timeout.  The artifact's platform/compute_dtype fields label
-    # the configuration either way.
-    # ...whether by probe failure or because only a CPU is present (e.g. a
-    # deliberate JAX_PLATFORMS=cpu baseline run)
+    # CPU fallback — probe failure or a cpu-only platform (e.g. deliberate
+    # JAX_PLATFORMS=cpu): shrink every knob.  float32 (CPU emulates bf16 in
+    # software, several times slower and meaningless as a TPU proxy),
+    # smaller batch/window (XLA:CPU compile of the batch-128 conv epoch
+    # program takes ~3 min; the small program compiles in well under a
+    # minute), small epoch.  Throughput is per-row either way, and the
+    # artifact's platform/compute_dtype/batch fields label the
+    # configuration.
     fallback = note is not None or probed_platform == "cpu"
-    default_rows = "60000" if not fallback else "4096"
-    n_rows = int(os.environ.get("DISTKERAS_BENCH_ROWS", default_rows))
+    batch = int(os.environ.get("DISTKERAS_BENCH_BATCH",
+                               "128" if not fallback else "32"))
+    window = int(os.environ.get("DISTKERAS_BENCH_WINDOW",
+                                "12" if not fallback else "4"))
+    n_rows = int(os.environ.get("DISTKERAS_BENCH_ROWS",
+                                "60000" if not fallback else "1024"))
     dtype = "float32" if fallback else "bfloat16"
 
     mesh = get_mesh()
     n = mesh.devices.size
+    stage(f"mesh ready: n={n} platform={jax.devices()[0].platform}")
     model = mnist_convnet(dtype)
     engine = SPMDEngine(model, "categorical_crossentropy", "adam", mesh,
                         "adag", communication_window=window)
@@ -113,6 +123,12 @@ def main():
     xb, yb, mb, rounds = shape_epoch_data(x, y, n, window, batch)
 
     state = engine.init_state(jax.random.PRNGKey(0), (784,))
+    # Re-place the fresh state with the exact shardings the epoch outputs
+    # carry (the checkpoint-restore path): the first call then compiles for
+    # the same layouts as every later call — ONE compile instead of a
+    # host-committed + donated pair.  XLA:CPU takes ~2.5 min per compile of
+    # this program (single-threaded here), TPU ~30 s; both halve.
+    state = engine.put_state(jax.device_get(state))
     rngs = engine.worker_rngs(0)
 
     # The whole epoch's data lives in HBM across epochs (188 MB at MNIST
@@ -124,23 +140,39 @@ def main():
     mb = jax.device_put(mb, sh)
     epoch_fn = engine._build_epoch_fn()
 
-    # warmup twice: the first call compiles for host-committed inputs, the
-    # second for the donated-state buffer layouts.
-    for _ in range(2):
+    stage("data placed; warming up")
+    # one warmup compiles (state already carries the steady-state layouts);
+    # a second run on the non-fallback path double-checks layout stability
+    # cheaply (~70 ms on TPU) — on CPU every epoch costs minutes, skip it
+    for i in range(1 if fallback else 2):
         state, losses = epoch_fn(state, xb, yb, mb, rngs)
         assert np.isfinite(np.asarray(losses)).all()
+        stage(f"warmup {i} done")
 
     # Estimate per-epoch wall time (host fetch included) to size a ~3.5 s
-    # run; min of two samples so one transient tunnel stall can't collapse
-    # the rep count, and a floor of 8 reps keeps the final-fetch round-trip
-    # amortized to <= 1/8 of an epoch even if the estimate is way off.
+    # run.  Accelerator path: min of two samples (one transient tunnel
+    # stall can't collapse the rep count) and a floor of 8 reps amortizes
+    # the final-fetch round-trip to <= 1/8 of an epoch.  CPU fallback: one
+    # sample, and the budget cap below may cut reps to 1 — precision is
+    # traded away so the artifact exists at all (epochs cost minutes).
     est = float("inf")
-    for _ in range(2):
+    for _ in range(1 if fallback else 2):
         t0 = time.perf_counter()
         state, losses = epoch_fn(state, xb, yb, mb, rngs)
         np.asarray(losses)
         est = min(est, time.perf_counter() - t0)
+        stage(f"est epoch: {time.perf_counter() - t0:.2f}s")
     reps = max(8, min(200, int(round(3.5 / est))))
+
+    # Hard wall-clock budget (DISTKERAS_BENCH_BUDGET seconds, default 540):
+    # whatever compile/probe already cost, cap the timed region so the
+    # driver's run always produces its JSON line instead of timing out.
+    # On the TPU this never binds (epochs are ~70 ms); it exists for the
+    # CPU fallback, where XLA compile alone can eat several minutes.
+    budget = float(os.environ.get("DISTKERAS_BENCH_BUDGET", "540"))
+    remaining = budget - (time.perf_counter() - t_start)
+    reps = max(1, min(reps, int(remaining / max(est, 1e-9))))
+    stage(f"est={est:.2f}s reps={reps} (remaining budget {remaining:.0f}s)")
 
     # Timed region: dispatch the whole run as one donation-chained sequence
     # and materialize once at the end.  Each epoch depends on the previous
@@ -186,6 +218,9 @@ def main():
         "device_kind": device_kind,
         "data": data_kind,
         "compute_dtype": dtype,
+        "batch": batch,
+        "window": window,
+        "rows": len(x),
         "flops_per_example": flops_ex,
     }))
 

@@ -48,6 +48,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .attention import validate_window
+
 NEG_INF = float("-inf")
 _LANES = 128  # TPU lane width: per-row stats are stored broadcast over it
 
@@ -332,7 +334,6 @@ def flash_attention(q, k, v, causal: bool = False,
     ``ops.attention.dot_product_attention``, including sliding-window
     (``window``, requires causal) — out-of-window k blocks are skipped
     entirely, so windowed compute is O(S·W) per head."""
-    from .attention import validate_window
     window = validate_window(window, causal)
     scale, interpret = _resolve(q, scale, interpret)
     out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
@@ -341,7 +342,6 @@ def flash_attention(q, k, v, causal: bool = False,
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k, interpret, window):
-    from .attention import validate_window
     window = validate_window(window, causal)
     scale, interpret = _resolve(q, scale, interpret)
     out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,

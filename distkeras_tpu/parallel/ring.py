@@ -57,14 +57,14 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
     block is entirely out of window still run (SPMD-uniform schedule) but
     contribute zeros.
     """
+    from ..ops.attention import validate_window
+    window = validate_window(window, causal)
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     hkv = k.shape[2]
     if h % hkv:
         raise ValueError(f"num_heads {h} not divisible by kv heads {hkv}")
-    from ..ops.attention import validate_window
-    window = validate_window(window, causal)
     g = h // hkv
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     if block_k is not None and s_loc % block_k:
