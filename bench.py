@@ -17,8 +17,11 @@ timeout first — if the probe crashes or hangs (round-1 failure mode: axon
 tunnel down -> rc=1, parsed=null), the bench falls back to CPU and labels
 the platform explicitly instead of dying.
 
-Steady-state timing: two warmup epochs (compile for host-committed and
-donated buffer layouts), then full epochs are timed for ~3 s.
+Steady-state timing: the initial state is placed with its steady-state
+shardings so ONE warmup epoch compiles the one program every later call
+reuses; then full epochs are timed for ~3 s, capped by a hard wall-clock
+budget (DISTKERAS_BENCH_BUDGET, default 540 s) so the artifact always
+exists.  DISTKERAS_BENCH_DEBUG=1 streams stage timings to stderr.
 """
 
 import json
