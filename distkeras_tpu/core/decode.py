@@ -99,6 +99,13 @@ def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype):
     q = proj("wq", mha.num_heads)
     k_t = proj("wk", mha._kv_heads())
     v_t = proj("wv", mha._kv_heads())
+    if mha.rope:
+        # rotate by the suffix's ABSOLUTE positions; cached k stay rotated
+        # by their own positions (RoPE scores depend only on distance)
+        from ..ops.rope import apply_rope
+        positions = pos + jnp.arange(length)
+        q = apply_rope(q, positions)
+        k_t = apply_rope(k_t, positions)
     k = jax.lax.dynamic_update_slice(cache["k"], k_t, (0, pos, 0, 0))
     v = jax.lax.dynamic_update_slice(cache["v"], v_t, (0, pos, 0, 0))
     out = dot_product_attention(q, k, v, causal=True, q_offset=pos,

@@ -534,11 +534,13 @@ class MultiHeadAttention(Layer):
     #: fields; from_config bypasses __init__) deserialize as classic MHA
     num_kv_heads: Optional[int] = None  # None = same as num_heads
     attention_window: Optional[int] = None  # None = full causal context
+    rope: bool = False  # rotary position embeddings on q/k
 
     def __init__(self, num_heads: int, key_dim: int, causal: bool = False,
                  use_bias: bool = True, attention_impl: Optional[str] = None,
                  num_kv_heads: Optional[int] = None,
-                 attention_window: Optional[int] = None):
+                 attention_window: Optional[int] = None,
+                 rope: bool = False):
         self.num_heads = int(num_heads)
         self.key_dim = int(key_dim)  # per-head dim
         self.causal = bool(causal)
@@ -553,6 +555,10 @@ class MultiHeadAttention(Layer):
         if attention_window is not None:
             self.attention_window = _validate_window(attention_window,
                                                      causal)
+        if rope:
+            from ..ops.rope import validate_rope_dim
+            validate_rope_dim(self.key_dim)
+            self.rope = True
 
     def _kv_heads(self) -> int:
         return (self.num_kv_heads if self.num_kv_heads is not None
@@ -587,9 +593,14 @@ class MultiHeadAttention(Layer):
             y = _project(x, params[name], bias, compute_dtype)
             return y.astype(compute_dtype).reshape(b, s, heads, dh)
 
-        out = attention(proj("wq", self.num_heads),
-                        proj("wk", self._kv_heads()),
-                        proj("wv", self._kv_heads()),
+        q = proj("wq", self.num_heads)
+        k = proj("wk", self._kv_heads())
+        v = proj("wv", self._kv_heads())
+        if self.rope:
+            from ..ops.rope import apply_rope
+            pos = jnp.arange(s)
+            q, k = apply_rope(q, pos), apply_rope(k, pos)
+        out = attention(q, k, v,
                         causal=self.causal, impl=self.attention_impl,
                         window=self.attention_window)
         out = out.reshape(b, s, self.num_heads * dh)
@@ -607,13 +618,15 @@ class TransformerBlock(Layer):
     #: class-level defaults mirror MultiHeadAttention (older configs)
     num_kv_heads: Optional[int] = None
     attention_window: Optional[int] = None
+    rope: bool = False
 
     def __init__(self, num_heads: int, key_dim: int, mlp_dim: int,
                  dropout: float = 0.0, causal: bool = False,
                  activation: str = "gelu",
                  attention_impl: Optional[str] = None,
                  num_kv_heads: Optional[int] = None,
-                 attention_window: Optional[int] = None):
+                 attention_window: Optional[int] = None,
+                 rope: bool = False):
         self.num_heads = int(num_heads)
         self.key_dim = int(key_dim)
         self.mlp_dim = int(mlp_dim)
@@ -626,13 +639,18 @@ class TransformerBlock(Layer):
         if attention_window is not None:
             self.attention_window = _validate_window(attention_window,
                                                      causal)
+        if rope:
+            from ..ops.rope import validate_rope_dim
+            validate_rope_dim(self.key_dim)  # eager, like MultiHeadAttention
+            self.rope = True
 
     def _mha(self) -> MultiHeadAttention:
         return MultiHeadAttention(self.num_heads, self.key_dim,
                                   causal=self.causal,
                                   attention_impl=self.attention_impl,
                                   num_kv_heads=self.num_kv_heads,
-                                  attention_window=self.attention_window)
+                                  attention_window=self.attention_window,
+                                  rope=self.rope)
 
     def init(self, rng, in_shape):
         s, d = in_shape

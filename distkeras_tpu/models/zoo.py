@@ -82,7 +82,8 @@ def transformer_lm(vocab_size: int = 256, seq_len: int = 128,
                    num_layers: int = 2, mlp_dim: int = 512,
                    dropout: float = 0.0, compute_dtype: str = "bfloat16",
                    attention_impl=None, num_kv_heads=None,
-                   attention_window=None) -> Sequential:
+                   attention_window=None,
+                   positional: str = "learned") -> Sequential:
     """Decoder-only causal transformer LM — the long-context flagship.
 
     No reference counterpart (SURVEY.md §2.3: attention/sequence models are
@@ -91,15 +92,19 @@ def transformer_lm(vocab_size: int = 256, seq_len: int = 128,
     workload.  Input: (seq_len,) int token ids; output: (seq_len, vocab)
     logits — train with loss="sparse_categorical_crossentropy_from_logits".
     """
-    layers = [
-        Embedding(vocab_size, d_model),
-        PositionalEmbedding(seq_len),
-    ]
+    if positional not in ("learned", "rope"):
+        raise ValueError(f"positional must be 'learned' or 'rope', got "
+                         f"{positional!r}")
+    rope = positional == "rope"
+    layers = [Embedding(vocab_size, d_model)]
+    if not rope:  # RoPE rotates q/k inside attention; no additive table
+        layers.append(PositionalEmbedding(seq_len))
     for _ in range(num_layers):
         layers.append(TransformerBlock(
             num_heads, d_model // num_heads, mlp_dim, dropout=dropout,
             causal=True, attention_impl=attention_impl,
-            num_kv_heads=num_kv_heads, attention_window=attention_window))
+            num_kv_heads=num_kv_heads, attention_window=attention_window,
+            rope=rope))
     layers += [LayerNormalization(), Dense(vocab_size)]
     return Sequential(layers, input_shape=(seq_len,),
                       compute_dtype=compute_dtype, name="transformer_lm")

@@ -1,0 +1,50 @@
+"""Rotary position embeddings (RoPE, Su et al. 2021).
+
+No reference counterpart (SURVEY.md §2.3: the reference has no sequence
+models) — part of the long-context layer.  Each (even, odd) channel pair of
+q and k is rotated by an angle proportional to the token's absolute
+position; dot products between rotated q and k then depend only on the
+RELATIVE distance, which is what makes RoPE extrapolate and window/cache
+naturally.  Rotation happens at projection time, before the attention
+dispatch, so it composes with every impl (XLA, flash, ring) and with
+GQA/sliding-window unchanged.
+
+Arithmetic is f32 (bf16-safe angles), output in the input dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def validate_rope_dim(dim: int) -> int:
+    """The single RoPE head-dim rule, shared by the layer constructors
+    (eager) and the op itself (trace time): channel pairs need an even
+    dim."""
+    if int(dim) % 2:
+        raise ValueError(f"RoPE needs an even head dim, got {dim}")
+    return int(dim)
+
+
+def rope_angles(positions, dim: int, theta: float = 10000.0):
+    """(S,) integer positions → (S, dim/2) rotation angles."""
+    validate_rope_dim(dim)
+    freqs = theta ** (-jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
+    return positions.astype(jnp.float32)[:, None] * freqs[None, :]
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotate (B, S, H, D) q or k by per-position angles.
+
+    ``positions``: (S,) absolute token positions — pass the true offsets
+    when decoding a suffix against a cache.
+    """
+    b, s, h, d = x.shape
+    ang = rope_angles(positions, d, theta)            # (S, d/2)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., 0::2], x32[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin,
+                     x1 * sin + x2 * cos], axis=-1).reshape(b, s, h, d)
+    return out.astype(x.dtype)
