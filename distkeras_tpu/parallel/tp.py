@@ -72,7 +72,8 @@ def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
                       compute_dtype=jnp.bfloat16,
                       ring_block_k: Optional[int] = None,
                       num_local_kv_heads: Optional[int] = None,
-                      window: Optional[int] = None):
+                      window: Optional[int] = None,
+                      rope_positions=None):
     """Head-parallel self-attention: each model-axis shard owns
     ``num_local_heads`` heads end to end (qkv column-split by head, local
     attention, output row-split) — one psum per block.  With ``seq_axis``
@@ -86,6 +87,10 @@ def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
     groups, so GQA composes with head parallelism as long as the global
     kv head count divides by the model-axis size.  ``window``: sliding-
     window masking (requires causal), same semantics as ``ops.attention``.
+    ``rope_positions``: (S_local,) GLOBAL token positions of this shard's
+    rows — when set, q/k are RoPE-rotated before attention; rotation is
+    per-position, so it is valid under the ring too (k blocks arrive
+    already rotated by their own global positions).
     """
     from .ring import ring_attention
     from ..ops.attention import attention
@@ -99,6 +104,10 @@ def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
         return y.astype(compute_dtype).reshape(b, s, heads, dh)
 
     q, k, v = proj(wq, h), proj(wk, hkv), proj(wv, hkv)
+    if rope_positions is not None:
+        from ..ops.rope import apply_rope
+        q = apply_rope(q, rope_positions)
+        k = apply_rope(k, rope_positions)
     if seq_axis is not None:
         # ring_block_k: blockwise chunking of each rotation's local attend —
         # the long-context memory knob when local shards are large

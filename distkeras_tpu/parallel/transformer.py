@@ -47,6 +47,7 @@ class ParallelTransformerLM:
                  ring_block_k: Optional[int] = None,
                  num_kv_heads: Optional[int] = None,
                  attention_window: Optional[int] = None,
+                 positional: str = "learned",
                  data_axis: str = "data", seq_axis: str = "seq",
                  model_axis: str = "model"):
         self.vocab_size = vocab_size
@@ -83,6 +84,13 @@ class ParallelTransformerLM:
         from ..ops.attention import validate_window
         self.attention_window = validate_window(attention_window,
                                                 causal=True)
+        if positional not in ("learned", "rope"):
+            raise ValueError(f"positional must be 'learned' or 'rope', "
+                             f"got {positional!r}")
+        self.positional = positional
+        if positional == "rope":
+            from ..ops.rope import validate_rope_dim
+            validate_rope_dim(d_model // num_heads)
         if mlp_dim % self.tp:
             raise ValueError(f"mlp_dim {mlp_dim} % tp {self.tp} != 0")
         if seq_len % self.sp:
@@ -128,11 +136,12 @@ class ParallelTransformerLM:
         d = self.d_model
         shapes: dict = {
             "embed": ((self.vocab_size, d), P()),
-            "pos": ((self.seq_len, d), P()),
             "ln_f": ((d,), P()),
             "head": ((d, self.vocab_size), P()),
             "layers": [self._layer_shapes(i) for i in range(self.num_layers)],
         }
+        if self.positional == "learned":  # rope has no additive table
+            shapes["pos"] = ((self.seq_len, d), P())
         split = lambda take: tmap(lambda sp: sp[take], shapes,
                                   is_leaf=lambda x: isinstance(x, tuple)
                                   and len(x) == 2 and isinstance(x[0], tuple))
@@ -182,9 +191,13 @@ class ParallelTransformerLM:
         seq_idx = jax.lax.axis_index(seq_axis)
 
         x = params["embed"].astype(cdt)[tokens]
-        pos = jax.lax.dynamic_slice_in_dim(params["pos"], seq_idx * s_loc,
-                                           s_loc)
-        x = x + pos.astype(cdt)
+        if self.positional == "learned":
+            pos = jax.lax.dynamic_slice_in_dim(params["pos"],
+                                               seq_idx * s_loc, s_loc)
+            x = x + pos.astype(cdt)
+        # rope: rotation happens on q/k inside each block (global positions)
+        rope_pos = (seq_idx * s_loc + jnp.arange(s_loc)
+                    if self.positional == "rope" else None)
 
         def ln(scale, h):
             h32 = h.astype(jnp.float32)
@@ -203,7 +216,8 @@ class ParallelTransformerLM:
                     seq_axis=seq_axis, causal=True, compute_dtype=cdt,
                     ring_block_k=self.ring_block_k,
                     num_local_kv_heads=self.num_kv_heads // self.tp,
-                    window=self.attention_window)
+                    window=self.attention_window,
+                    rope_positions=rope_pos)
                 x = x + attn.astype(cdt)
                 h = ln(lp["ln2"], x)
                 if i in self.moe_layers:

@@ -73,6 +73,21 @@ def test_gqa_window_sharded_matches_single_device(eight_devices):
     assert wk.addressable_shards[0].data.shape == (16, 4)
 
 
+def test_rope_sharded_matches_single_device(eight_devices):
+    """positional='rope' (global-position q/k rotation, no pos table) on
+    the 2×2×2 mesh == the same model on a 1×1×1 mesh, and it trains."""
+    kw = dict(num_heads=2, positional="rope")
+    l8, p8 = run_steps(make_lm(mesh_of((2, 2, 2)), **kw), 3)
+    l1, _ = run_steps(make_lm(mesh_of((1, 1, 1)), **kw), 3)
+    np.testing.assert_allclose(l8, l1, rtol=2e-4)
+    assert "pos" not in p8  # no additive positional table under rope
+
+    losses, _ = run_steps(make_lm(mesh_of((2, 2, 2)), **kw), 30)
+    assert losses[-1] < 0.3 * losses[0], losses
+    with pytest.raises(ValueError, match="positional"):
+        make_lm(mesh_of((2, 2, 2)), positional="alibi")
+
+
 def test_gqa_tp_divisibility_validated(eight_devices):
     with pytest.raises(ValueError, match="num_kv_heads"):
         make_lm(mesh_of((2, 2, 2)), num_heads=4, num_kv_heads=3)
