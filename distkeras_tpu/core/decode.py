@@ -58,10 +58,17 @@ def _context_limit(model) -> Optional[int]:
     return None
 
 
-def init_cache(model, batch: int, max_len: int) -> List[Any]:
+def init_cache(model, batch: int, max_len: int,
+               rolling: bool = False) -> List[Any]:
     """One cache slot per layer: ``{"k", "v"}`` of shape
     (batch, max_len, num_kv_heads, key_dim) for TransformerBlocks, None
-    elsewhere.  Cache dtype = the model's compute dtype (bf16 on TPU)."""
+    elsewhere.  Cache dtype = the model's compute dtype (bf16 on TPU).
+
+    ``rolling=True`` (sliding-window models only): each block's cache is a
+    ring buffer of its ``attention_window`` slots instead of ``max_len`` —
+    slot ``p % W`` holds position ``p``, old entries are overwritten as
+    generation advances, and memory stays O(W) however long the
+    continuation runs (the point of windowed attention at decode time)."""
     _check_supported(model)
     limit = _context_limit(model)
     if limit is not None and max_len > limit:
@@ -74,7 +81,15 @@ def init_cache(model, batch: int, max_len: int) -> List[Any]:
     for layer in model.layers:
         if isinstance(layer, TransformerBlock):
             mha = layer._mha()
-            shape = (batch, max_len, mha._kv_heads(), mha.key_dim)
+            slots = max_len
+            if rolling:
+                if mha.attention_window is None:
+                    raise ValueError(
+                        "rolling=True needs attention_window on every "
+                        "TransformerBlock: without a window, old positions "
+                        "stay visible and must stay cached")
+                slots = min(mha.attention_window, max_len)
+            shape = (batch, slots, mha._kv_heads(), mha.key_dim)
             caches.append({"k": jnp.zeros(shape, dtype),
                            "v": jnp.zeros(shape, dtype)})
         else:
@@ -82,7 +97,8 @@ def init_cache(model, batch: int, max_len: int) -> List[Any]:
     return caches
 
 
-def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype):
+def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype,
+                 rolling: bool = False):
     """Cached attention over (B, L, D) queries starting at position
     ``pos``; writes k/v for those L positions into the cache and attends
     through ``ops.attention.dot_product_attention`` (same numerics as the
@@ -106,23 +122,43 @@ def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype):
         positions = pos + jnp.arange(length)
         q = apply_rope(q, positions)
         k_t = apply_rope(k_t, positions)
-    k = jax.lax.dynamic_update_slice(cache["k"], k_t, (0, pos, 0, 0))
-    v = jax.lax.dynamic_update_slice(cache["v"], v_t, (0, pos, 0, 0))
-    out = dot_product_attention(q, k, v, causal=True, q_offset=pos,
-                                kv_length=pos + length,
-                                window=mha.attention_window)
+    if rolling:
+        # ring buffer of the block's window: slot p % W holds position p.
+        # Single-token writes only — generate() prefills with a full cache
+        # and converts (a batched ring write would wrap around the buffer).
+        if length != 1:
+            raise ValueError("rolling cache steps are single-token "
+                             "(prefill uses a full cache, then converts)")
+        w = cache["k"].shape[1]
+        slot = pos % w
+        k = jax.lax.dynamic_update_slice(cache["k"], k_t, (0, slot, 0, 0))
+        v = jax.lax.dynamic_update_slice(cache["v"], v_t, (0, slot, 0, 0))
+        # slot j currently holds position pos - ((pos - j) mod W); slots
+        # not yet written come out negative and mask themselves
+        j = jnp.arange(w)
+        kv_positions = pos - jnp.mod(pos - j, w)
+        out = dot_product_attention(q, k, v, causal=True, q_offset=pos,
+                                    window=mha.attention_window,
+                                    kv_positions=kv_positions)
+    else:
+        k = jax.lax.dynamic_update_slice(cache["k"], k_t, (0, pos, 0, 0))
+        v = jax.lax.dynamic_update_slice(cache["v"], v_t, (0, pos, 0, 0))
+        out = dot_product_attention(q, k, v, causal=True, q_offset=pos,
+                                    kv_length=pos + length,
+                                    window=mha.attention_window)
     out = out.reshape(b, length, mha.num_heads * dh)
     bias_o = params.get("bo") if mha.use_bias else None
     y = _project(out, params["wo"], bias_o, cdtype)
     return y, {"k": k, "v": v}
 
 
-def _block_forward(block: TransformerBlock, params, x, cache, pos, cdtype):
+def _block_forward(block: TransformerBlock, params, x, cache, pos, cdtype,
+                   rolling: bool = False):
     """Mirrors ``TransformerBlock.apply`` (train=False) with cached MHA."""
     ln = LayerNormalization()
     h = ln.apply(params["ln1"], x, compute_dtype=cdtype)
     h, cache = _mha_forward(block._mha(), params["attn"], h, cache, pos,
-                            cdtype)
+                            cdtype, rolling)
     x = x + h.astype(x.dtype)
     h = ln.apply(params["ln2"], x, compute_dtype=cdtype)
     h = _project(h, params["mlp_w1"], params["mlp_b1"], cdtype)
@@ -131,7 +167,7 @@ def _block_forward(block: TransformerBlock, params, x, cache, pos, cdtype):
     return x + h.astype(x.dtype), cache
 
 
-def _forward(model, params, caches, toks, pos):
+def _forward(model, params, caches, toks, pos, rolling: bool = False):
     """Walk the layer stack over (B, L) tokens starting at position
     ``pos``; returns ((B, L, V) f32 logits, new caches).  L == 1 is a
     decode step, L == P is the batched prompt prefill."""
@@ -148,30 +184,58 @@ def _forward(model, params, caches, toks, pos):
                 jnp.asarray(p["embedding"]), pos, toks.shape[1])
             x = x + pe.astype(x.dtype)[None]
         elif isinstance(layer, TransformerBlock):
-            x, cache = _block_forward(layer, p, x, cache, pos, cdtype)
+            x, cache = _block_forward(layer, p, x, cache, pos, cdtype,
+                                      rolling)
         else:  # LayerNormalization / Dense: position-independent
             x = layer.apply(p, x, compute_dtype=cdtype, train=False)
         new_caches.append(cache)
     return x.astype(jnp.float32), new_caches
 
 
-def decode_step(model, params, caches, tok, pos):
+def decode_step(model, params, caches, tok, pos, rolling: bool = False):
     """Advance one position.  tok: (B,) int32 current tokens; pos: scalar
     int32 position (0-based).  Returns (logits (B, V) f32, new caches).
     Jittable — wrap in ``jax.jit`` (or let ``generate`` do it) for real
     use."""
-    logits, caches = _forward(model, params, caches, tok[:, None], pos)
+    logits, caches = _forward(model, params, caches, tok[:, None], pos,
+                              rolling)
     return logits[:, 0], caches
+
+
+def _to_ring(full_cache, p_len: int, window: int):
+    """Convert a full prefill cache (positions 0..p_len-1 at slots
+    0..p_len-1) into a W-slot ring where slot ``p % W`` holds position
+    ``p``, keeping the last ``window`` positions."""
+    if p_len >= window:
+        # entries for positions p0..p_len-1 (p0 = p_len - W), in order;
+        # rolling by p0 % W puts position p at slot p % W
+        p0 = p_len - window
+        last = jax.lax.dynamic_slice_in_dim(full_cache, p0, window, axis=1)
+        return jnp.roll(last, p0 % window, axis=1)
+    # shorter prompt: positions 0..p_len-1 already sit at their slots;
+    # grow/trim to W slots (unwritten tail masks itself via kv_positions)
+    pad = window - full_cache.shape[1]
+    if pad > 0:
+        zeros = jnp.zeros(full_cache.shape[:1] + (pad,)
+                          + full_cache.shape[2:], full_cache.dtype)
+        return jnp.concatenate([full_cache, zeros], axis=1)
+    return full_cache[:, :window]
 
 
 def generate(model, params, prompt, num_steps: int,
              temperature: float = 0.0, rng: Optional[jax.Array] = None,
-             max_len: Optional[int] = None) -> jnp.ndarray:
+             max_len: Optional[int] = None,
+             rolling: bool = False) -> jnp.ndarray:
     """Continue ``prompt`` (B, P) int tokens by ``num_steps`` tokens.
 
     temperature 0 = greedy argmax; > 0 = softmax sampling (needs ``rng``).
     Returns (B, P + num_steps) tokens.  Prefill is one batched forward;
     the continuation is one compiled ``lax.scan`` of single-token steps.
+
+    ``rolling=True`` (sliding-window models): after the prefill, each
+    block's cache collapses to a ring of its ``attention_window`` slots,
+    so generation memory is O(W) regardless of ``num_steps`` — identical
+    tokens to ``rolling=False`` (windowed attention never looks past W).
     """
     _check_supported(model)
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -192,7 +256,12 @@ def generate(model, params, prompt, num_steps: int,
             f"the model's positional-embedding range {limit}")
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 sampling needs rng")
-    caches = init_cache(model, b, max_len)
+    if rolling:
+        # validates every block carries a window; the prefill below still
+        # uses a full P-slot cache (one batched forward), which then
+        # collapses to rings — peak memory O(P + W), steady-state O(W)
+        init_cache(model, 0, 1, rolling=True)
+    caches = init_cache(model, b, p_len if rolling else max_len)
 
     def sample(logits, pos):
         if temperature > 0.0:
@@ -206,10 +275,22 @@ def generate(model, params, prompt, num_steps: int,
     logits, caches = _forward(model, params, caches, prompt, 0)
     first = sample(logits[:, -1], p_len - 1)
 
+    if rolling:
+        ringed = []
+        for layer, cache in zip(model.layers, caches):
+            if cache is None:
+                ringed.append(None)
+                continue
+            w = layer._mha().attention_window
+            ringed.append({name: _to_ring(cache[name], p_len, w)
+                           for name in ("k", "v")})
+        caches = ringed
+
     def body(carry, i):
         caches, tok = carry
         pos = p_len + i
-        logits, caches = decode_step(model, params, caches, tok, pos)
+        logits, caches = decode_step(model, params, caches, tok, pos,
+                                     rolling)
         return (caches, sample(logits, pos)), tok
 
     (caches, last), toks = jax.lax.scan(

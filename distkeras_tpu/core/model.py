@@ -178,12 +178,13 @@ class FittedModel:
         return self.model.count_params(self.params)
 
     def generate(self, prompt, num_steps: int, temperature: float = 0.0,
-                 rng=None, max_len=None):
+                 rng=None, max_len=None, rolling: bool = False):
         """KV-cache autoregressive continuation (causal LMs only) — see
         ``core.decode.generate``."""
         from .decode import generate
         return generate(self.model, self.params, prompt, num_steps,
-                        temperature=temperature, rng=rng, max_len=max_len)
+                        temperature=temperature, rng=rng, max_len=max_len,
+                        rolling=rolling)
 
     def serialize(self) -> dict:
         return serialize_model(self.model, self.params)

@@ -39,7 +39,8 @@ def validate_window(window: Optional[int], causal: bool) -> Optional[int]:
 def dot_product_attention(q, k, v, *, causal: bool = False,
                           scale: Optional[float] = None,
                           q_offset=None, kv_length=None,
-                          window: Optional[int] = None):
+                          window: Optional[int] = None,
+                          kv_positions=None):
     """Softmax(q·kᵀ)·v with f32 softmax arithmetic.
 
     q: (B, Sq, H, Dh); k, v: (B, Sk, Hkv, Dh) → (B, Sq, H, Dh), in q.dtype.
@@ -60,7 +61,11 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     exact numerics path): ``q_offset`` places query i at absolute position
     ``q_offset + i`` for the causal mask (queries continuing a cached
     prefix); ``kv_length`` masks key slots >= it out of the softmax
-    (zero-filled tail of a preallocated cache).  Both accept tracers.
+    (zero-filled tail of a preallocated cache); ``kv_positions`` gives
+    each key slot an EXPLICIT absolute position (rolling/ring-buffer
+    caches, where slot order ≠ position order — negative = empty slot),
+    overriding the identity slot→position layout that ``causal``/
+    ``kv_length`` otherwise assume.  All accept tracers.
     """
     *_, d = q.shape
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
@@ -69,16 +74,23 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     if h % hkv:
         raise ValueError(f"num_heads {h} not divisible by kv heads {hkv}")
     window = validate_window(window, causal)
+    if kv_positions is not None and not causal:
+        raise ValueError("kv_positions (rolling-cache slot positions) "
+                         "requires causal=True — its empty-slot masking "
+                         "lives in the causal mask")
     g = h // hkv
     qg = q.reshape(b, sq, hkv, g, d)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
                         preferred_element_type=jnp.float32) * scale
-    k_pos = jnp.arange(k.shape[1])
+    k_pos = (jnp.arange(k.shape[1]) if kv_positions is None
+             else jnp.asarray(kv_positions))
     if causal:
         q_pos = jnp.arange(sq) + (0 if q_offset is None else q_offset)
         mask = k_pos[None, :] > q_pos[:, None]  # (Sq, Sk): True = hide
         if window is not None:
             mask = mask | (k_pos[None, :] <= q_pos[:, None] - window)
+        if kv_positions is not None:
+            mask = mask | (k_pos[None, :] < 0)  # negative = empty slot
         scores = jnp.where(mask[None, None, None], NEG_INF, scores)
     if kv_length is not None:
         scores = jnp.where((k_pos < kv_length)[None, None, None, None],

@@ -83,6 +83,35 @@ def test_generate_continues_learned_rule():
     np.testing.assert_array_equal(out[:, 4:], want)
 
 
+@pytest.mark.parametrize("p_len,steps", [(3, 14), (9, 10)])
+def test_rolling_cache_matches_full(p_len, steps):
+    """rolling=True (O(window) ring cache) produces EXACTLY the tokens of
+    the full cache, for prompts shorter and longer than the window."""
+    from distkeras_tpu.core.decode import init_cache
+    model = transformer_lm(vocab_size=16, seq_len=24, d_model=32,
+                           num_heads=4, num_layers=2, mlp_dim=64,
+                           compute_dtype="float32", num_kv_heads=2,
+                           attention_window=6, positional="rope")
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = np.random.default_rng(3).integers(
+        0, 16, (2, p_len)).astype(np.int32)
+    full = np.asarray(generate(model, params, prompt, steps))
+    rolled = np.asarray(generate(model, params, prompt, steps,
+                                 rolling=True))
+    np.testing.assert_array_equal(full, rolled)
+
+    # ring caches really are window-sized
+    caches = init_cache(model, batch=2, max_len=24, rolling=True)
+    assert all(c["k"].shape[1] == 6 for c in caches if c is not None)
+    # rolling without a window is refused
+    nowin = tiny_lm()
+    with pytest.raises(ValueError, match="rolling"):
+        init_cache(nowin, 1, 8, rolling=True)
+    with pytest.raises(ValueError, match="rolling"):
+        generate(nowin, nowin.init(jax.random.PRNGKey(0)),
+                 prompt[:, :3], 2, rolling=True)
+
+
 def test_generate_sampling_and_validation():
     model = tiny_lm()
     params = model.init(jax.random.PRNGKey(0))
