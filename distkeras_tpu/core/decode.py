@@ -58,6 +58,18 @@ def _context_limit(model) -> Optional[int]:
     return None
 
 
+def _validate_rolling(model) -> None:
+    """Every block must carry a window for a ring cache to be sound:
+    without one, old positions stay visible and must stay cached."""
+    for layer in model.layers:
+        if isinstance(layer, TransformerBlock) and \
+                layer._mha().attention_window is None:
+            raise ValueError(
+                "rolling=True needs attention_window on every "
+                "TransformerBlock: without a window, old positions stay "
+                "visible and must stay cached")
+
+
 def init_cache(model, batch: int, max_len: int,
                rolling: bool = False) -> List[Any]:
     """One cache slot per layer: ``{"k", "v"}`` of shape
@@ -70,6 +82,8 @@ def init_cache(model, batch: int, max_len: int,
     generation advances, and memory stays O(W) however long the
     continuation runs (the point of windowed attention at decode time)."""
     _check_supported(model)
+    if rolling:
+        _validate_rolling(model)
     limit = _context_limit(model)
     if limit is not None and max_len > limit:
         raise ValueError(
@@ -83,11 +97,6 @@ def init_cache(model, batch: int, max_len: int,
             mha = layer._mha()
             slots = max_len
             if rolling:
-                if mha.attention_window is None:
-                    raise ValueError(
-                        "rolling=True needs attention_window on every "
-                        "TransformerBlock: without a window, old positions "
-                        "stay visible and must stay cached")
                 slots = min(mha.attention_window, max_len)
             shape = (batch, slots, mha._kv_heads(), mha.key_dim)
             caches.append({"k": jnp.zeros(shape, dtype),
@@ -257,10 +266,10 @@ def generate(model, params, prompt, num_steps: int,
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 sampling needs rng")
     if rolling:
-        # validates every block carries a window; the prefill below still
-        # uses a full P-slot cache (one batched forward), which then
-        # collapses to rings — peak memory O(P + W), steady-state O(W)
-        init_cache(model, 0, 1, rolling=True)
+        # the prefill below still uses a full P-slot cache (one batched
+        # forward), which then collapses to rings — peak memory O(P + W),
+        # steady-state O(W)
+        _validate_rolling(model)
     caches = init_cache(model, b, p_len if rolling else max_len)
 
     def sample(logits, pos):
