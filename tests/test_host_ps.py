@@ -238,6 +238,31 @@ def test_host_ps_bf16_wire_compression_learns():
     assert all(w.dtype == np.float32 for w in fitted.get_weights())
 
 
+def test_host_ps_trains_transformer_lm():
+    """The async socket-PS engine handles the sequence-model family too:
+    a RoPE/GQA causal LM's loss drops through true hogwild training (the
+    wire carries the full transformer param pytree)."""
+    from distkeras_tpu.models.zoo import transformer_lm
+
+    model = transformer_lm(vocab_size=16, seq_len=12, d_model=32,
+                           num_heads=4, num_layers=1, mlp_dim=64,
+                           compute_dtype="float32", num_kv_heads=2,
+                           positional="rope")
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 16, (128, 12)).astype(np.int32)
+    y = (x + 1) % 16
+    tr = ADAG(model, num_workers=2, batch_size=16, num_epoch=8,
+              communication_window=2, execution="host_ps",
+              loss="sparse_categorical_crossentropy_from_logits",
+              worker_optimizer="adam", learning_rate=3e-3)
+    tr.train(Dataset({"features": x, "label": y}), shuffle=True)
+    hist = tr.get_history()
+    assert len(hist) > 0
+    first = np.mean(hist[:4])
+    last = np.mean(hist[-4:])
+    assert last < 0.5 * first, (first, last)
+
+
 def test_wire_dtype_resolves_eagerly():
     """float16 (numpy-native) and bad names resolve/fail at construction."""
     from distkeras_tpu.workers import DOWNPOURWorker
