@@ -251,8 +251,6 @@ def generate(model, params, prompt, num_steps: int,
     b, p_len = prompt.shape
     if num_steps < 0:
         raise ValueError(f"num_steps must be >= 0, got {num_steps}")
-    if num_steps == 0:
-        return prompt
     total = p_len + int(num_steps)
     if max_len is None:
         max_len = total
@@ -270,6 +268,10 @@ def generate(model, params, prompt, num_steps: int,
         # forward), which then collapses to rings — peak memory O(P + W),
         # steady-state O(W)
         _validate_rolling(model)
+    if num_steps == 0:
+        # after validation, so invalid argument combinations fail the same
+        # way regardless of step count
+        return prompt
     caches = init_cache(model, b, p_len if rolling else max_len)
 
     def sample(logits, pos):

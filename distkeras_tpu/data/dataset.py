@@ -94,22 +94,49 @@ class Dataset:
                 Dataset(right, self.num_partitions))
 
     # -- sharding / batching for the TPU path --------------------------------
-    def shard(self, num_shards: Optional[int] = None, drop_remainder=True
-              ) -> Dict[str, np.ndarray]:
+    def shard(self, num_shards: Optional[int] = None,
+              drop_remainder: bool = False,
+              pad: bool = False) -> Dict[str, np.ndarray]:
         """Columns reshaped to (num_shards, rows_per_shard, ...).
 
         The leading axis is laid out along the mesh 'workers' axis by the
-        parallel layer; equal shard sizes are required (SPMD static shapes),
-        so the tail remainder is dropped — matching Spark's repartition
-        semantics closely enough for training.
+        parallel layer; equal shard sizes are required (SPMD static shapes).
+        A row count not divisible by ``num_shards`` **raises** — silent
+        truncation violated the framework's no-data-drop contract, and
+        silent duplication would bias any metric computed over the shards.
+        Opt in explicitly to either resolution:
+
+        - ``drop_remainder=True`` — truncate the tail (Spark-repartition
+          style; acceptable for training streams);
+        - ``pad=True`` — wrap-pad the tail by repeating rows from the front
+          (no row lost, but padded duplicates weight those rows twice in
+          unweighted metrics — the trainers' ``batches``/mask path is the
+          metric-exact route).
         """
+        if drop_remainder and pad:
+            raise ValueError("drop_remainder and pad are mutually exclusive")
         n = num_shards or self.num_partitions
-        rows = (len(self) // n) * n
-        if rows == 0:
-            raise ValueError(f"Dataset of {len(self)} rows cannot fill "
+        total = len(self)
+        if total < n:
+            raise ValueError(f"Dataset of {total} rows cannot fill "
                              f"{n} shards")
-        return {k: v[:rows].reshape((n, rows // n) + v.shape[1:])
-                for k, v in self._cols.items()}
+        if total % n == 0:
+            rows = total
+            cols = self._cols
+        elif drop_remainder:
+            rows = (total // n) * n
+            cols = {k: v[:rows] for k, v in self._cols.items()}
+        elif pad:
+            rows = (-(-total // n)) * n  # ceil to a full last shard
+            cols = {k: np.concatenate([v, v[:rows - total]])
+                    for k, v in self._cols.items()}
+        else:
+            raise ValueError(
+                f"{total} rows do not divide into {n} equal shards; pass "
+                "drop_remainder=True to truncate the tail or pad=True to "
+                "wrap-pad it")
+        return {k: v.reshape((n, rows // n) + v.shape[1:])
+                for k, v in cols.items()}
 
     def batches(self, batch_size: int, columns: Sequence[str],
                 drop_remainder: bool = True) -> Dict[str, np.ndarray]:

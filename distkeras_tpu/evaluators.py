@@ -39,6 +39,15 @@ def _labels_1d(label: np.ndarray) -> np.ndarray:
 def _pred_and_label(dataset: Dataset, prediction_col: str, label_col: str):
     pred = np.asarray(dataset[prediction_col]).reshape(-1)
     label = _labels_1d(np.asarray(dataset[label_col]))
+    if np.issubdtype(pred.dtype, np.floating):
+        # prediction_col must hold class indices; round-to-nearest tolerates
+        # float storage of integers while NaN/inf (undefined as a class)
+        # fail loudly instead of casting to a platform-defined int64
+        if not np.isfinite(pred).all():
+            raise ValueError(
+                f"column {prediction_col!r} contains NaN/inf — expected "
+                "integer class indices (run LabelIndexTransformer first)")
+        pred = np.rint(pred)
     return pred.astype(np.int64), label
 
 
@@ -109,6 +118,10 @@ class TopKAccuracyEvaluator(Evaluator):
     def evaluate(self, dataset: Dataset) -> float:
         probs = np.asarray(dataset[self.prediction_col])
         label = _labels_1d(np.asarray(dataset[self.label_col]))
+        if probs.ndim != 2:
+            raise ValueError(
+                f"column {self.prediction_col!r} must be (N, num_classes) "
+                f"probability/logit vectors, got shape {probs.shape}")
         k = min(self.k, probs.shape[-1])
         topk = np.argpartition(-probs, k - 1, axis=-1)[:, :k]
         return float(np.mean((topk == label[:, None]).any(axis=1)))

@@ -116,6 +116,25 @@ def peak_flops(device_kind: str) -> Optional[float]:
     return None
 
 
+def _attention_flops(layer, in_shape) -> float:
+    """Matmul FLOPs of one attention layer on one example.
+
+    Sizes the k/v projections by ``num_kv_heads`` so GQA/MQA models are not
+    overcounted (q/o stay full-width: ``num_heads * key_dim``), and caps the
+    score/value matmuls at the sliding-window width when one is set.
+    """
+    s, d = in_shape
+    inner = layer.num_heads * layer.key_dim
+    kv_heads = layer.num_kv_heads or layer.num_heads
+    inner_kv = kv_heads * layer.key_dim
+    total = 2.0 * s * d * (inner + 2.0 * inner_kv)  # q + k + v projections
+    total += 2.0 * s * inner * d                  # output projection
+    window = getattr(layer, "attention_window", None)
+    ctx = float(min(s, window + 1)) if window is not None else float(s)
+    total += 2.0 * 2.0 * s * ctx * inner          # qk^T and scores@v
+    return total
+
+
 def flops_per_example(model, backward: bool = True) -> float:
     """Analytic matmul/conv FLOPs for one example through a ``Sequential``.
 
@@ -146,15 +165,10 @@ def flops_per_example(model, backward: bool = True) -> float:
         elif isinstance(layer, L.Embedding):
             pass  # gather, not matmul
         elif isinstance(layer, L.MultiHeadAttention):
-            s, d = shape
-            inner = layer.num_heads * layer.key_dim
-            total += 2.0 * s * d * inner * 4          # q/k/v/o projections
-            total += 2.0 * 2.0 * s * s * inner        # qk^T and scores@v
+            total += _attention_flops(layer, shape)
         elif isinstance(layer, L.TransformerBlock):
             s, d = shape
-            inner = layer.num_heads * layer.key_dim
-            total += 2.0 * s * d * inner * 4
-            total += 2.0 * 2.0 * s * s * inner
+            total += _attention_flops(layer, shape)
             total += 2.0 * s * d * layer.mlp_dim * 2  # mlp in+out
         shape = out_shape
     return total * (3.0 if backward else 1.0)

@@ -66,7 +66,7 @@ def main():
             print(json.dumps(r), flush=True)
             results.append(r)
 
-    ok = [r for r in results if "error" not in r]
+    ok = [r for r in results if "error" not in r and "value" in r]
     if ok:
         best = max(ok, key=lambda r: r["value"])
         print(json.dumps({"best": {k: best[k] for k in

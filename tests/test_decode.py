@@ -129,6 +129,12 @@ def test_generate_sampling_and_validation():
         generate(model, params, prompt, -2)
     np.testing.assert_array_equal(
         np.asarray(generate(model, params, prompt, 0)), prompt)
+    # num_steps == 0 does not bypass validation (ADVICE r3): invalid
+    # combinations fail the same way regardless of step count
+    with pytest.raises(ValueError, match="max_len"):
+        generate(model, params, prompt, 0, max_len=2)
+    with pytest.raises(ValueError, match="rolling"):
+        generate(model, params, prompt, 0, rolling=True)
     # encoder-style (non-causal) blocks are rejected: the cached step would
     # silently diverge from the full bidirectional forward
     from distkeras_tpu.core.layers import TransformerBlock, Embedding

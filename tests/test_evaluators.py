@@ -59,6 +59,28 @@ def test_f1_edge_cases():
         F1Evaluator(metric="auc")
 
 
+def test_accuracy_float_predictions():
+    """Float-stored class indices round (not truncate); NaN fails loudly."""
+    ds = make_ds(np.array([0.9, 1.1, 2.0]), np.array([1, 1, 2]))
+    # truncation would read 0.9 as class 0 and score 2/3
+    assert AccuracyEvaluator().evaluate(ds) == 1.0
+    for bad in (np.nan, np.inf, -np.inf):
+        bad_ds = make_ds(np.array([0.0, bad]), np.array([0, 1]))
+        with pytest.raises(ValueError, match="NaN/inf"):
+            AccuracyEvaluator().evaluate(bad_ds)
+
+
+def test_topk_rejects_non_2d_predictions():
+    ds1 = Dataset({"prediction": np.array([0.5, 0.5]),
+                   "label": np.array([0, 1])})
+    with pytest.raises(ValueError, match="num_classes"):
+        TopKAccuracyEvaluator(k=1).evaluate(ds1)
+    ds3 = Dataset({"prediction": np.zeros((2, 3, 4)),
+                   "label": np.array([0, 1])})
+    with pytest.raises(ValueError, match="num_classes"):
+        TopKAccuracyEvaluator(k=1).evaluate(ds3)
+
+
 def test_topk_accuracy():
     probs = np.array([[0.5, 0.3, 0.2],    # top2 = {0, 1}
                       [0.1, 0.2, 0.7],    # top2 = {2, 1}
