@@ -4,7 +4,7 @@ from .layers import (Layer, Dense, Conv2D, MaxPooling2D, AveragePooling2D,
                      LayerNormalization, PositionalEmbedding,
                      MultiHeadAttention, TransformerBlock)
 from .model import Sequential, serialize_model, deserialize_model
-from .decode import decode_step, generate, init_cache
+from .decode import decode_step, generate, init_cache, jit_decode_step
 from .losses import get_loss
 from .optimizers import (Optimizer, SGD, Adam, Adagrad, Adadelta, RMSprop,
                          get_optimizer)
@@ -17,7 +17,7 @@ __all__ = [
     "LayerNormalization", "PositionalEmbedding", "MultiHeadAttention",
     "TransformerBlock",
     "Sequential", "serialize_model", "deserialize_model",
-    "decode_step", "generate", "init_cache",
+    "decode_step", "generate", "init_cache", "jit_decode_step",
     "get_loss",
     "Optimizer", "SGD", "Adam", "Adagrad", "Adadelta", "RMSprop",
     "get_optimizer",

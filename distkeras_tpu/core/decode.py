@@ -205,10 +205,61 @@ def decode_step(model, params, caches, tok, pos, rolling: bool = False):
     """Advance one position.  tok: (B,) int32 current tokens; pos: scalar
     int32 position (0-based).  Returns (logits (B, V) f32, new caches).
     Jittable — wrap in ``jax.jit`` (or let ``generate`` do it) for real
-    use."""
+    use; ``jit_decode_step`` packages exactly that."""
     logits, caches = _forward(model, params, caches, tok[:, None], pos,
                               rolling)
     return logits[:, 0], caches
+
+
+def jit_decode_step(model, rolling: bool = False):
+    """The jitted single-token entry point for serving loops that own their
+    own sampling/stopping logic (``generate`` builds its scan from the same
+    ``decode_step``, so numerics are identical).
+
+    Returns ``step(params, caches, tok, pos) -> (logits (B, V) f32,
+    new caches)`` compiled once per (batch, cache-length) shape::
+
+        caches = init_cache(model, batch, max_len)
+        step = jit_decode_step(model)
+        for pos in range(p_len, max_len):
+            logits, caches = step(params, caches, tok, pos)
+            tok = my_sampler(logits)
+
+    ``model`` and ``rolling`` are closed over (they shape the program);
+    ``pos`` is a traced argument, so advancing it does NOT recompile.
+    """
+    _check_supported(model)
+    if rolling:
+        _validate_rolling(model)
+
+    @jax.jit
+    def step(params, caches, tok, pos):
+        return decode_step(model, params, caches,
+                           jnp.asarray(tok, jnp.int32), pos, rolling)
+
+    return step
+
+
+def _filter_logits(logits, top_k: Optional[int], top_p: Optional[float]):
+    """Restrict a (B, V) logit row to the top-k tokens and/or the smallest
+    nucleus whose probability mass reaches top_p (the top token always
+    survives); filtered entries go to -inf.  k-then-p order, the standard
+    composition."""
+    if top_k is not None:
+        k = min(int(top_k), logits.shape[-1])  # k past vocab = keep all
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose preceding cumulative mass is < top_p (the top
+        # token's is 0, so at least one survives); the cut logit is the
+        # smallest kept one
+        kept = jnp.sum((cum - probs) < top_p, axis=-1, keepdims=True)
+        cut = jnp.take_along_axis(sorted_desc, kept - 1, axis=-1)
+        logits = jnp.where(logits < cut, -jnp.inf, logits)
+    return logits
 
 
 def _to_ring(full_cache, p_len: int, window: int):
@@ -234,10 +285,16 @@ def _to_ring(full_cache, p_len: int, window: int):
 def generate(model, params, prompt, num_steps: int,
              temperature: float = 0.0, rng: Optional[jax.Array] = None,
              max_len: Optional[int] = None,
-             rolling: bool = False) -> jnp.ndarray:
+             rolling: bool = False,
+             top_k: Optional[int] = None,
+             top_p: Optional[float] = None) -> jnp.ndarray:
     """Continue ``prompt`` (B, P) int tokens by ``num_steps`` tokens.
 
     temperature 0 = greedy argmax; > 0 = softmax sampling (needs ``rng``).
+    ``top_k`` / ``top_p`` (sampling only) restrict each step's distribution
+    to the k highest-logit tokens and/or the smallest nucleus reaching
+    probability mass ``top_p`` before drawing — combinable (k first, then
+    p, the standard composition).
     Returns (B, P + num_steps) tokens.  Prefill is one batched forward;
     the continuation is one compiled ``lax.scan`` of single-token steps.
 
@@ -263,6 +320,15 @@ def generate(model, params, prompt, num_steps: int,
             f"the model's positional-embedding range {limit}")
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 sampling needs rng")
+    if top_k is not None or top_p is not None:
+        if temperature <= 0.0:
+            raise ValueError(
+                "top_k/top_p shape the SAMPLING distribution — pass "
+                "temperature > 0 (greedy argmax ignores them)")
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rolling:
         # the prefill below still uses a full P-slot cache (one batched
         # forward), which then collapses to rings — peak memory O(P + W),
@@ -277,7 +343,8 @@ def generate(model, params, prompt, num_steps: int,
     def sample(logits, pos):
         if temperature > 0.0:
             step_rng = jax.random.fold_in(rng, pos)
-            nxt = jax.random.categorical(step_rng, logits / temperature)
+            logits = _filter_logits(logits / temperature, top_k, top_p)
+            nxt = jax.random.categorical(step_rng, logits)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         return nxt.astype(jnp.int32)

@@ -112,6 +112,97 @@ def test_rolling_cache_matches_full(p_len, steps):
                  prompt[:, :3], 2, rolling=True)
 
 
+def test_filter_logits_topk_topp():
+    """Closed-form checks of the sampling filter itself."""
+    from distkeras_tpu.core.decode import _filter_logits
+    logits = jnp.log(jnp.array([[0.5, 0.25, 0.15, 0.1]]))
+    # top_k keeps the k largest, -inf elsewhere
+    out = np.asarray(_filter_logits(logits, top_k=2, top_p=None))
+    assert np.isfinite(out[0, :2]).all() and np.isinf(out[0, 2:]).all()
+    # top_k past the vocab keeps everything
+    assert np.isfinite(
+        np.asarray(_filter_logits(logits, top_k=99, top_p=None))).all()
+    # nucleus: preceding mass < p keeps {0.5, 0.25} at p=0.6 (0.5 alone
+    # reaches only 0.5 < 0.6, so the second token joins)
+    out = np.asarray(_filter_logits(logits, top_k=None, top_p=0.6))
+    assert np.isfinite(out[0, :2]).all() and np.isinf(out[0, 2:]).all()
+    # tiny p still keeps the top token
+    out = np.asarray(_filter_logits(logits, top_k=None, top_p=1e-6))
+    assert np.isfinite(out[0, 0]) and np.isinf(out[0, 1:]).all()
+    # p = 1 keeps everything
+    assert np.isfinite(
+        np.asarray(_filter_logits(logits, top_k=None, top_p=1.0))).all()
+    # composed: k first, then p over the survivors
+    out = np.asarray(_filter_logits(logits, top_k=3, top_p=0.6))
+    assert np.isfinite(out[0, :2]).all() and np.isinf(out[0, 2:]).all()
+
+
+def test_generate_topk_topp_sampling():
+    model = tiny_lm()
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = np.zeros((2, 3), np.int32)
+    rng = jax.random.PRNGKey(7)
+    # top_k=1 == greedy regardless of temperature
+    greedy = np.asarray(generate(model, params, prompt, 5))
+    k1 = np.asarray(generate(model, params, prompt, 5, temperature=2.0,
+                             rng=rng, top_k=1))
+    np.testing.assert_array_equal(greedy, k1)
+    # a tiny nucleus likewise collapses to greedy
+    p_tiny = np.asarray(generate(model, params, prompt, 5, temperature=2.0,
+                                 rng=rng, top_p=1e-9))
+    np.testing.assert_array_equal(greedy, p_tiny)
+    # valid sampling with both filters + the rolling-cache path
+    win_model = transformer_lm(vocab_size=16, seq_len=24, d_model=32,
+                               num_heads=4, num_layers=2, mlp_dim=64,
+                               compute_dtype="float32", attention_window=6,
+                               positional="rope")
+    win_params = win_model.init(jax.random.PRNGKey(1))
+    out = np.asarray(generate(win_model, win_params, prompt, 8,
+                              temperature=1.0, rng=rng, top_k=5, top_p=0.9,
+                              rolling=True))
+    assert out.shape == (2, 11)
+    assert ((0 <= out) & (out < 16)).all()
+    # validation
+    with pytest.raises(ValueError, match="temperature"):
+        generate(model, params, prompt, 2, top_k=5)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, 2, temperature=1.0, rng=rng,
+                 top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 2, temperature=1.0, rng=rng,
+                 top_p=1.5)
+
+
+def test_jit_decode_step_entry_point():
+    """jit_decode_step drives a hand-rolled loop to the same tokens as
+    generate(), without recompiling across positions."""
+    from distkeras_tpu.core.decode import jit_decode_step
+    model = tiny_lm(seq_len=16)
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = np.array([[5, 3, 9]], np.int32)
+    steps = 6
+    want = np.asarray(generate(model, params, prompt, steps))
+
+    caches = init_cache(model, batch=1, max_len=3 + steps)
+    step = jit_decode_step(model)
+    # teacher-forced prefill one token at a time (exercises pos tracing)
+    for pos in range(3):
+        logits, caches = step(params, caches, prompt[:, pos], pos)
+    toks = [int(np.argmax(np.asarray(logits)[0]))]
+    for pos in range(3, 3 + steps - 1):
+        logits, caches = step(params, caches,
+                              np.array([toks[-1]], np.int32), pos)
+        toks.append(int(np.argmax(np.asarray(logits)[0])))
+    np.testing.assert_array_equal(want[0, 3:], toks)
+    # one compile for all positions: pos is traced, not baked in
+    assert step._cache_size() == 1
+    # unsupported models are rejected at build time
+    from distkeras_tpu.core.layers import Conv2D
+    from distkeras_tpu import Sequential
+    with pytest.raises(ValueError, match="unsupported layer"):
+        jit_decode_step(Sequential([Conv2D(4, 3)], input_shape=(8, 8, 1)))
+
+
 def test_generate_sampling_and_validation():
     model = tiny_lm()
     params = model.init(jax.random.PRNGKey(0))
