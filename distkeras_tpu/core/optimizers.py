@@ -112,6 +112,62 @@ def get_optimizer(spec: Any, learning_rate: Optional[float] = None) -> Optimizer
     raise TypeError(f"Cannot interpret optimizer spec {spec!r}")
 
 
+def get_schedule(spec: Any, base_lr: float,
+                 total_steps: Optional[int] = None):
+    """Resolve an LR-schedule spec to an optax schedule callable.
+
+    ``spec``: None (returns ``base_lr`` unchanged), a callable
+    (step -> lr, used as-is), a name string, or a ``{"name": ..., ...}``
+    dict overriding the defaults.  Named schedules:
+
+    - ``"warmup_cosine"``: linear 0 → ``base_lr`` over ``warmup_steps``
+      (default 10% of ``total_steps``), cosine decay to ``end_value``
+      (default 0) over ``decay_steps`` (default ``total_steps``).
+    - ``"cosine"``: cosine decay ``base_lr`` → ``alpha * base_lr`` over
+      ``decay_steps``.
+    - ``"constant"``: ``base_lr`` forever (explicit no-op).
+
+    ``total_steps`` is the trainer's optimizer-update count (it knows the
+    epoch/round geometry); required for the defaults above.
+    """
+    if spec is None:
+        return base_lr
+    if callable(spec):
+        return spec
+    if isinstance(spec, str):
+        spec = {"name": spec}
+    if not isinstance(spec, dict) or "name" not in spec:
+        raise TypeError(
+            f"lr_schedule must be a name, {{'name': ...}} dict or callable, "
+            f"got {spec!r}")
+    cfg = dict(spec)
+    name = cfg.pop("name")
+    if name == "constant":
+        return base_lr
+    decay_steps = cfg.pop("decay_steps", total_steps)
+    if decay_steps is None:
+        raise ValueError(
+            f"lr_schedule {name!r} needs decay_steps (or a trainer that "
+            "knows its total step count)")
+    import optax as _optax
+    if name == "warmup_cosine":
+        warmup = cfg.pop("warmup_steps", max(int(decay_steps * 0.1), 1))
+        sched = _optax.warmup_cosine_decay_schedule(
+            init_value=cfg.pop("init_value", 0.0), peak_value=base_lr,
+            warmup_steps=int(warmup), decay_steps=int(decay_steps),
+            end_value=cfg.pop("end_value", 0.0))
+    elif name == "cosine":
+        sched = _optax.cosine_decay_schedule(
+            init_value=base_lr, decay_steps=int(decay_steps),
+            alpha=cfg.pop("alpha", 0.0))
+    else:
+        raise ValueError(f"unknown lr_schedule {name!r} "
+                         "(warmup_cosine/cosine/constant)")
+    if cfg:
+        raise ValueError(f"unknown lr_schedule keys {sorted(cfg)}")
+    return sched
+
+
 def _trainable_mask(params):
     """Pytree mask: False for BatchNorm running ``stats`` subtrees."""
     def mask_layer(p):
@@ -123,11 +179,39 @@ def _trainable_mask(params):
     return [mask_layer(p) for p in params]
 
 
-def build(spec: Any, params, learning_rate: Optional[float] = None):
+def build_tx(spec: Any, params, learning_rate: Optional[float] = None,
+             lr_schedule: Any = None, total_steps: Optional[int] = None,
+             gradient_accumulation: int = 1
+             ) -> optax.GradientTransformation:
+    """Build the optax transformation for a params pytree: named optimizer →
+    optional LR schedule → non-trainable masking → optional gradient
+    accumulation (``optax.MultiSteps`` averaging ``gradient_accumulation``
+    mini-step gradients per real update — the large-batch knob when one
+    batch no longer fits HBM)."""
+    opt = get_optimizer(spec, learning_rate)
+    if lr_schedule is not None:
+        base = opt.hyper.get("learning_rate",
+                             _DEFAULT_LR.get(opt.name, 0.01))
+        opt = Optimizer(opt.name, **{
+            **opt.hyper,
+            "learning_rate": get_schedule(lr_schedule, base, total_steps)})
+    tx = optax.masked(opt.to_optax(), _trainable_mask(params))
+    k = int(gradient_accumulation)
+    if k < 1:
+        raise ValueError(f"gradient_accumulation must be >= 1, got {k}")
+    if k > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=k
+                              ).gradient_transformation()
+    return tx
+
+
+def build(spec: Any, params, learning_rate: Optional[float] = None,
+          lr_schedule: Any = None, total_steps: Optional[int] = None,
+          gradient_accumulation: int = 1):
     """Build (optax_tx, opt_state) for a params pytree, masking non-trainables.
 
     Returns the transformation and its initialized state.
     """
-    opt = get_optimizer(spec, learning_rate)
-    tx = optax.masked(opt.to_optax(), _trainable_mask(params))
+    tx = build_tx(spec, params, learning_rate, lr_schedule, total_steps,
+                  gradient_accumulation)
     return tx, tx.init(params)

@@ -68,7 +68,9 @@ class SPMDEngine:
                  mesh: Mesh, algorithm: str,
                  communication_window: int = 5,
                  learning_rate: Optional[float] = None,
-                 alpha: Optional[float] = None):
+                 alpha: Optional[float] = None,
+                 lr_schedule=None, schedule_steps: Optional[int] = None,
+                 gradient_accumulation: int = 1):
         self.model = model
         self.loss_fn = get_loss(loss)
         self.mesh = mesh
@@ -77,6 +79,9 @@ class SPMDEngine:
         self.num_workers = int(mesh.devices.size)
         self.alpha = alpha
         self.optimizer = opt_lib.get_optimizer(worker_optimizer, learning_rate)
+        self.lr_schedule = lr_schedule
+        self.schedule_steps = schedule_steps
+        self.gradient_accumulation = int(gradient_accumulation)
         self.tx = None  # built in init_state (needs params for masking)
         self._epoch_fn = None
         self._round_step = None
@@ -86,8 +91,10 @@ class SPMDEngine:
         params = self.model.init(rng, input_shape)
         if initial_params is not None:
             params = initial_params
-        self.tx = optax.masked(self.optimizer.to_optax(),
-                               opt_lib._trainable_mask(params))
+        self.tx = opt_lib.build_tx(
+            self.optimizer, params, lr_schedule=self.lr_schedule,
+            total_steps=self.schedule_steps,
+            gradient_accumulation=self.gradient_accumulation)
         n = self.num_workers
         # every worker starts from the same center (reference: initial pull)
         local = tmap(lambda x: jnp.broadcast_to(x, (n,) + x.shape), params)

@@ -334,6 +334,14 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     ys = [y[i::n] for i in range(n)]
 
     worker_cls = WORKER_CLASSES[algorithm]
+    # LR-schedule horizon per worker: the largest shard has ceil(len(x)/n)
+    # rows → windows/epoch × window mini-steps × epochs, ceil-divided by
+    # the accumulation factor (workers differ by at most one window)
+    accum = getattr(trainer, "gradient_accumulation", 1)
+    shard_rows = -(-len(x) // n)
+    win = trainer.communication_window
+    windows_pe = -(-shard_rows // (win * trainer.batch_size))
+    schedule_steps = -(-windows_pe * win * trainer.num_epoch // accum)
     kw = dict(
         worker_optimizer=trainer.worker_optimizer, loss=trainer.loss,
         ps_host="127.0.0.1", ps_port=server.port,
@@ -341,6 +349,8 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
         features_col=trainer.features_col, label_col=trainer.label_col,
         batch_size=trainer.batch_size, num_epoch=trainer.num_epoch,
         learning_rate=trainer.learning_rate, seed=trainer.seed,
+        lr_schedule=getattr(trainer, "lr_schedule", None),
+        schedule_steps=schedule_steps, gradient_accumulation=accum,
         wire_dtype=getattr(trainer, "wire_dtype", None))
     if worker_cls.ALGORITHM in ("aeasgd", "eamsgd"):
         kw["rho"] = getattr(trainer, "rho", 5.0)

@@ -61,11 +61,21 @@ class Trainer:
 
     def __init__(self, keras_model, loss: str = "categorical_crossentropy",
                  worker_optimizer="sgd", learning_rate: Optional[float] = None,
-                 seed: int = 0):
+                 seed: int = 0, lr_schedule=None,
+                 gradient_accumulation: int = 1):
         self.master_model = _as_model(keras_model)
         self.loss = loss
         self.worker_optimizer = worker_optimizer
         self.learning_rate = learning_rate
+        # modernized worker-optimizer surface (no reference counterpart —
+        # the 2016 upstream is fixed-LR): ``lr_schedule`` is a name/dict/
+        # callable resolved by ``core.optimizers.get_schedule`` against the
+        # trainer's own total-update count; ``gradient_accumulation`` = K
+        # averages K mini-step gradients per optimizer update
+        self.lr_schedule = lr_schedule
+        self.gradient_accumulation = int(gradient_accumulation)
+        if self.gradient_accumulation < 1:
+            raise ValueError("gradient_accumulation must be >= 1")
         self.seed = seed
         self.history: List[float] = []
         self.metrics: List[dict] = []
@@ -119,9 +129,10 @@ class SingleTrainer(Trainer):
     def __init__(self, keras_model, features_col: str = "features",
                  label_col: str = "label", batch_size: int = 32,
                  num_epoch: int = 1, loss: str = "categorical_crossentropy",
-                 worker_optimizer="sgd", learning_rate=None, seed: int = 0):
+                 worker_optimizer="sgd", learning_rate=None, seed: int = 0,
+                 lr_schedule=None, gradient_accumulation: int = 1):
         super().__init__(keras_model, loss, worker_optimizer, learning_rate,
-                         seed)
+                         seed, lr_schedule, gradient_accumulation)
         self.features_col = features_col
         self.label_col = label_col
         self.batch_size = int(batch_size)
@@ -133,9 +144,16 @@ class SingleTrainer(Trainer):
         y = dataset[self.label_col]
         input_shape = x.shape[1:]
         params = self._initial_params(input_shape)
+        # schedule horizon = optimizer updates over the whole run: ceil-div
+        # mini-steps by the accumulation factor (MultiSteps advances its
+        # inner clock once per K mini-steps)
+        steps_per_epoch = -(-len(x) // self.batch_size)
+        total_updates = -(-steps_per_epoch * self.num_epoch
+                          // self.gradient_accumulation)
         state, tx = init_state(self.master_model, jax.random.PRNGKey(self.seed),
                                input_shape, self.worker_optimizer,
-                               self.learning_rate)
+                               self.learning_rate, self.lr_schedule,
+                               total_updates, self.gradient_accumulation)
         state = state._replace(params=params)
         runner = make_epoch_runner(self.master_model, self.loss, tx)
         rng = jax.random.PRNGKey(self.seed + 1)
@@ -177,9 +195,10 @@ class DistributedTrainer(Trainer):
                  checkpoint_unit: str = "epoch",
                  checkpoint_backend: str = "npz",
                  metrics_path: Optional[str] = None,
-                 wire_dtype: Optional[str] = None):
+                 wire_dtype: Optional[str] = None,
+                 lr_schedule=None, gradient_accumulation: int = 1):
         super().__init__(keras_model, loss, worker_optimizer, learning_rate,
-                         seed)
+                         seed, lr_schedule, gradient_accumulation)
         self.mesh = mesh if mesh is not None else mesh_lib.get_mesh(num_workers)
         self.num_workers = int(self.mesh.devices.size)
         self.batch_size = int(batch_size)
@@ -216,7 +235,9 @@ class DistributedTrainer(Trainer):
         engine = SPMDEngine(
             self.master_model, self.loss, self.worker_optimizer, self.mesh,
             self.ALGORITHM, self.communication_window, self.learning_rate,
-            alpha=self._elastic_alpha())
+            alpha=self._elastic_alpha(), lr_schedule=self.lr_schedule,
+            schedule_steps=getattr(self, "_schedule_steps", None),
+            gradient_accumulation=self.gradient_accumulation)
         self._state = engine.init_state(
             jax.random.PRNGKey(self.seed), self._input_shape,
             initial_params=self._initial_params(self._input_shape))
@@ -231,11 +252,16 @@ class DistributedTrainer(Trainer):
         x = np.asarray(dataset[self.features_col])
         y = np.asarray(dataset[self.label_col])
         self._input_shape = x.shape[1:]
-        engine = self.service(self._input_shape)
-        self._engine = engine
         from .data.pipeline import num_rounds
         rpe = num_rounds(len(x), self.num_workers, self.communication_window,
                          self.batch_size)  # rounds per epoch (constant)
+        # per-worker optimizer updates over the run (the LR-schedule horizon):
+        # rounds × window mini-steps per epoch, ceil-divided by accumulation
+        self._schedule_steps = -(-rpe * self.communication_window
+                                 * self.num_epoch
+                                 // self.gradient_accumulation)
+        engine = self.service(self._input_shape)
+        self._engine = engine
         ckpt = None
         start_epoch = 0
         skip_rounds = 0  # rounds of start_epoch already done (round unit)

@@ -40,7 +40,9 @@ class Worker:
     def __init__(self, model_blob: dict, worker_optimizer, loss,
                  features_col: str = "features", label_col: str = "label",
                  batch_size: int = 32, num_epoch: int = 1,
-                 learning_rate: Optional[float] = None, seed: int = 0):
+                 learning_rate: Optional[float] = None, seed: int = 0,
+                 lr_schedule=None, schedule_steps: Optional[int] = None,
+                 gradient_accumulation: int = 1):
         self.model_blob = model_blob
         self.worker_optimizer = worker_optimizer
         self.loss = loss
@@ -49,6 +51,9 @@ class Worker:
         self.batch_size = int(batch_size)
         self.num_epoch = int(num_epoch)
         self.learning_rate = learning_rate
+        self.lr_schedule = lr_schedule
+        self.schedule_steps = schedule_steps
+        self.gradient_accumulation = int(gradient_accumulation)
         self.seed = seed
         self.history: List[float] = []
         # lazily-built jit state (shared across threads is fine: jax caches
@@ -63,7 +68,10 @@ class Worker:
         if self._model is None:
             self._model, self._params0 = deserialize_model(self.model_blob)
             self._tx, _ = opt_lib.build(self.worker_optimizer, self._params0,
-                                        self.learning_rate)
+                                        self.learning_rate,
+                                        self.lr_schedule,
+                                        self.schedule_steps,
+                                        self.gradient_accumulation)
         return self._model
 
     def _build_window_fn(self):

@@ -42,6 +42,13 @@ def main():
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=1e-2)
+    ap.add_argument("--schedule", choices=["constant", "warmup_cosine"],
+                    default="constant",
+                    help="LR schedule (warmup 10%% of --steps, cosine to 0)")
+    ap.add_argument("--accum", type=int, default=1,
+                    help="gradient accumulation: average this many "
+                         "mini-step gradients per optimizer update")
     args = ap.parse_args()
 
     n = args.dp * args.sp * args.tp
@@ -62,7 +69,16 @@ def main():
         compute_dtype=jnp.float32 if jax.default_backend() == "cpu"
         else jnp.bfloat16)
     params = lm.init(jax.random.PRNGKey(0))
-    opt_state, step = lm.compile_train_step(optax.adam(1e-2), params)
+    # compile_train_step takes any optax transformation, so schedules and
+    # accumulation compose with the parallel program unchanged (the same
+    # get_schedule spelling the Trainer kwargs surface accepts)
+    from distkeras_tpu.core.optimizers import get_schedule
+    lr = get_schedule(None if args.schedule == "constant" else args.schedule,
+                      args.lr, total_steps=max(args.steps // args.accum, 1))
+    tx = optax.adam(lr)
+    if args.accum > 1:
+        tx = optax.MultiSteps(tx, args.accum).gradient_transformation()
+    opt_state, step = lm.compile_train_step(tx, params)
 
     # task: predict the next token of a shifted stream
     rng = np.random.default_rng(0)
