@@ -143,6 +143,8 @@ def get_schedule(spec: Any, base_lr: float,
     cfg = dict(spec)
     name = cfg.pop("name")
     if name == "constant":
+        if cfg:
+            raise ValueError(f"unknown lr_schedule keys {sorted(cfg)}")
         return base_lr
     decay_steps = cfg.pop("decay_steps", total_steps)
     if decay_steps is None:

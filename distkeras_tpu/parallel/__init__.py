@@ -4,7 +4,7 @@ from .spmd import SPMDEngine, DistState, shape_epoch_data
 from .ring import SEQ_AXIS, ring_attention, ring_self_attention
 from .tp import (MODEL_AXIS, column_parallel_dense, row_parallel_dense,
                  tp_mlp, tp_self_attention)
-from .moe import moe_mlp, top1_routing
+from .moe import load_balance_loss, moe_mlp, top1_routing, topk_routing
 from .pipeline import STAGE_AXIS, pipeline_apply
 from .transformer import ParallelTransformerLM
 from .pp_transformer import PipelineTransformerLM
@@ -17,6 +17,7 @@ __all__ = [
     "SEQ_AXIS", "ring_attention", "ring_self_attention",
     "MODEL_AXIS", "column_parallel_dense", "row_parallel_dense",
     "tp_mlp", "tp_self_attention", "moe_mlp", "top1_routing",
+    "topk_routing", "load_balance_loss",
     "STAGE_AXIS", "pipeline_apply", "ParallelTransformerLM",
     "PipelineTransformerLM",
 ]

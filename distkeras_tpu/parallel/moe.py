@@ -26,31 +26,84 @@ import jax.numpy as jnp
 EXPERT_AXIS = "model"  # experts ride the model axis by default
 
 
-def top1_routing(logits, capacity: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Top-1 router with per-expert capacity.
+def topk_routing(logits, capacity: int, k: int = 1
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, dict]:
+    """Top-k router with per-expert capacity + load-balance statistics.
 
-    logits: (T, E) f32 → dispatch (T, E, C) one-hot, combine (T, E, C)
-    gate-weighted.  Token t goes to its argmax expert e at queue slot c if
-    fewer than ``capacity`` earlier tokens chose e; otherwise it is dropped
-    (all-zero row — the caller's residual connection carries it).
+    logits: (T, E) f32 → (dispatch (T, E, C) one-hot, combine (T, E, C)
+    gate-weighted, router stats dict).  ``k=1`` is the Switch-Transformer
+    router (combine weight = raw top-1 probability); ``k=2`` is the
+    GShard-style variant — each token also goes to its second-choice expert,
+    with the two gate weights renormalized to sum to 1.  A choice lands at
+    queue slot c of expert e only if fewer than ``capacity`` earlier choices
+    (first-choice traffic first) picked e; overflow is dropped (all-zero
+    row — the caller's residual connection carries it).
+
+    ``stats`` carries the Switch load-balance ingredients, each (E,):
+    ``fraction`` = share of tokens whose *first* choice is e (non-
+    differentiable), ``prob`` = mean router probability of e (the
+    differentiable path).  Feed (optionally cross-shard-averaged) stats to
+    ``load_balance_loss`` and weight the result into the model loss
+    (~1e-2) to keep experts alive.  Averaging the *stats* across shards
+    before forming the product keeps the loss identical to the
+    single-device computation — averaging per-shard products would not.
     """
-    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(gates, axis=-1)                       # (T,)
-    gate = jnp.max(gates, axis=-1)                            # (T,)
-    onehot = jax.nn.one_hot(expert, logits.shape[-1],
-                            dtype=jnp.float32)                # (T, E)
-    pos = jnp.cumsum(onehot, axis=0) * onehot                 # 1-based slot
-    onehot = onehot * (pos <= capacity)
-    slot = jax.nn.one_hot((pos - 1.0).astype(jnp.int32), capacity,
-                          dtype=jnp.float32)                  # (T, E, C)
-    dispatch = onehot[..., None] * slot
-    combine = dispatch * gate[:, None, None]
+    e = logits.shape[-1]
+    if not 1 <= k <= e:
+        raise ValueError(f"router k must be in [1, {e}], got {k}")
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # (T, E)
+
+    # Switch load-balance statistics on the first-choice assignment
+    first = jax.nn.one_hot(jnp.argmax(gates, axis=-1), e, dtype=jnp.float32)
+    stats = {"fraction": jnp.mean(first, axis=0),
+             "prob": jnp.mean(gates, axis=0)}
+
+    # pick the k choices by iterated masked argmax
+    choices = []
+    masked = gates
+    for _ in range(k):
+        expert = jnp.argmax(masked, axis=-1)
+        gate = jnp.max(masked, axis=-1)
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+        choices.append((onehot, gate))
+        masked = masked * (1.0 - onehot)
+    # k=1 keeps the raw probability (Switch); k>1 renormalizes over choices
+    denom = (sum(g for _, g in choices) + 1e-9) if k > 1 else 1.0
+
+    dispatch = jnp.zeros(logits.shape + (capacity,), jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    counts = jnp.zeros((e,), jnp.float32)  # slots taken by earlier choices
+    for onehot, gate in choices:
+        pos = jnp.cumsum(onehot, axis=0) * onehot + counts * onehot
+        keep = onehot * (pos <= capacity)
+        slot = jax.nn.one_hot((pos - 1.0).astype(jnp.int32), capacity,
+                              dtype=jnp.float32)                # (T, E, C)
+        d = keep[..., None] * slot
+        dispatch = dispatch + d
+        combine = combine + d * (gate / denom)[:, None, None]
+        counts = counts + jnp.sum(keep, axis=0)
+    return dispatch, combine, stats
+
+
+def load_balance_loss(stats: dict) -> jnp.ndarray:
+    """Switch load-balance aux ``E · Σ_e f_e · P_e`` from router stats
+    (minimized at 1.0 for uniform routing, → E under full collapse).
+    Pass globally-averaged stats for a sharding-invariant loss."""
+    f, p = stats["fraction"], stats["prob"]
+    return f.shape[-1] * jnp.sum(f * p)
+
+
+def top1_routing(logits, capacity: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-1 router (back-compat surface): ``topk_routing(k=1)`` without
+    the load-balance stats."""
+    dispatch, combine, _ = topk_routing(logits, capacity, k=1)
     return dispatch, combine
 
 
 def moe_mlp(x, router_kernel, w1, b1, w2, b2, *,
             axis_name: str = EXPERT_AXIS, capacity_factor: float = 1.25,
-            activation=jax.nn.gelu, compute_dtype=jnp.bfloat16):
+            activation=jax.nn.gelu, compute_dtype=jnp.bfloat16,
+            router_top_k: int = 1):
     """Expert-parallel MoE MLP for (B, S, D) inputs inside shard_map.
 
     ``x`` is replicated (in value) over ``axis_name``; each shard routes only
@@ -63,8 +116,11 @@ def moe_mlp(x, router_kernel, w1, b1, w2, b2, *,
 
     router_kernel: (D, E) replicated; w1: (E_local, D, F), b1: (E_local, F),
     w2: (E_local, F, D), b2: (E_local, D) — local expert shards.  Returns
-    (B, S, D) f32 (add to the residual stream in the caller).  Requires
-    B·S divisible by the axis size.
+    ``((B, S, D) f32 output, router stats)`` — the output adds to the
+    residual stream; the stats (per-expert fraction/prob over this shard's
+    token slice, see ``topk_routing``) feed ``load_balance_loss`` after the
+    caller pmeans them across shards.  Requires B·S divisible by the axis
+    size.  ``router_top_k=2`` enables second-choice routing.
     """
     n = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -75,12 +131,17 @@ def moe_mlp(x, router_kernel, w1, b1, w2, b2, *,
     if t % n:
         raise ValueError(f"token count {t} not divisible by axis size {n}")
     t_loc = t // n
-    capacity = max(int(math.ceil(capacity_factor * t_loc / e_total)), 1)
+    # GShard capacity convention: k choices per token issue k·T_loc dispatch
+    # slots' worth of traffic, so capacity scales with router_top_k — else
+    # top-2 silently halves the effective capacity factor
+    capacity = max(int(math.ceil(
+        capacity_factor * router_top_k * t_loc / e_total)), 1)
 
     xt = x.reshape(t, d)
     xl = jax.lax.dynamic_slice_in_dim(xt, rank * t_loc, t_loc)  # my slice
     logits = xl.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
-    dispatch, combine = top1_routing(logits, capacity)      # (T_loc, E, C)
+    dispatch, combine, stats = topk_routing(logits, capacity,
+                                            router_top_k)   # (T_loc, E, C)
 
     # gather my tokens into per-expert buffers and ship each expert's buffer
     # to the device that owns it
@@ -108,4 +169,4 @@ def moe_mlp(x, router_kernel, w1, b1, w2, b2, *,
     # reassemble the full token set from the per-shard slices (ships only
     # the 1/n non-zero payload, unlike a zero-padded psum)
     y = jax.lax.all_gather(yl, axis_name, axis=0, tiled=True)
-    return y.reshape(b, s, d)
+    return y.reshape(b, s, d), stats

@@ -43,6 +43,8 @@ class ParallelTransformerLM:
                  mesh: Mesh, *, moe_layers: Tuple[int, ...] = (),
                  num_experts: Optional[int] = None,
                  capacity_factor: float = 2.0,
+                 router_top_k: int = 1,
+                 router_aux_weight: float = 1e-2,
                  compute_dtype=jnp.bfloat16, remat: bool = False,
                  ring_block_k: Optional[int] = None,
                  num_kv_heads: Optional[int] = None,
@@ -59,6 +61,10 @@ class ParallelTransformerLM:
         self.mesh = mesh
         self.moe_layers = tuple(moe_layers)
         self.capacity_factor = capacity_factor
+        # Switch load-balance recipe: the aux term (topk_routing) keeps the
+        # router from collapsing onto one expert; ~1e-2 is the paper weight
+        self.router_top_k = int(router_top_k)
+        self.router_aux_weight = float(router_aux_weight)
         self.compute_dtype = compute_dtype
         self.remat = bool(remat)
         # blockwise chunking of ring attention's local attend (memory knob
@@ -184,7 +190,8 @@ class ParallelTransformerLM:
     # -- forward --------------------------------------------------------------
     def _forward(self, params, tokens):
         """Local forward inside shard_map: tokens (B_loc, S_loc) int32 →
-        logits (B_loc, S_loc, V) f32."""
+        (logits (B_loc, S_loc, V) f32, per-MoE-layer router stats — this
+        shard's token slice; empty list for a dense stack)."""
         data_axis, seq_axis, model_axis = self.axes
         cdt = self.compute_dtype
         s_loc = tokens.shape[1]
@@ -220,34 +227,42 @@ class ParallelTransformerLM:
                     rope_positions=rope_pos)
                 x = x + attn.astype(cdt)
                 h = ln(lp["ln2"], x)
+                stats = None
                 if i in self.moe_layers:
                     # token slices route per model shard and all_gather back
                     # inside moe_mlp (value-replicated over 'model')
-                    y = moe_mlp(h, lp["router"], lp["w1"], lp["b1"],
-                                lp["w2"], lp["b2"], axis_name=model_axis,
-                                capacity_factor=self.capacity_factor,
-                                compute_dtype=cdt)
+                    y, stats = moe_mlp(h, lp["router"], lp["w1"], lp["b1"],
+                                       lp["w2"], lp["b2"],
+                                       axis_name=model_axis,
+                                       capacity_factor=self.capacity_factor,
+                                       compute_dtype=cdt,
+                                       router_top_k=self.router_top_k)
                 else:
                     y = tp_mlp(h, lp["w1"], lp["b1"], lp["w2"], lp["b2"],
                                axis_name=model_axis, compute_dtype=cdt)
-                return x + y.astype(cdt)
+                return x + y.astype(cdt), stats
 
             # remat: recompute block activations in the backward pass instead
             # of keeping them in HBM — the long-context memory/FLOPs trade
             return jax.checkpoint(body) if self.remat else body
 
+        router_stats = []
         for i, lp in enumerate(params["layers"]):
-            x = block(i)(x, lp)
+            x, stats = block(i)(x, lp)
+            if stats is not None:
+                router_stats.append(stats)
 
         x = ln(params["ln_f"], x)
-        return jax.lax.dot_general(
+        logits = jax.lax.dot_general(
             x.astype(cdt), params["head"].astype(cdt),
             (((2,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        return logits, router_stats
 
     def _loss(self, params, tokens, labels):
-        data_axis, seq_axis, _ = self.axes
-        logits = self._forward(params, tokens)
+        from .moe import load_balance_loss
+        data_axis, seq_axis, model_axis = self.axes
+        logits, router_stats = self._forward(params, tokens)
         logp = jax.nn.log_softmax(logits, axis=-1)
         picked = jnp.take_along_axis(
             logp, labels.astype(jnp.int32)[..., None], axis=-1)[..., 0]
@@ -258,7 +273,18 @@ class ParallelTransformerLM:
         # scalar pmean over 'model': a no-op in value (every model shard
         # computes the same loss) that makes the replication provable — the
         # MoE all_gather leaves activations typed model-varying
-        return jax.lax.pmean(total / count, self.axes[2])
+        loss = jax.lax.pmean(total / count, model_axis)
+        for stats in router_stats:
+            # every (data, seq, model) shard routes an equal-sized disjoint
+            # token slice: pmean the STATS first, then form the f·P product
+            # once — the loss is then identical on any mesh shape
+            # (averaging per-shard products would not be)
+            global_stats = jax.tree_util.tree_map(
+                lambda v: jax.lax.pmean(v, (data_axis, seq_axis,
+                                            model_axis)), stats)
+            loss = loss + (self.router_aux_weight
+                           * load_balance_loss(global_stats))
+        return loss
 
     # -- train step -----------------------------------------------------------
     def compile_train_step(self, optimizer: optax.GradientTransformation,

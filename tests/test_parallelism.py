@@ -133,7 +133,7 @@ def test_moe_matches_reference(eight_devices):
         # cannot infer that statically; psum/n makes replication provable
         lambda x_, r_, w1_, b1_, w2_, b2_: jax.lax.psum(moe_mlp(
             x_, r_, w1_, b1_, w2_, b2_, axis_name="model",
-            capacity_factor=2.0, compute_dtype=jnp.float32), "model") / 8,
+            capacity_factor=2.0, compute_dtype=jnp.float32)[0], "model") / 8,
         mesh=mesh,
         in_specs=(P(), P(), P("model"), P("model"), P("model"), P("model")),
         out_specs=P())
@@ -158,7 +158,7 @@ def test_moe_gradients_flow(eight_devices):
         fn = jax.shard_map(
             lambda x_, r_, a, b_, c, d_: jax.lax.psum(moe_mlp(
                 x_, r_, a, b_, c, d_, axis_name="model",
-                capacity_factor=2.0, compute_dtype=jnp.float32),
+                capacity_factor=2.0, compute_dtype=jnp.float32)[0],
                 "model") / 8,
             mesh=mesh,
             in_specs=(P(), P(), P("model"), P("model"), P("model"),
@@ -169,6 +169,134 @@ def test_moe_gradients_flow(eight_devices):
     g = jax.grad(loss)(w1)
     assert np.isfinite(np.asarray(g)).all()
     assert float(jnp.abs(g).sum()) > 0
+
+
+def test_topk_routing_aux_and_top2():
+    from distkeras_tpu.parallel.moe import load_balance_loss, topk_routing
+    # aux closed forms: uniform routing scores 1, full collapse scores ~E
+    t, e = 8, 4
+    uniform = jnp.zeros((t, e))
+    _, _, stats_u = topk_routing(uniform, capacity=t, k=1)
+    np.testing.assert_allclose(float(load_balance_loss(stats_u)), 1.0,
+                               atol=1e-6)
+    collapsed = jnp.tile(jnp.array([[50.0, 0, 0, 0]]), (t, 1))
+    _, _, stats_c = topk_routing(collapsed, capacity=t, k=1)
+    np.testing.assert_allclose(float(load_balance_loss(stats_c)), e,
+                               rtol=1e-3)
+
+    # top-2: each token reaches its two largest-gate experts, weights
+    # renormalized to sum to 1
+    logits = jnp.array([[3.0, 2.0, -50.0], [0.0, 1.0, 2.0]])
+    dispatch, combine, _ = topk_routing(logits, capacity=2, k=2)
+    assert dispatch[0, 0].sum() == 1 and dispatch[0, 1].sum() == 1
+    assert dispatch[0, 2].sum() == 0
+    assert dispatch[1, 2].sum() == 1 and dispatch[1, 1].sum() == 1
+    g = jax.nn.softmax(logits, -1)
+    np.testing.assert_allclose(
+        float(combine[0].sum()), 1.0, atol=1e-5)  # renormalized pair
+    np.testing.assert_allclose(
+        float(combine[0, 0].sum()),
+        float(g[0, 0] / (g[0, 0] + g[0, 1])), atol=1e-5)
+
+    # capacity counts first-choice traffic before second choices: with
+    # capacity=1, a second choice cannot evict a first choice
+    crowd = jnp.array([[4.0, 0.0], [3.0, 0.0], [0.0, 3.0]])
+    d2, _, _ = topk_routing(crowd, capacity=1, k=2)
+    assert d2[0, 0].sum() == 1    # token 0 first-choice e0 kept
+    assert d2[1, 0].sum() == 0    # token 1 first-choice e0 over capacity
+    assert d2[2, 1].sum() == 1    # token 2 first-choice e1 kept
+    # second choices (e1 for 0/1, e0 for 2) all hit full experts → dropped
+    assert float(d2.sum()) == 2.0
+
+    with pytest.raises(ValueError, match="router k"):
+        topk_routing(logits, capacity=2, k=5)
+
+
+def test_moe_aux_loss_prevents_expert_starvation(eight_devices):
+    """Train a 2-expert MoE regression whose router starts collapsed onto
+    expert 0; the Switch aux loss must revive expert 1 (utilization bounds)
+    while the task loss still falls."""
+    from distkeras_tpu.parallel.moe import moe_mlp
+    mesh = get_mesh(2, axis_name="model")
+    rng = np.random.default_rng(0)
+    t, d, f = 64, 8, 16
+    # two input clusters needing different linear maps; both have positive
+    # mean so the biased router below prefers expert 0 for EVERY token
+    # (the router is bias-free: logit_0 = 2·Σx > 0 > logit_1 for both)
+    half = t // 2
+    x = np.concatenate([rng.normal(1.0, 0.3, (half, d)),
+                        rng.normal(0.3, 0.3, (t - half, d))]).astype(
+                            np.float32)[None]                  # (1, T, D)
+    w_a = rng.normal(0, 1, (d, d)).astype(np.float32)
+    w_b = rng.normal(0, 1, (d, d)).astype(np.float32)
+    y = np.concatenate([x[0, :half] @ w_a, x[0, half:] @ w_b])[None]
+
+    params = {
+        # collapsed start: every token prefers expert 0
+        "router": jnp.concatenate([jnp.full((d, 1), 0.5),
+                                   jnp.full((d, 1), -0.5)], axis=1),
+        "w1": jnp.asarray(rng.normal(0, 0.2, (2, d, f)), jnp.float32),
+        "b1": jnp.zeros((2, f)),
+        "w2": jnp.asarray(rng.normal(0, 0.2, (2, f, d)), jnp.float32),
+        "b2": jnp.zeros((2, d)),
+    }
+
+    from distkeras_tpu.parallel.moe import load_balance_loss
+
+    def loss_fn(p, aux_weight):
+        def local(x_, y_, r_, w1_, b1_, w2_, b2_):
+            out, stats = moe_mlp(x_, r_, w1_, b1_, w2_, b2_,
+                                 axis_name="model", capacity_factor=2.0,
+                                 compute_dtype=jnp.float32)
+            mse = jnp.mean((out - y_) ** 2)
+            stats = jax.tree_util.tree_map(
+                lambda v: jax.lax.pmean(v, "model"), stats)
+            return (jax.lax.pmean(mse, "model")
+                    + aux_weight * load_balance_loss(stats))
+        fn = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), P(), P(), P("model"), P("model"), P("model"),
+                      P("model")),
+            out_specs=P())
+        return fn(jnp.asarray(x), jnp.asarray(y), p["router"], p["w1"],
+                  p["b1"], p["w2"], p["b2"])
+
+    def utilization(p):
+        gates = np.asarray(
+            jax.nn.softmax(jnp.asarray(x[0]) @ p["router"], axis=-1))
+        frac = np.bincount(gates.argmax(-1), minlength=2) / t
+        return frac
+
+    assert utilization(params)[0] == 1.0  # collapsed before training
+
+    import optax
+
+    def train(aux_weight, steps=200):
+        tx = optax.adam(3e-2)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(p, o):
+            l, g = jax.value_and_grad(
+                lambda q: loss_fn(q, aux_weight))(p)
+            updates, o = tx.update(g, o, p)
+            return l, optax.apply_updates(p, updates), o
+
+        p, first = params, None
+        for _ in range(steps):
+            l, p, opt = step(p, opt)
+            first = float(l) if first is None else first
+        return p, first, float(l)
+
+    # aux weight sized to the toy mse scale (~1e1 vs aux ∈ [1, 2])
+    p_aux, first, last = train(aux_weight=0.1)
+    frac = utilization(p_aux)
+    assert frac.min() >= 0.2, f"expert starved: utilization {frac}"
+    assert last < first
+    # contrast: without the aux term the same run stays collapsed — the
+    # balance really comes from the loss, not from the task gradient
+    p_no, _, _ = train(aux_weight=0.0)
+    assert utilization(p_no).max() > 0.85
 
 
 # ---------------------------------------------------------------------------
@@ -270,6 +398,21 @@ def test_pipeline_transformer_matches_sequential(eight_devices):
             np.asarray(a), np.asarray(b), atol=1e-5,
             err_msg=str(pa))
 
+    # remat=True recomputes stage internals in backward — same grads
+    # (before the optimizer step below donates the params buffers)
+    lm_r = PipelineTransformerLM(
+        vocab_size=32, seq_len=16, d_model=16, num_heads=2, num_layers=4,
+        mlp_dim=32, mesh=mesh, num_microbatches=2,
+        compute_dtype=jnp.float32, remat=True)
+    loss_m, grads_m = jax.jit(jax.shard_map(
+        jax.value_and_grad(lm_r._local_loss), mesh=mesh,
+        in_specs=(lm_r.param_specs(), P("data"), P("data")),
+        out_specs=(P(), lm_r.param_specs())))(params, tokens, labels)
+    np.testing.assert_allclose(float(loss_m), float(loss_r), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(grads_m)),
+                    jax.tree_util.tree_leaves(jax.device_get(grads_p))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
     # and a full optimizer step executes
     opt_state, step = lm.compile_train_step(optax.adam(1e-3), params)
     params2, opt_state, loss = step(params, opt_state, tokens, labels)
@@ -279,3 +422,10 @@ def test_pipeline_transformer_matches_sequential(eight_devices):
         lm.init(jax.random.PRNGKey(0))["layers"]["wq"]))
     w_after = np.asarray(jax.device_get(params2["layers"]["wq"]))
     assert not np.allclose(w_before, w_after)
+
+    # analytic bubble fraction: (n-1)/(M+n-1)
+    assert lm.bubble_fraction() == pytest.approx(3 / 5)
+    assert PipelineTransformerLM(
+        vocab_size=32, seq_len=16, d_model=16, num_heads=2, num_layers=4,
+        mlp_dim=32, mesh=mesh,
+        num_microbatches=8).bubble_fraction() == pytest.approx(3 / 11)

@@ -43,6 +43,8 @@ def test_get_schedule_closed_forms():
         get_schedule("polynomial", 0.1, 10)
     with pytest.raises(ValueError, match="unknown lr_schedule keys"):
         get_schedule({"name": "cosine", "warmup_steps": 3}, 0.1, 10)
+    with pytest.raises(ValueError, match="unknown lr_schedule keys"):
+        get_schedule({"name": "constant", "warmup_steps": 3}, 0.1, 10)
     with pytest.raises(TypeError, match="lr_schedule"):
         get_schedule(42, 0.1, 10)
 
