@@ -46,7 +46,8 @@ class Job:
                  hosts: Optional[Sequence[str]] = None,
                  env: Optional[Dict[str, str]] = None,
                  python: str = sys.executable,
-                 coordinator_port: int = COORDINATOR_PORT):
+                 coordinator_port: int = COORDINATOR_PORT,
+                 coordinated: bool = True):
         self.name = name
         self.script = script
         self.args = list(args)
@@ -54,21 +55,27 @@ class Job:
         self.env = dict(env or {})
         self.python = python
         self.coordinator_port = int(coordinator_port)
+        # coordinated=False: processes are independent (no jax.distributed
+        # group) — e.g. PS workers that only speak the socket wire; one
+        # crashing must not stall the others at an init barrier
+        self.coordinated = bool(coordinated)
         self.returncodes: List[int] = []
         self.processes: List[subprocess.Popen] = []
 
     # -- environment rendering ----------------------------------------------
     def host_env(self, process_id: int) -> Dict[str, str]:
-        """Per-host env for ``jax.distributed.initialize`` discovery."""
+        """Per-host env for ``jax.distributed.initialize`` discovery (or
+        just the process id when ``coordinated=False``)."""
         num = max(len(self.hosts), 1)
         coordinator = (self.hosts[0] if self.hosts else "127.0.0.1")
         env = dict(self.env)
-        env.update({
-            "DISTKERAS_TPU_COORDINATOR":
-                f"{coordinator}:{self.coordinator_port}",
-            "DISTKERAS_TPU_NUM_PROCESSES": str(num),
-            "DISTKERAS_TPU_PROCESS_ID": str(process_id),
-        })
+        env["DISTKERAS_TPU_PROCESS_ID"] = str(process_id)
+        if self.coordinated:
+            env.update({
+                "DISTKERAS_TPU_COORDINATOR":
+                    f"{coordinator}:{self.coordinator_port}",
+                "DISTKERAS_TPU_NUM_PROCESSES": str(num),
+            })
         return env
 
     def command(self) -> List[str]:
@@ -96,14 +103,16 @@ class Job:
     def to_record(self) -> dict:
         return {"name": self.name, "script": self.script, "args": self.args,
                 "hosts": self.hosts, "env": self.env, "python": self.python,
-                "coordinator_port": self.coordinator_port}
+                "coordinator_port": self.coordinator_port,
+                "coordinated": self.coordinated}
 
     @classmethod
     def from_record(cls, rec: dict) -> "Job":
         return cls(rec["name"], rec["script"], rec.get("args", ()),
                    rec.get("hosts"), rec.get("env"),
                    rec.get("python", sys.executable),
-                   rec.get("coordinator_port", COORDINATOR_PORT))
+                   rec.get("coordinator_port", COORDINATOR_PORT),
+                   rec.get("coordinated", True))
 
 
 class JobRunner:

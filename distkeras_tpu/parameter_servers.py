@@ -21,6 +21,7 @@ tests/test_host_ps.py asserts the two implementations agree.
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
 from typing import Any, Dict, List, Optional
@@ -334,26 +335,9 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     ys = [y[i::n] for i in range(n)]
 
     worker_cls = WORKER_CLASSES[algorithm]
-    # LR-schedule horizon per worker: the largest shard has ceil(len(x)/n)
-    # rows → windows/epoch × window mini-steps × epochs, ceil-divided by
-    # the accumulation factor (workers differ by at most one window)
-    accum = getattr(trainer, "gradient_accumulation", 1)
-    shard_rows = -(-len(x) // n)
-    win = trainer.communication_window
-    windows_pe = -(-shard_rows // (win * trainer.batch_size))
-    schedule_steps = -(-windows_pe * win * trainer.num_epoch // accum)
-    kw = dict(
-        worker_optimizer=trainer.worker_optimizer, loss=trainer.loss,
-        ps_host="127.0.0.1", ps_port=server.port,
-        communication_window=trainer.communication_window,
-        features_col=trainer.features_col, label_col=trainer.label_col,
-        batch_size=trainer.batch_size, num_epoch=trainer.num_epoch,
-        learning_rate=trainer.learning_rate, seed=trainer.seed,
-        lr_schedule=getattr(trainer, "lr_schedule", None),
-        schedule_steps=schedule_steps, gradient_accumulation=accum,
-        wire_dtype=getattr(trainer, "wire_dtype", None))
-    if worker_cls.ALGORITHM in ("aeasgd", "eamsgd"):
-        kw["rho"] = getattr(trainer, "rho", 5.0)
+    kw = _worker_kwargs(trainer, n, len(x))
+    kw.update(worker_optimizer=trainer.worker_optimizer,
+              ps_host="127.0.0.1", ps_port=server.port)
 
     workers = [worker_cls(blob, **kw) for _ in range(n)]
     share_compiled_state(workers)  # compile the window program once, not N×
@@ -458,6 +442,151 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     trainer.history.clear()
     for w in workers:
         trainer.history.extend(w.history)
+    fitted = server.get_model()
+    trainer._fitted = fitted
+    trainer.record_training_stop()
+    return fitted
+
+
+def _worker_kwargs(trainer, n: int, rows: int) -> dict:
+    """Worker construction kwargs shared by the host (thread) and process
+    PS engines — one place for the LR-schedule horizon formula and the
+    elastic rho special-case.
+
+    Schedule horizon per worker: the largest shard has ceil(rows/n) rows →
+    windows/epoch × window mini-steps × epochs, ceil-divided by the
+    accumulation factor (workers differ by at most one window).
+    """
+    accum = getattr(trainer, "gradient_accumulation", 1)
+    win = trainer.communication_window
+    shard_rows = -(-rows // n)
+    windows_pe = -(-shard_rows // (win * trainer.batch_size))
+    kw = dict(
+        loss=trainer.loss, communication_window=win,
+        features_col=trainer.features_col, label_col=trainer.label_col,
+        batch_size=trainer.batch_size, num_epoch=trainer.num_epoch,
+        learning_rate=trainer.learning_rate, seed=trainer.seed,
+        lr_schedule=getattr(trainer, "lr_schedule", None),
+        schedule_steps=-(-windows_pe * win * trainer.num_epoch // accum),
+        gradient_accumulation=accum,
+        wire_dtype=getattr(trainer, "wire_dtype", None))
+    if trainer.ALGORITHM in ("aeasgd", "eamsgd"):
+        kw["rho"] = getattr(trainer, "rho", 5.0)
+    return kw
+
+
+def run_process_ps_training(trainer, dataset, shuffle: bool = False
+                            ) -> FittedModel:
+    """Execute a DistributedTrainer with workers as separate OS PROCESSES.
+
+    This is the actual reference topology (SURVEY.md §3.1): the driver
+    process hosts the socket PS; each worker is its own interpreter,
+    launched with ``job_deployment.LocalJobRunner`` on loopback.  Unlike
+    ``execution='host_ps'`` (threads in one interpreter, GIL-shared), the
+    workers here share nothing but the TCP socket: the test proof that the
+    wire protocol, and not thread memory sharing, carries training.
+
+    Workers are launched *uncoordinated* (``Job(coordinated=False)``): PS
+    clients never use collectives, and a shared ``jax.distributed`` group
+    would stall the healthy workers at the init barrier if one died.
+
+    Model blob and per-worker shards travel via a driver-local scratch
+    directory (the Spark analogue: closure + partition shipping);
+    histories return the same way.  A real multi-host DCN deployment keeps
+    the same ``ps_worker_main`` entry point and ``DISTKERAS_TPU_*`` env
+    contract via ``SSHJobRunner``, but additionally needs a shared scratch
+    path and a PS bound on a routable interface — same-host processes are
+    what this function wires up today.  Checkpoint/resume stays on the
+    in-process engines.
+    """
+    import json
+    import tempfile
+
+    from .job_deployment import Job, LocalJobRunner
+    from .ps_worker_main import save_model_blob
+
+    algorithm = trainer.ALGORITHM
+    if algorithm not in WORKER_CLASSES:
+        raise ValueError(
+            f"execution='process_ps' supports PS algorithms "
+            f"{sorted(WORKER_CLASSES)}, not {algorithm!r} "
+            f"({type(trainer).__name__})")
+    if trainer.checkpoint_dir is not None:
+        raise ValueError(
+            "checkpoint/resume is not supported on execution='process_ps' "
+            "(use 'host_ps' for epoch-wave checkpoints)")
+
+    trainer.record_training_start()
+    x = np.asarray(dataset[trainer.features_col])
+    y = np.asarray(dataset[trainer.label_col])
+    if shuffle:
+        perm = np.random.default_rng(trainer.seed).permutation(len(x))
+        x, y = x[perm], y[perm]
+    params = trainer._initial_params(x.shape[1:])
+    blob = serialize_model(trainer.master_model, params)
+
+    n = trainer.num_workers * getattr(trainer, "parallelism_factor", 1)
+    if len(x) < n:
+        raise ValueError(
+            f"dataset of {len(x)} rows has fewer rows than workers ({n})")
+    ps = allocate_parameter_server(algorithm, blob, n)
+    server = SocketParameterServer(ps)
+    server.start()
+
+    optimizer = trainer.worker_optimizer
+    if not isinstance(optimizer, str):  # Optimizer object → JSON config
+        optimizer = optimizer.get_config()
+    kw = _worker_kwargs(trainer, n, len(x))
+    if callable(kw["lr_schedule"]):
+        raise ValueError(
+            "execution='process_ps' cannot ship a callable lr_schedule to "
+            "worker processes — pass a name or config dict "
+            "(e.g. 'warmup_cosine'), or use execution='host_ps'")
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="dkt_procps_") as tmp:
+            model_path = os.path.join(tmp, "model.npz")
+            save_model_blob(model_path, blob)
+            shard_paths, result_paths = [], []
+            for i in range(n):  # round-robin deal, as the thread engine
+                p = os.path.join(tmp, f"shard_{i}.npz")
+                np.savez(p, x=x[i::n], y=y[i::n])
+                shard_paths.append(p)
+                result_paths.append(os.path.join(tmp, f"result_{i}.npz"))
+            cfg_path = os.path.join(tmp, "worker_config.json")
+            with open(cfg_path, "w") as f:
+                json.dump({
+                    **kw,
+                    "algorithm": algorithm,
+                    "model_path": model_path,
+                    "shard_paths": shard_paths,
+                    "result_paths": result_paths,
+                    "ps_host": "127.0.0.1",
+                    "ps_port": server.port,
+                    "worker_optimizer": optimizer,
+                }, f)
+
+            # repo root on PYTHONPATH so `-m distkeras_tpu.ps_worker_main`
+            # resolves in the child even without an installed package
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            env = {"PYTHONPATH": os.pathsep.join(
+                p for p in (pkg_root, os.environ.get("PYTHONPATH")) if p)}
+            job = Job(name=f"{algorithm}-process-ps", script="-m",
+                      args=["distkeras_tpu.ps_worker_main", cfg_path],
+                      hosts=["127.0.0.1"] * n, env=env, coordinated=False)
+            rc = job.run(LocalJobRunner())
+            if rc != 0:
+                raise RuntimeError(
+                    f"worker process failed (exit codes {job.returncodes})")
+
+            trainer.history.clear()
+            for p in result_paths:
+                with np.load(p) as z:
+                    trainer.history.extend(z["history"].tolist())
+    finally:
+        server.stop()
+
     fitted = server.get_model()
     trainer._fitted = fitted
     trainer.record_training_stop()

@@ -1,0 +1,93 @@
+"""Standalone parameter-server worker process — the DCN executor.
+
+The reference ran each worker closure in a Spark *executor process* on
+another machine, dialing back to the driver's socket PS (reference:
+``distkeras/workers.py`` shipped via ``rdd.mapPartitionsWithIndex`` —
+SURVEY.md §3.1).  This module is that executor for the TPU rebuild: a
+process entry point that loads its shard + model blob from disk, connects
+to the PS over TCP, trains with the jitted window loop, and writes its
+history back for the driver to collect.
+
+Launched by ``parameter_servers.run_process_ps_training`` through
+``job_deployment.Job`` — ``LocalJobRunner`` for same-host processes (the
+cross-process test path), ``SSHJobRunner`` for real multi-host DCN
+deployments.  The worker id comes from the ``DISTKERAS_TPU_PROCESS_ID``
+env var ``Job.host_env`` renders, and ``initialize_from_env()`` runs first
+so a deployment that also wants a jax.distributed mesh in the workers gets
+it from the same env contract.
+
+Usage: ``python -m distkeras_tpu.ps_worker_main <config.json>``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def load_model_blob(path: str) -> dict:
+    """Read a {'model': json, 'weights': [...]} blob from disk — one codec
+    for the framework: ``FittedModel``'s npz layout."""
+    from .core.model import FittedModel
+    return FittedModel.load(path).serialize()
+
+
+def save_model_blob(path: str, blob: dict) -> None:
+    from .core.model import FittedModel
+    FittedModel.deserialize(blob).save(path)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv if argv is None else argv
+    if len(argv) != 2:
+        print("usage: python -m distkeras_tpu.ps_worker_main <config.json>",
+              file=sys.stderr)
+        return 2
+    from .utils import honor_platform_env
+    honor_platform_env()
+    from .job_deployment import initialize_from_env
+    initialize_from_env()
+
+    import numpy as np
+
+    from .workers import WORKER_CLASSES
+
+    with open(argv[1]) as f:
+        cfg = json.load(f)
+    worker_id = int(os.environ.get("DISTKERAS_TPU_PROCESS_ID",
+                                   cfg.get("worker_id", 0)))
+
+    blob = load_model_blob(cfg["model_path"])
+    with np.load(cfg["shard_paths"][worker_id]) as z:
+        shard = {cfg["features_col"]: z["x"], cfg["label_col"]: z["y"]}
+
+    optimizer = cfg["worker_optimizer"]
+    if isinstance(optimizer, dict):  # Optimizer.get_config round-trip
+        from .core.optimizers import Optimizer
+        optimizer = Optimizer(**optimizer)
+
+    worker_cls = WORKER_CLASSES[cfg["algorithm"]]
+    kw = dict(
+        worker_optimizer=optimizer, loss=cfg["loss"],
+        ps_host=cfg["ps_host"], ps_port=cfg["ps_port"],
+        communication_window=cfg["communication_window"],
+        features_col=cfg["features_col"], label_col=cfg["label_col"],
+        batch_size=cfg["batch_size"], num_epoch=cfg["num_epoch"],
+        learning_rate=cfg["learning_rate"], seed=cfg["seed"],
+        lr_schedule=cfg.get("lr_schedule"),
+        schedule_steps=cfg.get("schedule_steps"),
+        gradient_accumulation=cfg.get("gradient_accumulation", 1),
+        wire_dtype=cfg.get("wire_dtype"))
+    if worker_cls.ALGORITHM in ("aeasgd", "eamsgd"):
+        kw["rho"] = cfg.get("rho", 5.0)
+    worker = worker_cls(blob, **kw)
+
+    result = worker.train(worker_id, shard)
+    np.savez(cfg["result_paths"][worker_id],
+             history=np.asarray(result["history"], np.float32))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -248,6 +248,12 @@ class DistributedTrainer(Trainer):
         if self.execution == "host_ps":
             from .parameter_servers import run_host_ps_training
             return run_host_ps_training(self, dataset, shuffle, resume=resume)
+        if self.execution == "process_ps":
+            if resume:
+                raise ValueError(
+                    "resume is not supported on execution='process_ps'")
+            from .parameter_servers import run_process_ps_training
+            return run_process_ps_training(self, dataset, shuffle)
         self.record_training_start()
         x = np.asarray(dataset[self.features_col])
         y = np.asarray(dataset[self.label_col])

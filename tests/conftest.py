@@ -44,3 +44,7 @@ def pytest_configure(config):
         "markers",
         "tpu: exercises the real TPU chip in a subprocess (auto-skips when "
         "no accelerator is reachable)")
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process end-to-end tests (worker subprocesses each "
+        "import jax and compile)")
