@@ -84,9 +84,14 @@ def _synthetic_classification(n: int, shape: Tuple[int, ...], num_classes: int,
 
 
 def load_mnist(n_train: int = 60_000, n_test: int = 10_000,
-               seed: int = 0) -> Tuple[Dataset, Dataset]:
+               seed: int = 0, noise: float = 0.35
+               ) -> Tuple[Dataset, Dataset]:
     """MNIST as flat 784-dim feature rows, pixel range [0, 255] (matching the
-    reference's raw-CSV representation fed through MinMaxTransformer)."""
+    reference's raw-CSV representation fed through MinMaxTransformer).
+
+    ``noise`` only shapes the synthetic fallback (ignored on real npz data):
+    raising it makes the stand-in task genuinely hard, which parity/accuracy
+    gates need — at the default every capable model saturates at 1.0."""
     real = _try_load_npz("mnist")
     if real is not None:
         xtr = real["x_train"].reshape(-1, 784).astype(np.float32)[:n_train]
@@ -95,9 +100,11 @@ def load_mnist(n_train: int = 60_000, n_test: int = 10_000,
         yte = real["y_test"].astype(np.int64)[:n_test]
     else:
         xtr, ytr = _synthetic_classification(n_train, (784,), 10, seed,
+                                             noise=noise,
                                              image_hw=(28, 28, 1),
                                              proto_seed=seed)
         xte, yte = _synthetic_classification(n_test, (784,), 10, seed + 1,
+                                             noise=noise,
                                              image_hw=(28, 28, 1),
                                              proto_seed=seed)
     return (Dataset({"features": xtr, "label": ytr}),
