@@ -198,19 +198,27 @@ class FittedModel:
         """Persist spec+weights as .npz (final-model persistence; the
         reference's only persistence was ``model.save`` on the returned
         Keras model)."""
-        import io
-        weights = {f"w{i}": w for i, w in enumerate(self.get_weights())}
-        np.savez(path, spec=np.frombuffer(
-            self.model.to_json().encode(), dtype=np.uint8), **weights)
+        write_npz_blob(path, self.serialize())
 
     @staticmethod
     def load(path: str) -> "FittedModel":
-        with np.load(path) as z:
-            spec = bytes(z["spec"]).decode()
-            model = Sequential.from_json(spec)
-            weights = [z[f"w{i}"] for i in range(len(z.files) - 1)]
-        params = model.init(jax.random.PRNGKey(0), model.input_shape)
-        return FittedModel(model, model.set_weights(params, weights))
+        return FittedModel.deserialize(read_npz_blob(path))
+
+
+def write_npz_blob(path: str, blob: dict) -> None:
+    """The framework's ONE npz model layout (``spec`` json bytes + ``w{i}``
+    weight arrays) — shared by ``FittedModel.save`` and the process-worker
+    shipping path, which writes straight from a blob without re-tracing."""
+    weights = {f"w{i}": np.asarray(w) for i, w in enumerate(blob["weights"])}
+    np.savez(path, spec=np.frombuffer(blob["model"].encode(),
+                                      dtype=np.uint8), **weights)
+
+
+def read_npz_blob(path: str) -> dict:
+    with np.load(path) as z:
+        spec = bytes(z["spec"]).decode()
+        weights = [z[f"w{i}"] for i in range(len(z.files) - 1)]
+    return {"model": spec, "weights": weights}
 
 
 def serialize_model(model: Sequential, params: Params) -> dict:

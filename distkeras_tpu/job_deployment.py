@@ -76,6 +76,13 @@ class Job:
                     f"{coordinator}:{self.coordinator_port}",
                 "DISTKERAS_TPU_NUM_PROCESSES": str(num),
             })
+        else:
+            # explicitly blank (not merely omit): launchers overlay this on
+            # os.environ, and a driver that itself runs under a coordinated
+            # Job must not leak its coordinator into uncoordinated children
+            # (they would try to join the parent's jax.distributed group)
+            env.update({"DISTKERAS_TPU_COORDINATOR": "",
+                        "DISTKERAS_TPU_NUM_PROCESSES": "1"})
         return env
 
     def command(self) -> List[str]:

@@ -529,10 +529,8 @@ def run_process_ps_training(trainer, dataset, shuffle: bool = False
     if len(x) < n:
         raise ValueError(
             f"dataset of {len(x)} rows has fewer rows than workers ({n})")
-    ps = allocate_parameter_server(algorithm, blob, n)
-    server = SocketParameterServer(ps)
-    server.start()
-
+    # all validation/config prep BEFORE the server starts: an error here
+    # must not leak the listener thread
     optimizer = trainer.worker_optimizer
     if not isinstance(optimizer, str):  # Optimizer object → JSON config
         optimizer = optimizer.get_config()
@@ -543,6 +541,9 @@ def run_process_ps_training(trainer, dataset, shuffle: bool = False
             "worker processes — pass a name or config dict "
             "(e.g. 'warmup_cosine'), or use execution='host_ps'")
 
+    ps = allocate_parameter_server(algorithm, blob, n)
+    server = SocketParameterServer(ps)
+    server.start()
     try:
         with tempfile.TemporaryDirectory(prefix="dkt_procps_") as tmp:
             model_path = os.path.join(tmp, "model.npz")
@@ -575,8 +576,9 @@ def run_process_ps_training(trainer, dataset, shuffle: bool = False
             job = Job(name=f"{algorithm}-process-ps", script="-m",
                       args=["distkeras_tpu.ps_worker_main", cfg_path],
                       hosts=["127.0.0.1"] * n, env=env, coordinated=False)
-            rc = job.run(LocalJobRunner())
-            if rc != 0:
+            job.run(LocalJobRunner())
+            # max() would mask signal deaths (negative codes) behind a 0
+            if any(c != 0 for c in job.returncodes):
                 raise RuntimeError(
                     f"worker process failed (exit codes {job.returncodes})")
 

@@ -28,14 +28,14 @@ import sys
 
 def load_model_blob(path: str) -> dict:
     """Read a {'model': json, 'weights': [...]} blob from disk — one codec
-    for the framework: ``FittedModel``'s npz layout."""
-    from .core.model import FittedModel
-    return FittedModel.load(path).serialize()
+    for the framework (``core.model``'s npz layout), no re-trace."""
+    from .core.model import read_npz_blob
+    return read_npz_blob(path)
 
 
 def save_model_blob(path: str, blob: dict) -> None:
-    from .core.model import FittedModel
-    FittedModel.deserialize(blob).save(path)
+    from .core.model import write_npz_blob
+    write_npz_blob(path, blob)
 
 
 def main(argv=None) -> int:
@@ -67,21 +67,15 @@ def main(argv=None) -> int:
         from .core.optimizers import Optimizer
         optimizer = Optimizer(**optimizer)
 
+    # the config is _worker_kwargs' output plus transport keys: pass the
+    # kwargs through verbatim so a kwarg added there reaches the child
+    # without this module re-enumerating the list (rho is present exactly
+    # when the worker class accepts it)
+    transport = {"algorithm", "model_path", "shard_paths", "result_paths",
+                 "worker_optimizer"}
+    kw = {k: v for k, v in cfg.items() if k not in transport}
     worker_cls = WORKER_CLASSES[cfg["algorithm"]]
-    kw = dict(
-        worker_optimizer=optimizer, loss=cfg["loss"],
-        ps_host=cfg["ps_host"], ps_port=cfg["ps_port"],
-        communication_window=cfg["communication_window"],
-        features_col=cfg["features_col"], label_col=cfg["label_col"],
-        batch_size=cfg["batch_size"], num_epoch=cfg["num_epoch"],
-        learning_rate=cfg["learning_rate"], seed=cfg["seed"],
-        lr_schedule=cfg.get("lr_schedule"),
-        schedule_steps=cfg.get("schedule_steps"),
-        gradient_accumulation=cfg.get("gradient_accumulation", 1),
-        wire_dtype=cfg.get("wire_dtype"))
-    if worker_cls.ALGORITHM in ("aeasgd", "eamsgd"):
-        kw["rho"] = cfg.get("rho", 5.0)
-    worker = worker_cls(blob, **kw)
+    worker = worker_cls(blob, worker_optimizer=optimizer, **kw)
 
     result = worker.train(worker_id, shard)
     np.savez(cfg["result_paths"][worker_id],
