@@ -21,6 +21,8 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+tmap = jax.tree_util.tree_map
+
 STAGE_AXIS = "stage"
 
 
@@ -73,3 +75,145 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
 
     (_, outputs), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(ticks))
     return outputs
+
+
+def pipeline_1f1b(stage_fn, stage_params, x_micro, labels_micro,
+                  head_loss_fn, head_params, axis_name: str = STAGE_AXIS):
+    """One-forward-one-backward pipeline TRAIN step — call inside shard_map.
+
+    GPipe's backward (reverse-mode autodiff through ``pipeline_apply``'s
+    scan) runs all M forwards before any backward, so every stage holds
+    O(M) microbatch activations when the backward starts.  This schedule
+    interleaves them: the last stage back-propagates microbatch m in the
+    same tick it finishes m's forward, cotangents flow back through a
+    second (reverse) ppermute ring while later microbatches are still
+    flowing forward, and each stage stores only a rotating buffer of
+    ``2n - 1`` microbatch *inputs* (re-linearized at backward time,
+    remat-style) — activation memory O(n), independent of M.
+
+    The whole backward is built by hand from per-stage ``jax.vjp`` calls:
+    no outer ``jax.grad`` is involved, the returned cotangents ARE the
+    gradients.  Per SPMD uniformity every stage computes a forward, a head
+    loss and a backward every tick; bubble ticks work on garbage and their
+    contributions are masked to zero (finite garbage — buffers start at
+    zero and ``stage_fn`` keeps them finite).
+
+    Schedule (0-based tick t, stage s, n stages, M microbatches):
+      forward of m on s  at t = s + m
+      backward of m on s at t = 2(n-1) - s + m
+    so the last stage's backward of m lands in the same tick as its
+    forward, and the total tick count is ``M + 2(n-1)`` with the same
+    2(n-1)-tick fill/drain bubble as GPipe fwd+bwd.
+
+    Arguments
+    ---------
+    stage_fn(params, x) -> y: shape-preserving stage program.
+    stage_params: this stage's param slice (already squeezed).
+    x_micro: (M, micro_b, ...) stage-0 inputs (embedded tokens).
+    labels_micro: (M, micro_b, S) labels, consumed by the last stage.
+    head_loss_fn(head_params, y, labels) -> scalar loss SUM over the
+      microbatch (runs on the last stage's outputs).
+    head_params: pytree for ``head_loss_fn`` (replicated on every stage).
+
+    Returns ``(loss_sum, dstage_params, dhead_params, dx_micro)`` —
+    loss_sum and dhead_params are real on the LAST stage (zeros
+    elsewhere); dx_micro (M, micro_b, ...) is real on stage 0 (the embed
+    cotangent); dstage_params is each stage's own gradient.  Callers psum
+    the first two over ``axis_name`` and feed dx_micro to the embedding's
+    vjp.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    m_total = x_micro.shape[0]
+    nbuf = 2 * n - 1   # slots live at most 2(n-1) ticks before reuse
+    ticks = m_total + 2 * (n - 1)
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+    is_last = idx == n - 1
+
+    def _cast_varying(a, axes):
+        # idempotent pcast: add only the axes the value doesn't carry yet
+        missing = tuple(ax for ax in axes
+                        if ax not in getattr(jax.typeof(a), "vma", ()))
+        return jax.lax.pcast(a, missing, to="varying") if missing else a
+
+    # activation-shaped carries follow the data: varying over the ring
+    # axis AND whatever outer axes the microbatches vary over (e.g. 'data'
+    # when composed with data parallelism).  Gradient accumulators are
+    # ring-varying only — the vjp's replication transpose data-psums the
+    # param cotangents before they reach the accumulator.
+    batch_axes = tuple(getattr(jax.typeof(x_micro), "vma", ())) \
+        + (axis_name,)
+    varying = lambda a: _cast_varying(a, batch_axes)
+    varying_ring = lambda a: _cast_varying(a, (axis_name,))
+    zeros_like_v = lambda t: tmap(
+        lambda v: varying_ring(jnp.zeros_like(v)), t)
+    micro0 = varying(jnp.zeros_like(x_micro[0]))
+    # differentiate w.r.t. a ring-VARYING copy of the replicated head
+    # params: vjp of an axis-invariant primal inside shard_map triggers
+    # the replication transpose (an implicit psum over the axis), which
+    # would sum every stage's garbage head-cotangent into the real one
+    head_params = tmap(varying_ring, head_params)
+
+    def masked_add(acc, contrib, valid):
+        return tmap(lambda a, c: a + jnp.where(valid, c, 0.0), acc, contrib)
+
+    def tick(carry, t):
+        (buf_fwd, buf_bwd, slots, dstage, dhead, loss, dx_out) = carry
+        m_f = t - idx                      # microbatch in forward here
+        m_b = t - 2 * (n - 1) + idx        # microbatch in backward here
+        f_valid = (m_f >= 0) & (m_f < m_total)
+        b_valid = (m_b >= 0) & (m_b < m_total)
+
+        # ---- forward ----
+        feed = jax.lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(m_f, 0, m_total - 1), keepdims=False)
+        x_in = jnp.where(idx == 0, feed, buf_fwd)
+        y = stage_fn(stage_params, x_in)
+        slots = jax.lax.dynamic_update_index_in_dim(
+            slots, x_in, jnp.mod(t, nbuf), axis=0)
+
+        # ---- head loss + its cotangents (real on the last stage) ----
+        lbl = jax.lax.dynamic_index_in_dim(
+            labels_micro, jnp.clip(m_f, 0, m_total - 1), keepdims=False)
+        loss_m, head_vjp = jax.vjp(
+            lambda hp, yy: head_loss_fn(hp, yy, lbl), head_params, y)
+        dhp, dy_head = head_vjp(jnp.ones_like(loss_m))
+        loss = loss + jnp.where(f_valid & is_last, loss_m, 0.0)
+        dhead = masked_add(dhead, dhp, f_valid & is_last)
+
+        # ---- backward (re-linearize the stored input: remat) ----
+        # the last stage consumes its own dy from THIS tick (m_b == m_f
+        # there); earlier stages consume the cotangent that arrived from
+        # the next stage via the reverse ring
+        dy_in = jnp.where(is_last, dy_head.astype(jnp.float32),
+                          buf_bwd).astype(y.dtype)
+        x_saved = jax.lax.dynamic_index_in_dim(
+            slots, jnp.mod(t - 2 * (n - 1 - idx), nbuf), keepdims=False)
+        _, stage_vjp = jax.vjp(stage_fn, stage_params, x_saved)
+        dp, dx = stage_vjp(dy_in)
+        dstage = masked_add(dstage, dp, b_valid)
+        dx_out = jax.lax.dynamic_update_index_in_dim(
+            dx_out,
+            jnp.where(b_valid & (idx == 0), dx.astype(jnp.float32), 0.0),
+            jnp.clip(m_b, 0, m_total - 1), axis=0)
+
+        # ---- rings: activations forward, cotangents backward ----
+        buf_fwd = jax.lax.ppermute(y, axis_name, fwd_perm)
+        buf_bwd = jax.lax.ppermute(dx.astype(jnp.float32), axis_name,
+                                   bwd_perm)
+        return (buf_fwd, buf_bwd, slots, dstage, dhead, loss, dx_out), None
+
+    carry0 = (
+        micro0,                                            # buf_fwd
+        varying(jnp.zeros(x_micro.shape[1:], jnp.float32)),  # buf_bwd
+        varying(jnp.zeros((nbuf,) + x_micro.shape[1:],
+                          x_micro.dtype)),                 # slots
+        zeros_like_v(stage_params),                        # dstage
+        zeros_like_v(head_params),                         # dhead
+        varying(jnp.zeros((), jnp.float32)),               # loss
+        varying(jnp.zeros(x_micro.shape, jnp.float32)),    # dx_out
+    )
+    (_, _, _, dstage, dhead, loss, dx_out), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(ticks))
+    return loss, dstage, dhead, dx_out

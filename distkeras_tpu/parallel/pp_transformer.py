@@ -48,7 +48,7 @@ class PipelineTransformerLM:
     def __init__(self, vocab_size: int, seq_len: int, d_model: int,
                  num_heads: int, num_layers: int, mlp_dim: int, mesh: Mesh,
                  *, num_microbatches: int = 2, compute_dtype=jnp.bfloat16,
-                 remat: bool = False,
+                 remat: bool = False, schedule: str = "gpipe",
                  data_axis: str = "data", stage_axis: str = "stage"):
         self.vocab_size = vocab_size
         self.seq_len = seq_len
@@ -64,6 +64,15 @@ class PipelineTransformerLM:
         # only the tick-boundary activations persist (the standard
         # activation-memory/FLOPs trade at real depth)
         self.remat = bool(remat)
+        # 'gpipe': autodiff through the forward pipeline (backward after
+        # all forwards — activation state O(M)).  '1f1b': hand-built
+        # one-forward-one-backward schedule (pipeline.pipeline_1f1b) —
+        # cotangents chase activations through a second ring, per-stage
+        # activation buffer O(n) independent of M
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"schedule must be 'gpipe' or '1f1b', "
+                             f"got {schedule!r}")
+        self.schedule = schedule
         self.data_axis = data_axis
         self.stage_axis = stage_axis
         self.n_stages = mesh.shape[stage_axis]
@@ -230,6 +239,65 @@ class PipelineTransformerLM:
         count = float(self.dp * b_loc * tokens.shape[1])
         return total / count
 
+    def _local_loss_and_grads_1f1b(self, params, tokens, labels):
+        """Manual loss + gradients via the 1F1B schedule (no outer
+        ``jax.grad`` — ``pipeline_1f1b`` builds the backward from
+        per-stage vjps).  Inside shard_map over ('data', 'stage').
+
+        The implicit-psum bookkeeping: stage-layer and head cotangents are
+        data-psummed automatically by the vjp's replication transpose (the
+        primals are data-invariant).  Explicit collectives: the scalar
+        loss reduction, the head-grad stage broadcast, and one stage-axis
+        psum of the (B_loc, S, D) embedding cotangent (real on stage 0,
+        zeros elsewhere — the embed pullback demands a cotangent with the
+        embed output's exact varying axes).
+        """
+        from .pipeline import pipeline_1f1b
+        m = self.num_microbatches
+        b_loc, s_len = tokens.shape
+        if b_loc % m:
+            raise ValueError(
+                f"local batch {b_loc} % microbatches {m} != 0")
+        stage_layers = tmap(lambda v: v[0], params["layers"])
+        embed_sub = {"embed": params["embed"], "pos": params["pos"]}
+        head_sub = {"ln_f": params["ln_f"], "head": params["head"]}
+
+        x, embed_pull = jax.vjp(lambda ep: self._embed(ep, tokens),
+                                embed_sub)
+        micro = x.reshape((m, b_loc // m) + x.shape[1:])
+        labels_micro = labels.reshape(m, b_loc // m, s_len)
+        stage = lambda sp, h: self._stage_fn(sp,
+                                             h.astype(self.compute_dtype))
+        if self.remat:
+            stage = jax.checkpoint(stage)
+
+        loss_sum, dstage, dhead, dx_micro = pipeline_1f1b(
+            stage, stage_layers, micro, labels_micro,
+            lambda hp, y, lbl: self._head_loss(hp, y, lbl)[0],
+            head_sub, axis_name=self.stage_axis)
+
+        # loss: real on the last stage only, per data shard → global mean
+        count = float(self.dp * b_loc * s_len)
+        loss = jax.lax.psum(loss_sum,
+                            (self.data_axis, self.stage_axis)) / count
+        # embed/pos: collapse the stage axis first (real on stage 0, zeros
+        # elsewhere — the pullback demands the cotangent carry x's exact
+        # varying axes); the pullback then data-psums internally
+        dx_full = dx_micro.reshape((b_loc,) + x.shape[1:])
+        dx_full = jax.lax.psum(dx_full, self.stage_axis).astype(x.dtype)
+        (dembed,) = embed_pull(dx_full)
+        # head/ln_f: real on the last stage, zeros elsewhere → broadcast
+        dhead = tmap(lambda g: jax.lax.psum(g, self.stage_axis), dhead)
+        grads = {
+            "embed": dembed["embed"], "pos": dembed["pos"],
+            "ln_f": dhead["ln_f"], "head": dhead["head"],
+            # restore the (1, lps, ...) leading stage axis of the params
+            "layers": tmap(lambda g: g[None], dstage),
+        }
+        # manual grads are for the loss SUM; match the mean-loss scaling
+        grads = tmap(lambda g: g / count, grads)
+        return loss, grads
+
     def reference_forward_loss(self, params, tokens, labels):
         """The same math with no mesh: stages applied sequentially on one
         device — the correctness oracle for the pipelined step."""
@@ -246,20 +314,32 @@ class PipelineTransformerLM:
     def compile_train_step(self, optimizer: optax.GradientTransformation,
                            params):
         """(opt_state, jitted step): step(params, opt, tokens, labels) ->
-        (params, opt, loss); tokens/labels (B, S) int32 sharded P('data')."""
+        (params, opt, loss); tokens/labels (B, S) int32 sharded P('data').
+        ``schedule='1f1b'`` swaps the autodiff GPipe backward for the
+        hand-scheduled one-forward-one-backward program (same loss/grads,
+        O(n) activation state)."""
         from .train_step import build_train_step
-        return build_train_step(self.mesh, self._local_loss,
-                                self.param_specs(), P(self.data_axis),
-                                optimizer, params)
+        return build_train_step(
+            self.mesh, self._local_loss, self.param_specs(),
+            P(self.data_axis), optimizer, params,
+            loss_and_grads=(self._local_loss_and_grads_1f1b
+                            if self.schedule == "1f1b" else None))
 
     def batch_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P(self.data_axis))
 
     def bubble_fraction(self) -> float:
-        """Analytic GPipe fill/drain bubble: of the ``M + n - 1`` ticks each
-        stage executes, only ``M`` process that stage's real microbatches —
-        the rest are fill/drain garbage (masked).  Shrinks with more
+        """Analytic fill/drain bubble of this instance's schedule.
+
+        GPipe: forward scan of ``M + n - 1`` ticks plus its autodiff
+        mirror — ``2(n-1)`` of ``2(M + n - 1)`` tick-halves are garbage,
+        i.e. ``(n-1)/(M+n-1)``.  1F1B: one combined fwd+bwd scan of
+        ``M + 2(n-1)`` ticks with ``M`` real forwards (and ``M`` real
+        backwards) each — bubble ``2(n-1)/(M+2(n-1))``, slightly larger at
+        equal M but with the O(n) activation buffer.  Shrinks with more
         microbatches; ``examples/pp_bubble_bench.py`` measures how closely
         wall-clock follows it."""
         m, n = self.num_microbatches, self.n_stages
+        if self.schedule == "1f1b":
+            return 2 * (n - 1) / (m + 2 * (n - 1))
         return (n - 1) / (m + n - 1)

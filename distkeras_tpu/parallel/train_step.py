@@ -43,18 +43,24 @@ def opt_partition_specs(optimizer, params, param_specs):
 
 
 def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
-                     optimizer: optax.GradientTransformation, params):
+                     optimizer: optax.GradientTransformation, params,
+                     loss_and_grads=None):
     """(opt_state, jitted step): step(params, opt, tokens, labels) ->
     (params, opt, loss).
 
     ``local_loss(params, tokens, labels)`` runs *inside* shard_map over
     ``mesh`` — it sees local shards and is responsible for its own
-    collectives.  State buffers are donated.
+    collectives.  State buffers are donated.  Pass ``loss_and_grads`` to
+    supply gradients another way than reverse-mode over ``local_loss``
+    (e.g. the hand-scheduled 1F1B pipeline backward); it has the
+    ``value_and_grad`` signature and also runs inside shard_map.
     """
     opt_sp = opt_partition_specs(optimizer, params, param_specs)
+    if loss_and_grads is None:
+        loss_and_grads = jax.value_and_grad(local_loss)
 
     def local_step(params, opt_state, tokens, labels):
-        loss, grads = jax.value_and_grad(local_loss)(params, tokens, labels)
+        loss, grads = loss_and_grads(params, tokens, labels)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

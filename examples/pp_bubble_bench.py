@@ -45,6 +45,11 @@ def main():
                     help="global batch (constant across the sweep)")
     ap.add_argument("--microbatches", default="1,2,4,8")
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--schedule", choices=["gpipe", "1f1b"],
+                    default="gpipe",
+                    help="gpipe = autodiff backward after all forwards; "
+                         "1f1b = hand-scheduled one-forward-one-backward "
+                         "(O(stages) activation buffer)")
     args = ap.parse_args()
 
     n = args.dp * args.pp
@@ -71,7 +76,7 @@ def main():
             vocab_size=args.vocab, seq_len=args.seq_len,
             d_model=args.d_model, num_heads=2, num_layers=args.layers,
             mlp_dim=4 * args.d_model, mesh=mesh, num_microbatches=m,
-            compute_dtype=cdt)
+            compute_dtype=cdt, schedule=args.schedule)
         params = lm.init(jax.random.PRNGKey(0))
         opt_state, step = lm.compile_train_step(optax.adam(1e-3), params)
         toks_d = jax.device_put(toks, lm.batch_sharding())

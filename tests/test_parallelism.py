@@ -429,3 +429,114 @@ def test_pipeline_transformer_matches_sequential(eight_devices):
         vocab_size=32, seq_len=16, d_model=16, num_heads=2, num_layers=4,
         mlp_dim=32, mesh=mesh,
         num_microbatches=8).bubble_fraction() == pytest.approx(3 / 11)
+
+
+def test_pipeline_1f1b_toy_grads_match_autodiff(eight_devices):
+    """pipeline_1f1b's hand-built backward == jax.grad of the sequential
+    program on a toy stage stack: loss, per-stage grads, head grads, and
+    the input cotangent all match."""
+    from distkeras_tpu.parallel.pipeline import pipeline_1f1b
+
+    n, m, micro_b, d = 4, 6, 2, 4
+    mesh = Mesh(np.array(jax.devices()[:n]), ("stage",))
+    ws = jax.random.normal(jax.random.PRNGKey(5), (n, d, d)) * 0.3
+    x = jax.random.normal(jax.random.PRNGKey(6), (m, micro_b, d))
+    labels = jax.random.normal(jax.random.PRNGKey(7), (m, micro_b, d))
+    head = {"scale": jnp.asarray(1.5)}
+
+    def stage_fn(w, h):
+        return jnp.tanh(h @ w)
+
+    def head_loss(hp, y, lbl):
+        return jnp.sum((hp["scale"] * y - lbl) ** 2)
+
+    def local(w, h_, xm, lm_):
+        loss, dstage, dhead, dx = pipeline_1f1b(
+            stage_fn, w[0], xm, lm_, head_loss, h_, axis_name="stage")
+        lead = lambda t: jax.tree_util.tree_map(lambda v: v[None], t)
+        return loss[None], lead(dstage), lead(dhead), lead(dx)
+
+    fn = jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(P("stage"), P(), P(), P()),
+        out_specs=(P("stage"),) * 4))
+    loss, dstage, dhead, dx = fn(ws, head, x, labels)
+
+    def seq_loss(ws_, head_, x_):
+        h = x_
+        for i in range(n):
+            h = jax.vmap(lambda hh: stage_fn(ws_[i], hh))(h)
+        return sum(head_loss(head_, h[j], labels[j]) for j in range(m))
+
+    loss_o, (dws_o, dhead_o, dx_o) = jax.value_and_grad(
+        seq_loss, argnums=(0, 1, 2))(ws, head, x)
+    np.testing.assert_allclose(float(loss[n - 1]), float(loss_o), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dstage), np.asarray(dws_o),
+                               atol=1e-4)
+    np.testing.assert_allclose(float(dhead["scale"][n - 1]),
+                               float(dhead_o["scale"]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx[0]), np.asarray(dx_o),
+                               atol=1e-4)
+
+
+def test_pipeline_1f1b_lm_matches_gpipe(eight_devices):
+    """The 1F1B dp×pp LM: loss and ALL gradients equal the GPipe autodiff
+    path (itself oracle-checked against the sequential reference), with
+    more microbatches than stages (M=8 > n=4 — the regime where 1F1B's
+    O(n) activation buffer actually differs from O(M)), and training
+    converges through compile_train_step."""
+    import optax
+    from distkeras_tpu.parallel.pp_transformer import PipelineTransformerLM
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "stage"))
+    kw = dict(vocab_size=32, seq_len=16, d_model=16, num_heads=2,
+              num_layers=4, mlp_dim=32, mesh=mesh, num_microbatches=8,
+              compute_dtype=jnp.float32)
+    lm_g = PipelineTransformerLM(**kw)
+    lm_1 = PipelineTransformerLM(**kw, schedule="1f1b")
+    params = lm_g.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 32, (16, 16)), jnp.int32)
+    labels = (tokens + 1) % 32
+
+    loss_g, grads_g = jax.jit(jax.shard_map(
+        jax.value_and_grad(lm_g._local_loss), mesh=mesh,
+        in_specs=(lm_g.param_specs(), P("data"), P("data")),
+        out_specs=(P(), lm_g.param_specs())))(params, tokens, labels)
+    loss_1, grads_1 = jax.jit(jax.shard_map(
+        lm_1._local_loss_and_grads_1f1b, mesh=mesh,
+        in_specs=(lm_1.param_specs(), P("data"), P("data")),
+        out_specs=(P(), lm_1.param_specs())))(params, tokens, labels)
+    np.testing.assert_allclose(float(loss_1), float(loss_g), rtol=1e-5)
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(
+                jax.device_get(grads_g))[0],
+            jax.tree_util.tree_flatten_with_path(
+                jax.device_get(grads_1))[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, err_msg=str(pa))
+
+    # remat composes (same grads, tick inputs re-linearized)
+    lm_r = PipelineTransformerLM(**kw, schedule="1f1b", remat=True)
+    _, grads_r = jax.jit(jax.shard_map(
+        lm_r._local_loss_and_grads_1f1b, mesh=mesh,
+        in_specs=(lm_r.param_specs(), P("data"), P("data")),
+        out_specs=(P(), lm_r.param_specs())))(params, tokens, labels)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(grads_r)),
+                    jax.tree_util.tree_leaves(jax.device_get(grads_1))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    # the compiled 1F1B train step trains
+    opt_state, step = lm_1.compile_train_step(optax.adam(1e-2), params)
+    losses = []
+    for _ in range(20):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+        losses.append(float(loss))
+    assert losses[-1] < 0.5 * losses[0], losses
+
+    # schedule-aware analytic bubble: 2(n-1)/(M+2(n-1)) for 1F1B
+    assert lm_1.bubble_fraction() == pytest.approx(6 / 14)
+    assert lm_g.bubble_fraction() == pytest.approx(3 / 11)
+
+    with pytest.raises(ValueError, match="schedule"):
+        PipelineTransformerLM(**kw, schedule="interleaved")
