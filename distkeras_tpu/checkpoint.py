@@ -133,10 +133,11 @@ class OrbaxCheckpointer:
     """Drop-in alternative to ``Checkpointer`` backed by
     ``orbax.checkpoint.CheckpointManager``: asynchronous (non-blocking)
     saves that overlap the next training rounds.  ``save`` passes the state
-    pytree straight to orbax, so sharded ``jax.Array`` state checkpoints
-    per-host on a multi-host pod; note the *trainers* currently
-    ``device_get`` state before saving (host-local materialization —
-    correct for single-host meshes, the only configuration testable here).
+    pytree straight to orbax — the trainers hand it the LIVE sharded
+    ``DistState``, so on a multi-host pod each host snapshots its own
+    shards (orbax copies device→host synchronously inside ``save``, which
+    keeps the trainers' donated-buffer reuse safe, then writes to disk in
+    the background).
 
     Same interface as ``Checkpointer`` (``save`` / ``restore`` /
     ``all_steps`` / ``latest_step`` / ``read_meta`` / ``wait``), selected

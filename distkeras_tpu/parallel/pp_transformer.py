@@ -37,7 +37,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import dot_product_attention
-from .pipeline import pipeline_apply
+from .pipeline import pipeline_1f1b, pipeline_apply
 
 tmap = jax.tree_util.tree_map
 
@@ -201,21 +201,29 @@ class PipelineTransformerLM:
             logp, labels.astype(jnp.int32)[..., None], axis=-1)[..., 0]
         return -jnp.sum(picked), jnp.asarray(picked.size, jnp.float32)
 
-    def _local_loss(self, params, tokens, labels):
-        """Inside shard_map over ('data', 'stage')."""
+    def _microbatch_prologue(self, params, tokens):
+        """Shared by the gpipe and 1f1b paths: the batch-divisibility
+        check, this device's stage-slice squeeze of the stacked layer
+        params ((1, lps, ...) → (lps, ...)), and the (optionally remat'd)
+        shape-preserving stage program."""
         m = self.num_microbatches
         b_loc = tokens.shape[0]
         if b_loc % m:
             raise ValueError(
                 f"local batch {b_loc} % microbatches {m} != 0")
-        # this device's stage slice arrives as (1, lps, ...): squeeze
         stage_layers = tmap(lambda v: v[0], params["layers"])
-        x = self._embed(params, tokens)                  # (B_loc, S, D)
-        micro = x.reshape((m, b_loc // m) + x.shape[1:])
         stage = lambda sp, h: self._stage_fn(sp,
                                              h.astype(self.compute_dtype))
         if self.remat:
             stage = jax.checkpoint(stage)
+        return m, b_loc, stage_layers, stage
+
+    def _local_loss(self, params, tokens, labels):
+        """Inside shard_map over ('data', 'stage')."""
+        m, b_loc, stage_layers, stage = self._microbatch_prologue(params,
+                                                                  tokens)
+        x = self._embed(params, tokens)                  # (B_loc, S, D)
+        micro = x.reshape((m, b_loc // m) + x.shape[1:])
         out = pipeline_apply(stage, stage_layers, micro,
                              axis_name=self.stage_axis)
         # outputs are real only on the last stage (zeros elsewhere): every
@@ -252,13 +260,9 @@ class PipelineTransformerLM:
         zeros elsewhere — the embed pullback demands a cotangent with the
         embed output's exact varying axes).
         """
-        from .pipeline import pipeline_1f1b
-        m = self.num_microbatches
-        b_loc, s_len = tokens.shape
-        if b_loc % m:
-            raise ValueError(
-                f"local batch {b_loc} % microbatches {m} != 0")
-        stage_layers = tmap(lambda v: v[0], params["layers"])
+        m, b_loc, stage_layers, stage = self._microbatch_prologue(params,
+                                                                  tokens)
+        s_len = tokens.shape[1]
         embed_sub = {"embed": params["embed"], "pos": params["pos"]}
         head_sub = {"ln_f": params["ln_f"], "head": params["head"]}
 
@@ -266,10 +270,6 @@ class PipelineTransformerLM:
                                 embed_sub)
         micro = x.reshape((m, b_loc // m) + x.shape[1:])
         labels_micro = labels.reshape(m, b_loc // m, s_len)
-        stage = lambda sp, h: self._stage_fn(sp,
-                                             h.astype(self.compute_dtype))
-        if self.remat:
-            stage = jax.checkpoint(stage)
 
         loss_sum, dstage, dhead, dx_micro = pipeline_1f1b(
             stage, stage_layers, micro, labels_micro,

@@ -353,7 +353,10 @@ class DistributedTrainer(Trainer):
                         losses.append(loss)
                         done += 1
                         if done % self.checkpoint_every == 0:
-                            ckpt.save(done, jax.device_get(self._state),
+                            # live (possibly sharded) state: npz device_gets
+                            # internally; orbax snapshots to host in save()
+                            # and writes async — per-host shards on a pod
+                            ckpt.save(done, self._state,
                                       meta={"engine": "spmd",
                                             "unit": "round",
                                             "rounds_per_epoch": rpe})
@@ -374,7 +377,7 @@ class DistributedTrainer(Trainer):
                               float(losses.mean()) if len(losses) else 0.0)
                 if (ckpt is not None and self.checkpoint_unit == "epoch"
                         and (epoch + 1) % self.checkpoint_every == 0):
-                    ckpt.save(epoch + 1, jax.device_get(self._state),
+                    ckpt.save(epoch + 1, self._state,
                               meta={"engine": "spmd", "unit": "epoch"})
         finally:
             metrics.logger.close()

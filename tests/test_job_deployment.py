@@ -49,6 +49,33 @@ def test_host_env_renders_coordinator():
     assert env1["DISTKERAS_TPU_NUM_PROCESSES"] == "2"
 
 
+def test_host_env_uncoordinated_blanks_inherited_coordinator():
+    """coordinated=False must actively BLANK the coordinator vars — child
+    launchers overlay host_env on os.environ, and a driver itself running
+    under a coordinated Job must not drag its uncoordinated children into
+    the parent's jax.distributed group."""
+    job = Job("j", "worker.py", hosts=["h"] * 3, coordinated=False)
+    env = job.host_env(2)
+    assert env["DISTKERAS_TPU_PROCESS_ID"] == "2"
+    assert env["DISTKERAS_TPU_COORDINATOR"] == ""
+    assert env["DISTKERAS_TPU_NUM_PROCESSES"] == "1"
+    # initialize_from_env treats the blank coordinator as absent (no-op)
+    import os
+    from distkeras_tpu.job_deployment import initialize_from_env
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        initialize_from_env()  # must not try to join a group
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    # round-trips through the punchcard record
+    assert not Job.from_record(job.to_record()).coordinated
+
+
 def test_ssh_command_rendering(monkeypatch):
     captured = []
 
