@@ -186,12 +186,24 @@ class OrbaxCheckpointer:
         return out["meta"] or {}
 
     def restore(self, target: Any, step: Optional[int] = None) -> Any:
+        """Restore into ``target``'s structure.  Live ``jax.Array`` leaves
+        become ABSTRACT (shape/dtype/sharding) targets, so orbax rebuilds
+        each host's shards in place — the restore mirror of the per-host
+        sharded save (a ``device_get`` here would crash on a pod, where no
+        host can address the full array).  Plain numpy/scalar leaves
+        restore host-side as before (the host-PS state path)."""
         step = self._resolve(step)
-        host_target = jax.tree_util.tree_map(
-            lambda l: np.asarray(jax.device_get(l)), target)
+
+        def abstract(leaf):
+            if isinstance(leaf, jax.Array):
+                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                            sharding=leaf.sharding)
+            return np.asarray(leaf)
+
         out = self._mgr.restore(
             step, args=self._ocp.args.Composite(
-                state=self._ocp.args.StandardRestore(host_target)))
+                state=self._ocp.args.StandardRestore(
+                    jax.tree_util.tree_map(abstract, target))))
         return out["state"]
 
     def _resolve(self, step: Optional[int]) -> int:

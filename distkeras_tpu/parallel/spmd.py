@@ -114,8 +114,12 @@ class SPMDEngine:
         center = jax.device_put(state.center, replicated(self.mesh))
         local = tmap(lambda x: jax.device_put(x, ws), state.local)
         opt_state = tmap(lambda x: jax.device_put(x, ws), state.opt_state)
+        # round_idx may arrive as a live single-device jax scalar (orbax
+        # sharded restore): pull it to host so the fresh array doesn't pin
+        # a stale placement into the jitted epoch's device set
         return DistState(center, local, opt_state,
-                         jnp.asarray(state.round_idx, jnp.int32))
+                         jnp.asarray(jax.device_get(state.round_idx),
+                                     jnp.int32))
 
     # -- the per-round SPMD body ---------------------------------------------
     def _local_window(self, params, opt_state, xw, yw, mw, rng):

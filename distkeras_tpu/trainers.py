@@ -310,8 +310,11 @@ class DistributedTrainer(Trainer):
                         f"gives {rpe} (batch_size/communication_window/"
                         "dataset size changed) — resume with the same "
                         "configuration")
+                # live state as the restore target: npz reads only its
+                # structure/shapes; orbax restores each host's shards in
+                # place from the abstract (shape/dtype/sharding) view
                 self._state = engine.put_state(
-                    ckpt.restore(jax.device_get(self._state), latest))
+                    ckpt.restore(self._state, latest))
                 if self.checkpoint_unit == "round":
                     # step k = global round clock after k rounds
                     start_epoch, skip_rounds = divmod(latest, rpe)

@@ -37,6 +37,20 @@ def test_process_ps_trains_across_os_processes():
 
 
 @pytest.mark.slow
+def test_process_ps_elastic_family():
+    """AEASGD across OS processes: the elastic rho rides the JSON worker
+    config and the persistent local models converge against the center."""
+    from distkeras_tpu import AEASGD
+    ds = make_dataset(n=512)
+    t = AEASGD(make_model(), num_workers=2, batch_size=16, num_epoch=3,
+               communication_window=4, rho=1.0, learning_rate=0.1,
+               label_col="label_encoded", worker_optimizer="sgd",
+               execution="process_ps")
+    fitted = t.train(ds)
+    assert eval_accuracy(fitted, ds) > 0.85
+
+
+@pytest.mark.slow
 def test_process_ps_downpour_and_validation():
     ds = make_dataset(n=512)
     t = DOWNPOUR(make_model(), num_workers=2, batch_size=16, num_epoch=2,
