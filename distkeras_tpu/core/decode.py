@@ -58,6 +58,13 @@ def _context_limit(model) -> Optional[int]:
     return None
 
 
+def _vocab_size(model) -> Optional[int]:
+    for layer in model.layers:
+        if isinstance(layer, Embedding):
+            return layer.input_dim
+    return None
+
+
 def _validate_rolling(model) -> None:
     """Every block must carry a window for a ring cache to be sound:
     without one, old positions stay visible and must stay cached."""
@@ -287,7 +294,9 @@ def generate(model, params, prompt, num_steps: int,
              max_len: Optional[int] = None,
              rolling: bool = False,
              top_k: Optional[int] = None,
-             top_p: Optional[float] = None) -> jnp.ndarray:
+             top_p: Optional[float] = None,
+             eos_id: Optional[int] = None,
+             pad_id: Optional[int] = None) -> jnp.ndarray:
     """Continue ``prompt`` (B, P) int tokens by ``num_steps`` tokens.
 
     temperature 0 = greedy argmax; > 0 = softmax sampling (needs ``rng``).
@@ -295,6 +304,9 @@ def generate(model, params, prompt, num_steps: int,
     to the k highest-logit tokens and/or the smallest nucleus reaching
     probability mass ``top_p`` before drawing — combinable (k first, then
     p, the standard composition).
+    ``eos_id``: once a sequence emits it, every later slot in that row is
+    ``pad_id`` (default: ``eos_id`` itself) — per-row stopping for batched
+    serving; the output stays the static (B, P + num_steps) shape.
     Returns (B, P + num_steps) tokens.  Prefill is one batched forward;
     the continuation is one compiled ``lax.scan`` of single-token steps.
 
@@ -329,6 +341,14 @@ def generate(model, params, prompt, num_steps: int,
             raise ValueError(f"top_k must be >= 1, got {top_k}")
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if pad_id is not None and eos_id is None:
+        raise ValueError("pad_id only means something with eos_id")
+    if eos_id is not None:
+        vocab = _vocab_size(model)
+        if vocab is not None and not 0 <= eos_id < vocab:
+            raise ValueError(
+                f"eos_id {eos_id} outside the model's vocabulary "
+                f"[0, {vocab}) — stopping could never trigger")
     if rolling:
         # the prefill below still uses a full P-slot cache (one batched
         # forward), which then collapses to rings — peak memory O(P + W),
@@ -364,15 +384,24 @@ def generate(model, params, prompt, num_steps: int,
                            for name in ("k", "v")})
         caches = ringed
 
+    pad = jnp.int32(pad_id if pad_id is not None else (eos_id or 0))
+
     def body(carry, i):
-        caches, tok = carry
+        caches, tok, done = carry
         pos = p_len + i
         logits, caches = decode_step(model, params, caches, tok, pos,
                                      rolling)
-        return (caches, sample(logits, pos)), tok
+        nxt = sample(logits, pos)
+        if eos_id is not None:
+            # rows whose CURRENT token is eos (or that finished earlier)
+            # emit padding from the next slot on
+            done = done | (tok == eos_id)
+            nxt = jnp.where(done, pad, nxt)
+        return (caches, nxt, done), tok
 
-    (caches, last), toks = jax.lax.scan(
-        body, (caches, first), jnp.arange(int(num_steps) - 1))
+    done0 = jnp.zeros((b,), bool)
+    (caches, last, _), toks = jax.lax.scan(
+        body, (caches, first, done0), jnp.arange(int(num_steps) - 1))
     gen = jnp.concatenate(
         [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1) \
         if num_steps > 1 else first[:, None]

@@ -178,13 +178,14 @@ class FittedModel:
         return self.model.count_params(self.params)
 
     def generate(self, prompt, num_steps: int, temperature: float = 0.0,
-                 rng=None, max_len=None, rolling: bool = False):
+                 rng=None, max_len=None, rolling: bool = False, **kw):
         """KV-cache autoregressive continuation (causal LMs only) — see
-        ``core.decode.generate``."""
+        ``core.decode.generate`` (``**kw`` passes through its sampling/
+        stopping surface: ``top_k``, ``top_p``, ``eos_id``, ``pad_id``)."""
         from .decode import generate
         return generate(self.model, self.params, prompt, num_steps,
                         temperature=temperature, rng=rng, max_len=max_len,
-                        rolling=rolling)
+                        rolling=rolling, **kw)
 
     def serialize(self) -> dict:
         return serialize_model(self.model, self.params)

@@ -63,9 +63,10 @@ def test_gqa_cache_is_kv_head_sized():
                  np.zeros((1, 8), np.int32), 10)
 
 
-def test_generate_continues_learned_rule():
-    """Train the y = x+1 (mod V) LM, then generate: the continuation must
-    keep incrementing."""
+@pytest.fixture(scope="module")
+def increment_lm():
+    """One trained y = x+1 (mod 16) LM shared by the behavioral tests
+    (training it costs ~35 s on the CPU mesh — pay once per module)."""
     model = tiny_lm(num_kv_heads=2, seq_len=24)
     rng = np.random.default_rng(0)
     x = rng.integers(0, 16, (256, 24)).astype(np.int32)
@@ -73,10 +74,13 @@ def test_generate_continues_learned_rule():
     tr = SingleTrainer(model, batch_size=32, num_epoch=30,
                        loss="sparse_categorical_crossentropy_from_logits",
                        worker_optimizer="adam", learning_rate=3e-3)
-    fitted = tr.train(Dataset({"features": x, "label": y}))
+    return tr.train(Dataset({"features": x, "label": y}))
 
+
+def test_generate_continues_learned_rule(increment_lm):
+    """The trained x+1 LM's continuation must keep incrementing."""
     prompt = np.array([[3, 4, 5, 6], [11, 12, 13, 14]], np.int32)
-    out = np.asarray(fitted.generate(prompt, num_steps=6))  # FittedModel API
+    out = np.asarray(increment_lm.generate(prompt, num_steps=6))
     assert out.shape == (2, 10)
     np.testing.assert_array_equal(out[:, :4], prompt)  # prompt preserved
     want = (prompt[:, -1:] + 1 + np.arange(6)) % 16
@@ -171,6 +175,29 @@ def test_generate_topk_topp_sampling():
     with pytest.raises(ValueError, match="top_p"):
         generate(model, params, prompt, 2, temperature=1.0, rng=rng,
                  top_p=1.5)
+
+
+def test_generate_eos_stopping(increment_lm):
+    """After a row emits eos_id, its remaining slots are pad_id; other
+    rows keep generating (static output shape)."""
+    model, params = increment_lm.model, increment_lm.params
+
+    # row 0 counts 3,4,5... and hits eos 7 mid-generation; row 1 starts at
+    # 9 and never reaches it within the horizon
+    prompt = np.array([[3, 4], [9, 10]], np.int32)
+    out = np.asarray(generate(model, params, prompt, 8, eos_id=7,
+                              pad_id=0))
+    np.testing.assert_array_equal(out[0], [3, 4, 5, 6, 7, 0, 0, 0, 0, 0])
+    np.testing.assert_array_equal(
+        out[1], [9, 10, 11, 12, 13, 14, 15, 0, 1, 2])
+    # pad defaults to the eos token itself
+    out2 = np.asarray(generate(model, params, prompt, 8, eos_id=7))
+    np.testing.assert_array_equal(out2[0], [3, 4, 5, 6, 7, 7, 7, 7, 7, 7])
+    with pytest.raises(ValueError, match="pad_id"):
+        generate(model, params, prompt, 2, pad_id=0)
+    # out-of-vocab eos could never trigger: refused, not silently ignored
+    with pytest.raises(ValueError, match="eos_id"):
+        generate(model, params, prompt, 2, eos_id=16)
 
 
 def test_jit_decode_step_entry_point():

@@ -477,6 +477,40 @@ def test_pipeline_1f1b_toy_grads_match_autodiff(eight_devices):
     np.testing.assert_allclose(np.asarray(dx[0]), np.asarray(dx_o),
                                atol=1e-4)
 
+    # edge cases: fewer microbatches than stages (M=2 < n=4, the ring is
+    # mostly bubble) and the degenerate single-stage "ring" (n=1)
+    for n_e, m_e in ((4, 2), (1, 3)):
+        mesh_e = Mesh(np.array(jax.devices()[:n_e]), ("stage",))
+        ws_e = ws[:n_e]
+        x_e, l_e = x[:m_e], labels[:m_e]
+
+        def local_e(w, h_, xm, lm_):
+            loss, dstage, dhead, dx = pipeline_1f1b(
+                stage_fn, w[0], xm, lm_, head_loss, h_,
+                axis_name="stage")
+            lead = lambda t: jax.tree_util.tree_map(lambda v: v[None], t)
+            return loss[None], lead(dstage), lead(dhead), lead(dx)
+
+        fn_e = jax.jit(jax.shard_map(
+            local_e, mesh=mesh_e, in_specs=(P("stage"), P(), P(), P()),
+            out_specs=(P("stage"),) * 4))
+        loss_e, dstage_e, _, dx_e = fn_e(ws_e, head, x_e, l_e)
+
+        def seq_e(ws_, head_, x_):
+            h = x_
+            for i in range(n_e):
+                h = jax.vmap(lambda hh: stage_fn(ws_[i], hh))(h)
+            return sum(head_loss(head_, h[j], l_e[j]) for j in range(m_e))
+
+        lo, (dws_o2, _, dx_o2) = jax.value_and_grad(
+            seq_e, argnums=(0, 1, 2))(ws_e, head, x_e)
+        np.testing.assert_allclose(float(loss_e[n_e - 1]), float(lo),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(dstage_e),
+                                   np.asarray(dws_o2), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dx_e[0]), np.asarray(dx_o2),
+                                   atol=1e-4)
+
 
 def test_pipeline_1f1b_lm_matches_gpipe(eight_devices):
     """The 1F1B dp×pp LM: loss and ALL gradients equal the GPipe autodiff
