@@ -62,7 +62,9 @@ class Trainer:
     def __init__(self, keras_model, loss: str = "categorical_crossentropy",
                  worker_optimizer="sgd", learning_rate: Optional[float] = None,
                  seed: int = 0, lr_schedule=None,
-                 gradient_accumulation: int = 1):
+                 gradient_accumulation: int = 1,
+                 early_stopping_patience: Optional[int] = None,
+                 early_stopping_min_delta: float = 0.0):
         self.master_model = _as_model(keras_model)
         self.loss = loss
         self.worker_optimizer = worker_optimizer
@@ -76,6 +78,17 @@ class Trainer:
         self.gradient_accumulation = int(gradient_accumulation)
         if self.gradient_accumulation < 1:
             raise ValueError("gradient_accumulation must be >= 1")
+        # early stopping on validation loss (train(validation_data=...)):
+        # stop after `patience` epochs without > min_delta improvement
+        self.early_stopping_patience = (
+            int(early_stopping_patience)
+            if early_stopping_patience is not None else None)
+        if self.early_stopping_patience is not None \
+                and self.early_stopping_patience < 1:
+            raise ValueError("early_stopping_patience must be >= 1")
+        self.early_stopping_min_delta = float(early_stopping_min_delta)
+        self.validation_history: List[float] = []
+        self.stopped_epoch: Optional[int] = None
         self.seed = seed
         self.history: List[float] = []
         self.metrics: List[dict] = []
@@ -120,6 +133,51 @@ class Trainer:
     def train(self, dataset: Dataset, shuffle: bool = False) -> FittedModel:
         raise NotImplementedError
 
+    # -- validation / early stopping (beyond-reference: upstream trains
+    # -- blind — SURVEY.md §5 has no observability beyond loss lists) ------
+    def _setup_validation(self, validation_data: Optional[Dataset]):
+        if validation_data is None:
+            if self.early_stopping_patience is not None:
+                raise ValueError(
+                    "early_stopping_patience needs validation_data passed "
+                    "to train()")
+            return None
+        from .core.losses import get_loss
+        xv = jnp.asarray(validation_data[self.features_col])
+        yv = jnp.asarray(validation_data[self.label_col])
+        loss_fn = get_loss(self.loss)
+        model = self.master_model
+
+        @jax.jit
+        def val_loss(params):
+            return loss_fn(yv, model.apply(params, xv, train=False))
+
+        self.validation_history = []
+        self._val_best = float("inf")
+        self._val_bad = 0
+        return val_loss
+
+    def _validate_epoch(self, val_fn, params, epoch: int, metrics=None
+                        ) -> bool:
+        """Record this epoch's validation loss; True → stop now (no
+        improvement > min_delta for ``early_stopping_patience`` epochs)."""
+        vl = float(val_fn(params))
+        self.validation_history.append(vl)
+        if metrics is not None:
+            metrics.logger.log(kind="val", epoch=epoch, val_loss=vl)
+        patience = self.early_stopping_patience
+        if patience is None:
+            return False
+        if vl < self._val_best - self.early_stopping_min_delta:
+            self._val_best = vl
+            self._val_bad = 0
+            return False
+        self._val_bad += 1
+        if self._val_bad >= patience:
+            self.stopped_epoch = epoch
+            return True
+        return False
+
 
 class SingleTrainer(Trainer):
     """Single-device baseline (reference: ``trainers.py :: SingleTrainer`` —
@@ -130,15 +188,19 @@ class SingleTrainer(Trainer):
                  label_col: str = "label", batch_size: int = 32,
                  num_epoch: int = 1, loss: str = "categorical_crossentropy",
                  worker_optimizer="sgd", learning_rate=None, seed: int = 0,
-                 lr_schedule=None, gradient_accumulation: int = 1):
+                 lr_schedule=None, gradient_accumulation: int = 1,
+                 early_stopping_patience: Optional[int] = None,
+                 early_stopping_min_delta: float = 0.0):
         super().__init__(keras_model, loss, worker_optimizer, learning_rate,
-                         seed, lr_schedule, gradient_accumulation)
+                         seed, lr_schedule, gradient_accumulation,
+                         early_stopping_patience, early_stopping_min_delta)
         self.features_col = features_col
         self.label_col = label_col
         self.batch_size = int(batch_size)
         self.num_epoch = int(num_epoch)
 
-    def train(self, dataset: Dataset, shuffle: bool = False) -> FittedModel:
+    def train(self, dataset: Dataset, shuffle: bool = False,
+              validation_data: Optional[Dataset] = None) -> FittedModel:
         self.record_training_start()
         x = dataset[self.features_col]
         y = dataset[self.label_col]
@@ -157,6 +219,7 @@ class SingleTrainer(Trainer):
         state = state._replace(params=params)
         runner = make_epoch_runner(self.master_model, self.loss, tx)
         rng = jax.random.PRNGKey(self.seed + 1)
+        val_fn = self._setup_validation(validation_data)
         for epoch in range(self.num_epoch):
             if shuffle:
                 ds = Dataset({"x": x, "y": y}).shuffle(self.seed + epoch)
@@ -169,6 +232,9 @@ class SingleTrainer(Trainer):
             state, losses = runner(state, jnp.asarray(xb), jnp.asarray(yb),
                                    jnp.asarray(mb), sub)
             self.history.extend(np.asarray(losses).tolist())
+            if val_fn is not None and self._validate_epoch(
+                    val_fn, state.params, epoch):
+                break
         self._fitted = FittedModel(self.master_model, state.params)
         self.record_training_stop()
         return self._fitted
@@ -196,9 +262,12 @@ class DistributedTrainer(Trainer):
                  checkpoint_backend: str = "npz",
                  metrics_path: Optional[str] = None,
                  wire_dtype: Optional[str] = None,
-                 lr_schedule=None, gradient_accumulation: int = 1):
+                 lr_schedule=None, gradient_accumulation: int = 1,
+                 early_stopping_patience: Optional[int] = None,
+                 early_stopping_min_delta: float = 0.0):
         super().__init__(keras_model, loss, worker_optimizer, learning_rate,
-                         seed, lr_schedule, gradient_accumulation)
+                         seed, lr_schedule, gradient_accumulation,
+                         early_stopping_patience, early_stopping_min_delta)
         self.mesh = mesh if mesh is not None else mesh_lib.get_mesh(num_workers)
         self.num_workers = int(self.mesh.devices.size)
         self.batch_size = int(batch_size)
@@ -244,7 +313,15 @@ class DistributedTrainer(Trainer):
         return engine
 
     def train(self, dataset: Dataset, shuffle: bool = False,
-              resume: bool = False) -> FittedModel:
+              resume: bool = False,
+              validation_data: Optional[Dataset] = None) -> FittedModel:
+        if self.execution in ("host_ps", "process_ps") \
+                and (validation_data is not None
+                     or self.early_stopping_patience is not None):
+            raise ValueError(
+                "validation_data/early stopping run between SPMD epochs; "
+                "the async PS engines have no between-epoch hook (workers "
+                "own their epoch loops) — use execution='spmd'")
         if self.execution == "host_ps":
             from .parameter_servers import run_host_ps_training
             return run_host_ps_training(self, dataset, shuffle, resume=resume)
@@ -255,6 +332,9 @@ class DistributedTrainer(Trainer):
             from .parameter_servers import run_process_ps_training
             return run_process_ps_training(self, dataset, shuffle)
         self.record_training_start()
+        # before any resource (checkpoint manager, metrics file) opens:
+        # a bad validation config must not leak them
+        val_fn = self._setup_validation(validation_data)
         x = np.asarray(dataset[self.features_col])
         y = np.asarray(dataset[self.label_col])
         self._input_shape = x.shape[1:]
@@ -382,6 +462,9 @@ class DistributedTrainer(Trainer):
                         and (epoch + 1) % self.checkpoint_every == 0):
                     ckpt.save(epoch + 1, self._state,
                               meta={"engine": "spmd", "unit": "epoch"})
+                if val_fn is not None and self._validate_epoch(
+                        val_fn, self._state.center, epoch, metrics):
+                    break
         finally:
             metrics.logger.close()
             if ckpt is not None:
@@ -487,6 +570,17 @@ class EAMSGD(AEASGD):
         self.momentum = float(momentum)
 
 
+def _reject_validation_kwargs(kw: dict, name: str) -> None:
+    """The 'local' trainers never update a center model, so validating it
+    per epoch would watch the INITIAL weights — refuse up front instead of
+    accepting a kwarg that can never work."""
+    if kw.get("early_stopping_patience") is not None:
+        raise ValueError(
+            f"{name} trains independent per-worker models (the center "
+            "never moves): per-epoch center validation / early stopping "
+            "does not apply")
+
+
 class AveragingTrainer(DistributedTrainer):
     """One-shot parameter averaging (reference:
     ``trainers.py :: AveragingTrainer``): each worker trains independently on
@@ -495,6 +589,7 @@ class AveragingTrainer(DistributedTrainer):
 
     def __init__(self, keras_model, **kw):
         kw.setdefault("communication_window", 1)
+        _reject_validation_kwargs(kw, type(self).__name__)
         super().__init__(keras_model, **kw)
 
     def train(self, dataset: Dataset, shuffle: bool = False,
@@ -516,6 +611,7 @@ class EnsembleTrainer(DistributedTrainer):
         kw.setdefault("communication_window", 1)
         if num_models is not None:
             kw.setdefault("num_workers", num_models)
+        _reject_validation_kwargs(kw, type(self).__name__)
         super().__init__(keras_model, **kw)
         self.num_models = self.num_workers
 

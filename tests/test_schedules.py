@@ -104,6 +104,74 @@ def test_spmd_schedule_and_accumulation_converge(eight_devices):
     assert t._schedule_steps == t.num_epoch * 4 * 4 // 2
 
 
+def test_validation_history_and_metrics(eight_devices, tmp_path):
+    """validation_data records a per-epoch val loss (JSONL 'val' events on
+    the distributed path) and it decreases on learnable data."""
+    import json
+    ds = make_dataset(n=1024, seed=0)
+    val = make_dataset(n=256, seed=9)
+    path = str(tmp_path / "m.jsonl")
+    t = ADAG(make_model(), num_workers=8, batch_size=8, num_epoch=4,
+             communication_window=4, label_col="label_encoded",
+             worker_optimizer="adam", learning_rate=1e-3,
+             metrics_path=path)
+    t.train(ds, validation_data=val)
+    assert len(t.validation_history) == 4
+    assert t.validation_history[-1] < t.validation_history[0]
+    assert t.stopped_epoch is None
+    events = [json.loads(l) for l in open(path)]
+    assert sum(e.get("kind") == "val" for e in events) == 4
+
+    s = SingleTrainer(make_model(), batch_size=32, num_epoch=3,
+                      label_col="label_encoded", worker_optimizer="adam",
+                      learning_rate=1e-3)
+    s.train(ds, validation_data=val)
+    assert len(s.validation_history) == 3
+    assert s.validation_history[-1] < s.validation_history[0]
+
+
+def test_early_stopping_halts_on_plateau():
+    """Unlearnable labels: validation loss plateaus immediately, so
+    patience=2 must cut a 20-epoch run short."""
+    rng = np.random.default_rng(0)
+    import numpy as _np
+    from distkeras_tpu import Dataset, OneHotTransformer
+    noise = Dataset({"features": rng.standard_normal((256, 16)).astype(
+        _np.float32), "label": rng.integers(0, 4, 256)})
+    noise = OneHotTransformer(4, input_col="label",
+                              output_col="label_encoded").transform(noise)
+    val = make_dataset(n=128, seed=5)
+    t = SingleTrainer(make_model(), batch_size=32, num_epoch=20,
+                      label_col="label_encoded", worker_optimizer="sgd",
+                      learning_rate=0.05, early_stopping_patience=2)
+    t.train(noise, validation_data=val)
+    assert t.stopped_epoch is not None
+    assert len(t.validation_history) < 20
+    # epochs actually trained == epochs validated
+    assert len(t.get_history()) == len(t.validation_history) * (256 // 32)
+
+
+def test_validation_kwarg_validation(eight_devices):
+    from distkeras_tpu import AveragingTrainer
+    ds = make_dataset(n=256)
+    with pytest.raises(ValueError, match="early_stopping_patience"):
+        SingleTrainer(make_model(), label_col="label_encoded",
+                      early_stopping_patience=3).train(ds)
+    with pytest.raises(ValueError, match="between-epoch hook|spmd"):
+        ADAG(make_model(), num_workers=2, label_col="label_encoded",
+             execution="host_ps").train(ds, validation_data=ds)
+    # patience on an async engine is dead config even without val data
+    with pytest.raises(ValueError, match="between-epoch hook|spmd"):
+        ADAG(make_model(), num_workers=2, label_col="label_encoded",
+             execution="host_ps", early_stopping_patience=2).train(ds)
+    # local-family trainers never move the center: refused at construction
+    with pytest.raises(ValueError, match="center"):
+        AveragingTrainer(make_model(), num_workers=2,
+                         early_stopping_patience=2)
+    with pytest.raises(ValueError, match="early_stopping_patience"):
+        SingleTrainer(make_model(), early_stopping_patience=0)
+
+
 def test_host_ps_schedule_and_accumulation_converge(eight_devices):
     ds = make_dataset(n=1024)
     t = ADAG(make_model(), num_workers=2, batch_size=16, num_epoch=4,
