@@ -183,13 +183,17 @@ def _trainable_mask(params):
 
 def build_tx(spec: Any, params, learning_rate: Optional[float] = None,
              lr_schedule: Any = None, total_steps: Optional[int] = None,
-             gradient_accumulation: int = 1
+             gradient_accumulation: int = 1,
+             gradient_clip_norm: Optional[float] = None
              ) -> optax.GradientTransformation:
-    """Build the optax transformation for a params pytree: named optimizer →
-    optional LR schedule → non-trainable masking → optional gradient
-    accumulation (``optax.MultiSteps`` averaging ``gradient_accumulation``
-    mini-step gradients per real update — the large-batch knob when one
-    batch no longer fits HBM)."""
+    """Build the optax transformation for a params pytree: optional
+    global-norm clip → named optimizer (optionally LR-scheduled) →
+    non-trainable masking → optional gradient accumulation
+    (``optax.MultiSteps`` averaging ``gradient_accumulation`` mini-step
+    gradients per real update — the large-batch knob when one batch no
+    longer fits HBM).  ``gradient_clip_norm`` rescales each update's
+    gradients so their global L2 norm never exceeds it (the standard
+    transformer training stabilizer)."""
     opt = get_optimizer(spec, learning_rate)
     if lr_schedule is not None:
         base = opt.hyper.get("learning_rate",
@@ -197,7 +201,14 @@ def build_tx(spec: Any, params, learning_rate: Optional[float] = None,
         opt = Optimizer(opt.name, **{
             **opt.hyper,
             "learning_rate": get_schedule(lr_schedule, base, total_steps)})
-    tx = optax.masked(opt.to_optax(), _trainable_mask(params))
+    inner = opt.to_optax()
+    if gradient_clip_norm is not None:
+        if gradient_clip_norm <= 0:
+            raise ValueError(
+                f"gradient_clip_norm must be > 0, got {gradient_clip_norm}")
+        inner = optax.chain(
+            optax.clip_by_global_norm(float(gradient_clip_norm)), inner)
+    tx = optax.masked(inner, _trainable_mask(params))
     k = int(gradient_accumulation)
     if k < 1:
         raise ValueError(f"gradient_accumulation must be >= 1, got {k}")
@@ -209,11 +220,12 @@ def build_tx(spec: Any, params, learning_rate: Optional[float] = None,
 
 def build(spec: Any, params, learning_rate: Optional[float] = None,
           lr_schedule: Any = None, total_steps: Optional[int] = None,
-          gradient_accumulation: int = 1):
+          gradient_accumulation: int = 1,
+          gradient_clip_norm: Optional[float] = None):
     """Build (optax_tx, opt_state) for a params pytree, masking non-trainables.
 
     Returns the transformation and its initialized state.
     """
     tx = build_tx(spec, params, learning_rate, lr_schedule, total_steps,
-                  gradient_accumulation)
+                  gradient_accumulation, gradient_clip_norm)
     return tx, tx.init(params)

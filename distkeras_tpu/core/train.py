@@ -155,11 +155,13 @@ def batch_epoch_data(x: np.ndarray, y: np.ndarray, batch_size: int):
 
 def init_state(model: Sequential, rng, input_shape, optimizer,
                learning_rate=None, lr_schedule=None, total_steps=None,
-               gradient_accumulation: int = 1
+               gradient_accumulation: int = 1,
+               gradient_clip_norm=None
                ) -> Tuple[TrainState, optax.GradientTransformation]:
     """Initialize params + optimizer state for a model."""
     params = model.init(rng, input_shape)
     tx, opt_state = opt_lib.build(optimizer, params, learning_rate,
                                   lr_schedule, total_steps,
-                                  gradient_accumulation)
+                                  gradient_accumulation,
+                                  gradient_clip_norm)
     return TrainState(params, opt_state, jnp.zeros((), jnp.int32)), tx

@@ -70,7 +70,8 @@ class SPMDEngine:
                  learning_rate: Optional[float] = None,
                  alpha: Optional[float] = None,
                  lr_schedule=None, schedule_steps: Optional[int] = None,
-                 gradient_accumulation: int = 1):
+                 gradient_accumulation: int = 1,
+                 gradient_clip_norm=None):
         self.model = model
         self.loss_fn = get_loss(loss)
         self.mesh = mesh
@@ -82,6 +83,7 @@ class SPMDEngine:
         self.lr_schedule = lr_schedule
         self.schedule_steps = schedule_steps
         self.gradient_accumulation = int(gradient_accumulation)
+        self.gradient_clip_norm = gradient_clip_norm
         self.tx = None  # built in init_state (needs params for masking)
         self._epoch_fn = None
         self._round_step = None
@@ -94,7 +96,8 @@ class SPMDEngine:
         self.tx = opt_lib.build_tx(
             self.optimizer, params, lr_schedule=self.lr_schedule,
             total_steps=self.schedule_steps,
-            gradient_accumulation=self.gradient_accumulation)
+            gradient_accumulation=self.gradient_accumulation,
+            gradient_clip_norm=self.gradient_clip_norm)
         n = self.num_workers
         # every worker starts from the same center (reference: initial pull)
         local = tmap(lambda x: jnp.broadcast_to(x, (n,) + x.shape), params)

@@ -469,6 +469,7 @@ def _worker_kwargs(trainer, n: int, rows: int) -> dict:
         lr_schedule=getattr(trainer, "lr_schedule", None),
         schedule_steps=-(-windows_pe * win * trainer.num_epoch // accum),
         gradient_accumulation=accum,
+        gradient_clip_norm=getattr(trainer, "gradient_clip_norm", None),
         wire_dtype=getattr(trainer, "wire_dtype", None))
     if trainer.ALGORITHM in ("aeasgd", "eamsgd"):
         kw["rho"] = getattr(trainer, "rho", 5.0)

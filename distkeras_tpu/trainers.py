@@ -63,6 +63,7 @@ class Trainer:
                  worker_optimizer="sgd", learning_rate: Optional[float] = None,
                  seed: int = 0, lr_schedule=None,
                  gradient_accumulation: int = 1,
+                 gradient_clip_norm: Optional[float] = None,
                  early_stopping_patience: Optional[int] = None,
                  early_stopping_min_delta: float = 0.0):
         self.master_model = _as_model(keras_model)
@@ -78,6 +79,12 @@ class Trainer:
         self.gradient_accumulation = int(gradient_accumulation)
         if self.gradient_accumulation < 1:
             raise ValueError("gradient_accumulation must be >= 1")
+        self.gradient_clip_norm = (float(gradient_clip_norm)
+                                   if gradient_clip_norm is not None
+                                   else None)
+        if self.gradient_clip_norm is not None \
+                and self.gradient_clip_norm <= 0:
+            raise ValueError("gradient_clip_norm must be > 0")
         # early stopping on validation loss (train(validation_data=...)):
         # stop after `patience` epochs without > min_delta improvement
         self.early_stopping_patience = (
@@ -189,10 +196,12 @@ class SingleTrainer(Trainer):
                  num_epoch: int = 1, loss: str = "categorical_crossentropy",
                  worker_optimizer="sgd", learning_rate=None, seed: int = 0,
                  lr_schedule=None, gradient_accumulation: int = 1,
+                 gradient_clip_norm: Optional[float] = None,
                  early_stopping_patience: Optional[int] = None,
                  early_stopping_min_delta: float = 0.0):
         super().__init__(keras_model, loss, worker_optimizer, learning_rate,
                          seed, lr_schedule, gradient_accumulation,
+                         gradient_clip_norm,
                          early_stopping_patience, early_stopping_min_delta)
         self.features_col = features_col
         self.label_col = label_col
@@ -215,7 +224,8 @@ class SingleTrainer(Trainer):
         state, tx = init_state(self.master_model, jax.random.PRNGKey(self.seed),
                                input_shape, self.worker_optimizer,
                                self.learning_rate, self.lr_schedule,
-                               total_updates, self.gradient_accumulation)
+                               total_updates, self.gradient_accumulation,
+                               self.gradient_clip_norm)
         state = state._replace(params=params)
         runner = make_epoch_runner(self.master_model, self.loss, tx)
         rng = jax.random.PRNGKey(self.seed + 1)
@@ -263,10 +273,12 @@ class DistributedTrainer(Trainer):
                  metrics_path: Optional[str] = None,
                  wire_dtype: Optional[str] = None,
                  lr_schedule=None, gradient_accumulation: int = 1,
+                 gradient_clip_norm: Optional[float] = None,
                  early_stopping_patience: Optional[int] = None,
                  early_stopping_min_delta: float = 0.0):
         super().__init__(keras_model, loss, worker_optimizer, learning_rate,
                          seed, lr_schedule, gradient_accumulation,
+                         gradient_clip_norm,
                          early_stopping_patience, early_stopping_min_delta)
         self.mesh = mesh if mesh is not None else mesh_lib.get_mesh(num_workers)
         self.num_workers = int(self.mesh.devices.size)
@@ -306,7 +318,8 @@ class DistributedTrainer(Trainer):
             self.ALGORITHM, self.communication_window, self.learning_rate,
             alpha=self._elastic_alpha(), lr_schedule=self.lr_schedule,
             schedule_steps=getattr(self, "_schedule_steps", None),
-            gradient_accumulation=self.gradient_accumulation)
+            gradient_accumulation=self.gradient_accumulation,
+            gradient_clip_norm=self.gradient_clip_norm)
         self._state = engine.init_state(
             jax.random.PRNGKey(self.seed), self._input_shape,
             initial_params=self._initial_params(self._input_shape))

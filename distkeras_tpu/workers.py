@@ -42,7 +42,8 @@ class Worker:
                  batch_size: int = 32, num_epoch: int = 1,
                  learning_rate: Optional[float] = None, seed: int = 0,
                  lr_schedule=None, schedule_steps: Optional[int] = None,
-                 gradient_accumulation: int = 1):
+                 gradient_accumulation: int = 1,
+                 gradient_clip_norm=None):
         self.model_blob = model_blob
         self.worker_optimizer = worker_optimizer
         self.loss = loss
@@ -54,6 +55,7 @@ class Worker:
         self.lr_schedule = lr_schedule
         self.schedule_steps = schedule_steps
         self.gradient_accumulation = int(gradient_accumulation)
+        self.gradient_clip_norm = gradient_clip_norm
         self.seed = seed
         self.history: List[float] = []
         # lazily-built jit state (shared across threads is fine: jax caches
@@ -71,7 +73,8 @@ class Worker:
                                         self.learning_rate,
                                         self.lr_schedule,
                                         self.schedule_steps,
-                                        self.gradient_accumulation)
+                                        self.gradient_accumulation,
+                                        self.gradient_clip_norm)
         return self._model
 
     def _build_window_fn(self):

@@ -59,6 +59,31 @@ def test_build_rejects_bad_accumulation():
     assert not hasattr(state, "mini_step")
 
 
+def test_gradient_clip_norm():
+    import jax
+    import jax.numpy as jnp
+    import optax
+    params = make_model().init(jax.random.PRNGKey(0), (16,))
+    tx, state = build("sgd", params, 1.0, gradient_clip_norm=1e-3)
+    # giant synthetic grads: the applied update's global norm is exactly
+    # lr * clip (sgd lr=1.0)
+    grads = jax.tree_util.tree_map(lambda p: jnp.ones_like(p) * 100.0,
+                                   params)
+    updates, _ = tx.update(grads, state, params)
+    np.testing.assert_allclose(float(optax.global_norm(updates)), 1e-3,
+                               rtol=1e-5)
+    # under the norm: untouched (plain sgd)
+    small = jax.tree_util.tree_map(lambda p: jnp.full_like(p, 1e-6), params)
+    upd2, _ = tx.update(small, state, params)
+    np.testing.assert_allclose(np.asarray(jax.tree_util.tree_leaves(upd2)[0]),
+                               -1e-6, rtol=1e-5)
+    with pytest.raises(ValueError, match="gradient_clip_norm"):
+        build_tx("sgd", params, 1.0, gradient_clip_norm=0.0)
+    # trainers validate eagerly at construction, like accumulation
+    with pytest.raises(ValueError, match="gradient_clip_norm"):
+        SingleTrainer(make_model(), gradient_clip_norm=0.0)
+
+
 def test_zero_schedule_freezes_params():
     """A callable schedule is really driving the optimizer: lr ≡ 0 must
     leave the initial weights untouched through a full train()."""
@@ -97,7 +122,8 @@ def test_spmd_schedule_and_accumulation_converge(eight_devices):
     t = ADAG(make_model(), num_workers=8, batch_size=16, num_epoch=4,
              communication_window=4, label_col="label_encoded",
              worker_optimizer="sgd", learning_rate=0.3,
-             lr_schedule="warmup_cosine", gradient_accumulation=2)
+             lr_schedule="warmup_cosine", gradient_accumulation=2,
+             gradient_clip_norm=5.0)
     fitted = t.train(ds)
     assert eval_accuracy(fitted, ds) > 0.9
     # the schedule horizon the trainer derived: rounds*window*epochs / K
