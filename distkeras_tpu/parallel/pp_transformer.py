@@ -29,7 +29,7 @@ pipeline-parallel stage.
 from __future__ import annotations
 
 import math
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -49,7 +49,8 @@ class PipelineTransformerLM:
                  num_heads: int, num_layers: int, mlp_dim: int, mesh: Mesh,
                  *, num_microbatches: int = 2, compute_dtype=jnp.bfloat16,
                  remat: bool = False, schedule: str = "gpipe",
-                 data_axis: str = "data", stage_axis: str = "stage"):
+                 data_axis: str = "data", stage_axis: str = "stage",
+                 model_axis: Optional[str] = None):
         self.vocab_size = vocab_size
         self.seq_len = seq_len
         self.d_model = d_model
@@ -75,6 +76,13 @@ class PipelineTransformerLM:
         self.schedule = schedule
         self.data_axis = data_axis
         self.stage_axis = stage_axis
+        # model_axis: Megatron tensor parallelism INSIDE each pipeline
+        # stage (3-D dp × pp × tp): qkv/w1 column-split and wo/w2
+        # row-split over this mesh axis, one psum per attention/MLP —
+        # activations stay replicated in value over 'model', so the
+        # pipeline rings are unchanged
+        self.model_axis = model_axis
+        self.tp = mesh.shape[model_axis] if model_axis is not None else 1
         self.n_stages = mesh.shape[stage_axis]
         self.dp = mesh.shape[data_axis]
         if num_layers % self.n_stages:
@@ -83,6 +91,10 @@ class PipelineTransformerLM:
         self.layers_per_stage = num_layers // self.n_stages
         if d_model % num_heads:
             raise ValueError(f"d_model {d_model} % heads {num_heads} != 0")
+        if num_heads % self.tp:
+            raise ValueError(f"num_heads {num_heads} % tp {self.tp} != 0")
+        if mlp_dim % self.tp:
+            raise ValueError(f"mlp_dim {mlp_dim} % tp {self.tp} != 0")
         self.head_dim = d_model // num_heads
 
     # -- params ---------------------------------------------------------------
@@ -95,8 +107,21 @@ class PipelineTransformerLM:
         }
 
     def param_specs(self):
-        layer_specs = {k: P(self.stage_axis)
-                       for k in self._layer_leaf_shapes()}
+        st, md = self.stage_axis, self.model_axis
+        if md is None:
+            layer_specs = {k: P(st) for k in self._layer_leaf_shapes()}
+        else:
+            # Megatron split on top of the stage stacking (n, lps, ...):
+            # qkv/w1 column-split (trailing dim), wo/w2 row-split (their
+            # input dim), b1 follows w1's columns, ln/b2 replicated
+            layer_specs = {
+                "ln1": P(st), "ln2": P(st),
+                "wq": P(st, None, None, md), "wk": P(st, None, None, md),
+                "wv": P(st, None, None, md),
+                "wo": P(st, None, md, None),
+                "w1": P(st, None, None, md), "b1": P(st, None, md),
+                "w2": P(st, None, md, None), "b2": P(st),
+            }
         return {"embed": P(), "pos": P(), "ln_f": P(), "head": P(),
                 "layers": layer_specs}
 
@@ -175,11 +200,34 @@ class PipelineTransformerLM:
             preferred_element_type=jnp.float32) + lp["b2"]
         return x + y.astype(cdt)
 
-    def _stage_fn(self, stage_layers, x):
+    def _block_tp(self, lp, x):
+        """The same block with Megatron tensor parallelism over
+        ``model_axis`` (call inside shard_map only: one psum per
+        attention/MLP).  lp leaves are this shard's local slices."""
+        from .tp import tp_mlp, tp_self_attention
+        cdt = self.compute_dtype
+        h = self._ln(lp["ln1"], x)
+        attn = tp_self_attention(
+            h, lp["wq"], lp["wk"], lp["wv"], lp["wo"],
+            num_local_heads=self.num_heads // self.tp,
+            head_dim=self.head_dim, axis_name=self.model_axis,
+            causal=True, compute_dtype=cdt)
+        x = x + attn.astype(cdt)
+        h = self._ln(lp["ln2"], x)
+        y = tp_mlp(h, lp["w1"], lp["b1"], lp["w2"], lp["b2"],
+                   axis_name=self.model_axis, compute_dtype=cdt)
+        return x + y.astype(cdt)
+
+    def _stage_fn(self, stage_layers, x, tp: bool = False):
         """Run this stage's ``layers_per_stage`` blocks (scan over the
-        stacked layer params) — shape-preserving, as the pipeline needs."""
+        stacked layer params) — shape-preserving, as the pipeline needs.
+        ``tp=True`` selects the tensor-parallel block (sharded weights,
+        inside shard_map); the dense block doubles as the no-mesh oracle
+        on full-width params."""
+        block = self._block_tp if tp else self._block
+
         def body(h, lp):
-            return self._block(lp, h), None
+            return block(lp, h), None
 
         out, _ = jax.lax.scan(body, x, stage_layers)
         return out
@@ -213,7 +261,8 @@ class PipelineTransformerLM:
                 f"local batch {b_loc} % microbatches {m} != 0")
         stage_layers = tmap(lambda v: v[0], params["layers"])
         stage = lambda sp, h: self._stage_fn(sp,
-                                             h.astype(self.compute_dtype))
+                                             h.astype(self.compute_dtype),
+                                             tp=self.tp > 1)
         if self.remat:
             stage = jax.checkpoint(stage)
         return m, b_loc, stage_layers, stage
