@@ -111,17 +111,21 @@ class PipelineTransformerLM:
         if md is None:
             layer_specs = {k: P(st) for k in self._layer_leaf_shapes()}
         else:
-            # Megatron split on top of the stage stacking (n, lps, ...):
-            # qkv/w1 column-split (trailing dim), wo/w2 row-split (their
-            # input dim), b1 follows w1's columns, ln/b2 replicated
-            layer_specs = {
-                "ln1": P(st), "ln2": P(st),
-                "wq": P(st, None, None, md), "wk": P(st, None, None, md),
-                "wv": P(st, None, None, md),
-                "wo": P(st, None, md, None),
-                "w1": P(st, None, None, md), "b1": P(st, None, md),
-                "w2": P(st, None, md, None), "b2": P(st),
+            # Megatron split kind per leaf, applied on top of the stage
+            # stacking (n, lps, ...): "col" = trailing dim (qkv/w1 and
+            # b1, which follows w1's columns), "row" = input dim (wo/w2);
+            # leaves without an entry stay replicated (ln/b2 — correct,
+            # just unsplit, for any future leaf too)
+            split = {"wq": "col", "wk": "col", "wv": "col", "w1": "col",
+                     "b1": "col", "wo": "row", "w2": "row"}
+            to_spec = {
+                ("col", 2): P(st, None, None, md),   # (n,lps,in,out)
+                ("col", 1): P(st, None, md),         # (n,lps,out)
+                ("row", 2): P(st, None, md, None),
             }
+            layer_specs = {
+                k: to_spec.get((split.get(k), len(shape)), P(st))
+                for k, shape in self._layer_leaf_shapes().items()}
         return {"embed": P(), "pos": P(), "ln_f": P(), "head": P(),
                 "layers": layer_specs}
 
