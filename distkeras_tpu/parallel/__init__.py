@@ -2,6 +2,7 @@ from .mesh import (WORKER_AXIS, get_mesh, initialize, replicated,
                    worker_sharded, put_replicated, put_worker_sharded)
 from .spmd import SPMDEngine, DistState, shape_epoch_data
 from .ring import SEQ_AXIS, ring_attention, ring_self_attention
+from .ulysses import ulysses_attention, ulysses_self_attention
 from .tp import (MODEL_AXIS, column_parallel_dense, row_parallel_dense,
                  tp_mlp, tp_self_attention)
 from .moe import load_balance_loss, moe_mlp, top1_routing, topk_routing
@@ -15,6 +16,7 @@ __all__ = [
     "put_replicated", "put_worker_sharded",
     "SPMDEngine", "DistState", "shape_epoch_data", "rules",
     "SEQ_AXIS", "ring_attention", "ring_self_attention",
+    "ulysses_attention", "ulysses_self_attention",
     "MODEL_AXIS", "column_parallel_dense", "row_parallel_dense",
     "tp_mlp", "tp_self_attention", "moe_mlp", "top1_routing",
     "topk_routing", "load_balance_loss",

@@ -73,7 +73,8 @@ def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
                       ring_block_k: Optional[int] = None,
                       num_local_kv_heads: Optional[int] = None,
                       window: Optional[int] = None,
-                      rope_positions=None):
+                      rope_positions=None,
+                      sp_impl: str = "ring"):
     """Head-parallel self-attention: each model-axis shard owns
     ``num_local_heads`` heads end to end (qkv column-split by head, local
     attention, output row-split) — one psum per block.  With ``seq_axis``
@@ -91,8 +92,16 @@ def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
     rows — when set, q/k are RoPE-rotated before attention; rotation is
     per-position, so it is valid under the ring too (k blocks arrive
     already rotated by their own global positions).
+
+    ``sp_impl``: which sequence-parallel schedule carries the attend when
+    ``seq_axis`` is set — ``"ring"`` (k/v rotation, overlapped, no head
+    constraint) or ``"ulysses"`` (two all_to_alls reshard seq<->heads and
+    the full-sequence local attend reuses the flash kernel; needs the
+    local head count divisible by the seq-axis size).  See
+    ``parallel/ulysses.py`` for the trade-off table.
     """
     from .ring import ring_attention
+    from .ulysses import ulysses_attention
     from ..ops.attention import attention
 
     b, s, _ = x.shape
@@ -108,7 +117,13 @@ def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
         from ..ops.rope import apply_rope
         q = apply_rope(q, rope_positions)
         k = apply_rope(k, rope_positions)
-    if seq_axis is not None:
+    if seq_axis is not None and sp_impl == "ulysses":
+        out = ulysses_attention(q, k, v, seq_axis, causal=causal,
+                                window=window)
+    elif seq_axis is not None:
+        if sp_impl != "ring":
+            raise ValueError(f"unknown sp_impl {sp_impl!r} "
+                             "(expected 'ring' or 'ulysses')")
         # ring_block_k: blockwise chunking of each rotation's local attend —
         # the long-context memory knob when local shards are large
         out = ring_attention(q, k, v, seq_axis, causal=causal,

@@ -47,6 +47,7 @@ class ParallelTransformerLM:
                  router_aux_weight: float = 1e-2,
                  compute_dtype=jnp.bfloat16, remat: bool = False,
                  ring_block_k: Optional[int] = None,
+                 sp_impl: str = "ring",
                  num_kv_heads: Optional[int] = None,
                  attention_window: Optional[int] = None,
                  positional: str = "learned",
@@ -76,6 +77,17 @@ class ParallelTransformerLM:
         self.dp = mesh.shape[data_axis]
         if num_heads % self.tp:
             raise ValueError(f"num_heads {num_heads} % tp {self.tp} != 0")
+        if sp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"sp_impl must be 'ring' or 'ulysses', got "
+                             f"{sp_impl!r}")
+        # ulysses reshards the model-local heads over the seq axis: two
+        # all_to_alls + a full-sequence flash attend (parallel/ulysses.py)
+        self.sp_impl = sp_impl
+        if sp_impl == "ulysses" and (num_heads // self.tp) % self.sp:
+            raise ValueError(
+                f"sp_impl='ulysses' needs local head count "
+                f"{num_heads // self.tp} (num_heads/tp) divisible by sp "
+                f"{self.sp}; use sp_impl='ring' for this shape")
         # GQA over tensor parallelism: every shard keeps whole kv-head
         # groups, so the kv head count must divide both H and the tp size
         self.num_kv_heads = (int(num_kv_heads) if num_kv_heads is not None
@@ -224,7 +236,7 @@ class ParallelTransformerLM:
                     ring_block_k=self.ring_block_k,
                     num_local_kv_heads=self.num_kv_heads // self.tp,
                     window=self.attention_window,
-                    rope_positions=rope_pos)
+                    rope_positions=rope_pos, sp_impl=self.sp_impl)
                 x = x + attn.astype(cdt)
                 h = ln(lp["ln2"], x)
                 stats = None

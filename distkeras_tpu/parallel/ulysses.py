@@ -1,0 +1,113 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence resharding.
+
+No reference counterpart (SURVEY.md §2.3: sequence parallelism absent
+upstream); this is the second of the two SP schedules SURVEY §5 names
+("ring attention or all-to-all sequence/context parallelism").  Where
+``ring.py`` keeps q resident and rotates k/v around the ICI ring in
+``sp`` steps, the all-to-all schedule pays exactly TWO collectives total:
+
+    (B, S/sp, H, Dh)  --all_to_all-->  (B, S, H/sp, Dh)
+        attend locally over the FULL sequence (flash kernel eligible)
+    (B, S, H/sp, Dh)  --all_to_all-->  (B, S/sp, H, Dh)
+
+Each device ends up owning ``H/sp`` whole heads over the whole sequence,
+computes ordinary (causal/windowed) attention for them — on TPU that local
+attend dispatches to the Pallas flash kernel, which the ring's hand-rolled
+online-softmax rotation cannot use — and reshards back.  Trade-offs vs the
+ring, so callers can pick per workload:
+
+  * collectives: 2 all_to_alls (each moves the full q/k/v+out bytes once)
+    vs ``sp`` ppermutes of the k/v shard (k/v bytes ``sp`` times);
+  * overlap: the ring overlaps transfer with compute (double-buffered);
+    all_to_all is a barrier — but only two of them;
+  * memory: full-S keys live on each device during the attend (score
+    blocks stay flash-bounded), so the ring remains the choice when even
+    one head's full-S kv does not fit;
+  * constraint: the head count (q AND kv) must divide by ``sp``; the ring
+    has no head-count requirement.
+
+The sequence blocks land in device order along the axis (``tiled``
+all_to_all concatenates by axis index), matching the contiguous-block
+sharding the transformer uses, so global causal/window masks and
+pre-applied RoPE rotations line up unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .ring import SEQ_AXIS
+
+
+def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
+                      causal: bool = False, scale: Optional[float] = None,
+                      window: Optional[int] = None):
+    """All-to-all sequence-parallel attention — call *inside* shard_map.
+
+    q: local shard (B, S_local, H, Dh); k, v: (B, S_local, Hkv, Dh) with
+    Hkv | H (grouped-query attention).  Sequence-sharded on ``axis_name``
+    in contiguous blocks; returns the local (B, S_local, H, Dh) output in
+    q.dtype.
+
+    Head divisibility: requires ``H % sp == 0``.  When ``Hkv % sp != 0``
+    each k/v head is first repeated ``sp/gcd(Hkv, sp)`` times — the
+    smallest expansion making the kv head count (``lcm(Hkv, sp)``)
+    splittable — since the GQA grouping cannot be split mid-group across
+    devices; the repeat costs all_to_all payload, so keep ``num_kv_heads``
+    a multiple of the seq-axis size where the cache/propagation savings
+    matter.  Head-group alignment: device j's q slice [j·H/sp, (j+1)·H/sp)
+    consumes exactly kv slice [j·Hkv/sp, (j+1)·Hkv/sp) whenever
+    ``Hkv % sp == 0`` (which the repeat establishes), so the per-device
+    GQA ratio equals the global one and the grouped attend is unchanged.
+    """
+    from ..ops.attention import attention
+
+    sp = jax.lax.axis_size(axis_name)
+    b, s_loc, h, dh = q.shape
+    hkv = k.shape[2]
+    if h % sp:
+        raise ValueError(
+            f"ulysses attention needs num_heads % seq-axis size == 0, got "
+            f"{h} heads over sp={sp} (use the ring schedule otherwise)")
+    if hkv % sp:
+        r = sp // math.gcd(hkv, sp)
+        k = jnp.repeat(k, r, axis=2)
+        v = jnp.repeat(v, r, axis=2)
+
+    def to_heads(x):
+        # (B, S/sp, H', Dh) -> (B, S, H'/sp, Dh): split heads, gather seq
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)
+    # full sequence resident: the ordinary dispatcher applies (Pallas flash
+    # on TPU when shapes qualify, XLA reference otherwise); global causal /
+    # sliding-window semantics need no position bookkeeping here
+    out = attention(q, k, v, causal=causal, scale=scale, window=window)
+    # (B, S, H/sp, Dh) -> (B, S/sp, H, Dh): split seq, gather heads
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
+                           causal: bool = False,
+                           scale: Optional[float] = None,
+                           window: Optional[int] = None):
+    """Convenience wrapper: global (B, S, H, Dh) arrays in, sequence sharded
+    over ``mesh[axis_name]``, all-to-all attention, global array out.  For
+    models already running under shard_map, call ``ulysses_attention``
+    directly (same shape as ``ring.ring_self_attention``)."""
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        lambda a, b_, c: ulysses_attention(a, b_, c, axis_name, causal,
+                                           scale, window),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    sharding = NamedSharding(mesh, spec)
+    return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),
+              jax.device_put(v, sharding))

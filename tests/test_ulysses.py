@@ -1,0 +1,142 @@
+"""Ulysses (all-to-all) sequence parallelism vs full attention and vs the
+ring schedule, on the 8-device virtual mesh.  No reference counterpart
+(SURVEY.md §2.3: sequence parallelism absent upstream) — with ring.py this
+completes the two SP schedules SURVEY §5 names ("ring attention or
+all-to-all sequence/context parallelism").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from distkeras_tpu.ops.attention import dot_product_attention
+from distkeras_tpu.parallel import get_mesh
+from distkeras_tpu.parallel.transformer import ParallelTransformerLM
+from distkeras_tpu.parallel.ulysses import ulysses_self_attention
+
+
+def rand_qkv(rng, b=2, s=64, h=8, hkv=None, d=16):
+    ks = jax.random.split(rng, 3)
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, hkv or h, d))
+    v = jax.random.normal(ks[2], (b, s, hkv or h, d))
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full(eight_devices, causal):
+    """Sequence sharded over 8 devices; two all_to_alls + local full-S
+    attend == full attention."""
+    mesh = get_mesh(8, axis_name="seq")
+    q, k, v = rand_qkv(jax.random.PRNGKey(0))
+    out = ulysses_self_attention(q, k, v, mesh, axis_name="seq",
+                                 causal=causal)
+    want = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-5)
+
+
+@pytest.mark.parametrize("kv_heads", [1, 2])
+def test_ulysses_gqa_repeat_path_matches_full(eight_devices, kv_heads):
+    """Hkv % sp != 0: k/v repeat up to H before the reshard; forward and
+    k-gradients equal full-array GQA attention."""
+    mesh = get_mesh(8, axis_name="seq")
+    q, k, v = rand_qkv(jax.random.PRNGKey(1), hkv=kv_heads)
+    out = ulysses_self_attention(q, k, v, mesh, axis_name="seq", causal=True)
+    want = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-5)
+
+    g_u = jax.grad(lambda k_: ulysses_self_attention(
+        q, k_, v, mesh, axis_name="seq", causal=True).sum())(k)
+    g_f = jax.grad(lambda k_: dot_product_attention(
+        q, k_, v, causal=True).sum())(k)
+    np.testing.assert_allclose(np.asarray(g_u), np.asarray(g_f), atol=1e-4)
+
+
+def test_ulysses_gqa_divisible_split_matches_full(eight_devices):
+    """Hkv % sp == 0: kv heads split directly (no repeat) and the per-device
+    head-group alignment preserves the global GQA grouping."""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    q, k, v = rand_qkv(jax.random.PRNGKey(2), hkv=4)
+    out = ulysses_self_attention(q, k, v, mesh, axis_name="seq", causal=True)
+    want = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-5)
+
+
+def test_ulysses_window_matches_full(eight_devices):
+    """Sliding window on the gathered full sequence == windowed full
+    attention (global positions line up with block-ordered all_to_all)."""
+    mesh = get_mesh(8, axis_name="seq")
+    q, k, v = rand_qkv(jax.random.PRNGKey(3))
+    out = ulysses_self_attention(q, k, v, mesh, axis_name="seq",
+                                 causal=True, window=12)
+    want = dot_product_attention(q, k, v, causal=True, window=12)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(eight_devices):
+    mesh = get_mesh(8, axis_name="seq")
+    q, k, v = rand_qkv(jax.random.PRNGKey(4), h=4)
+    with pytest.raises(ValueError, match="num_heads"):
+        ulysses_self_attention(q, k, v, mesh, axis_name="seq", causal=True)
+
+
+# -- integrated LM ------------------------------------------------------------
+
+def mesh_of(shape):
+    n = int(np.prod(shape))
+    devs = np.array(jax.devices()[:n]).reshape(shape)
+    return Mesh(devs, ("data", "seq", "model"))
+
+
+def run_steps(lm, steps=3, lr=1e-2):
+    import optax
+    params = lm.init(jax.random.PRNGKey(7))
+    opt_state, step = lm.compile_train_step(optax.adam(lr), params)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, lm.vocab_size, (4, lm.seq_len)).astype(np.int32)
+    labels = (toks + 1) % lm.vocab_size
+    sh = lm.batch_sharding()
+    toks, labels = jax.device_put(toks, sh), jax.device_put(labels, sh)
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, toks, labels)
+        losses.append(float(loss))
+    return losses
+
+
+def make_lm(mesh, **kw):
+    cfg = dict(vocab_size=32, seq_len=16, d_model=16, num_heads=8,
+               num_layers=2, mlp_dim=32, mesh=mesh,
+               compute_dtype=jnp.float32)
+    cfg.update(kw)
+    return ParallelTransformerLM(**cfg)
+
+
+def test_ulysses_lm_matches_ring_and_single(eight_devices):
+    """The dp×sp×tp LM under sp_impl='ulysses' == the same model under
+    sp_impl='ring' == the 1×1×1 mesh: the SP schedule is an execution
+    detail, not a numerics change."""
+    l_u = run_steps(make_lm(mesh_of((1, 4, 2)), sp_impl="ulysses"))
+    l_r = run_steps(make_lm(mesh_of((1, 4, 2)), sp_impl="ring"))
+    l_1 = run_steps(make_lm(mesh_of((1, 1, 1))))
+    np.testing.assert_allclose(l_u, l_r, rtol=2e-4)
+    np.testing.assert_allclose(l_u, l_1, rtol=2e-4)
+
+
+def test_ulysses_lm_rope_gqa_window(eight_devices):
+    """Composed long-context stack (RoPE + GQA + sliding window) under
+    ulysses == single device."""
+    kw = dict(num_heads=8, num_kv_heads=2, attention_window=8,
+              positional="rope", d_model=32)
+    l_u = run_steps(make_lm(mesh_of((1, 4, 2)), sp_impl="ulysses", **kw))
+    l_1 = run_steps(make_lm(mesh_of((1, 1, 1)), **kw))
+    np.testing.assert_allclose(l_u, l_1, rtol=2e-4)
+
+
+def test_ulysses_lm_rejects_bad_head_split(eight_devices):
+    with pytest.raises(ValueError, match="ulysses"):
+        make_lm(mesh_of((1, 4, 2)), sp_impl="ulysses", num_heads=4)
+    with pytest.raises(ValueError, match="sp_impl"):
+        make_lm(mesh_of((1, 4, 2)), sp_impl="nope")
