@@ -5,9 +5,19 @@ Both ``ParallelTransformerLM`` (dp × sp × tp + ep) and
 ``shard_map``'d value_and_grad + optax update over mesh-sharded params, with
 the optimizer state sharded like the params it tracks.  This module holds
 that machinery once, in a model-agnostic place.
+
+``zero_axis`` adds ZeRO-1 optimizer-state sharding: optax moment leaves are
+additionally partitioned over the data axis (each data shard owns 1/dp of
+every mu/nu/trace buffer), expressed purely through sharding annotations —
+the update stays ordinary optax, and XLA GSPMD inserts the slice of the
+(replicated) gradients, the local moment update, and the all-gather of the
+applied param updates.  This is the "annotate shardings, let the compiler
+place collectives" recipe, not a hand-rolled reduce-scatter schedule.
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import optax
@@ -22,7 +32,8 @@ def opt_partition_specs(optimizer, params, param_specs):
     Optax moment trees (mu/nu/trace...) embed the full param tree, so every
     state leaf's key path *ends with* some param's key path — match on that
     suffix to inherit the param's spec; leaves with no param suffix (step
-    counters, scalars) replicate."""
+    counters, scalars) replicate.  Returns (specs, state shape tree) so
+    callers needing the shapes (zero_shard_specs) don't re-trace init."""
     opt_shape = jax.eval_shape(optimizer.init, params)
     spec_leaves = jax.tree_util.tree_leaves(
         param_specs, is_leaf=lambda x: isinstance(x, P))
@@ -39,12 +50,36 @@ def opt_partition_specs(optimizer, params, param_specs):
                 return sp
         return P()
 
-    return jax.tree_util.tree_map_with_path(leaf_spec, opt_shape)
+    return jax.tree_util.tree_map_with_path(leaf_spec, opt_shape), opt_shape
+
+
+def zero_shard_specs(opt_specs, opt_shapes, mesh: Mesh, zero_axis: str):
+    """ZeRO-1: partition each optimizer-state leaf's spec over ``zero_axis``.
+
+    For every leaf, the first dimension that is (a) unsharded in the
+    inherited spec and (b) divisible by the axis size takes ``zero_axis``;
+    leaves with no such dimension (scalars, odd shapes) stay as inherited —
+    per-leaf fallback, never an error, so any model shape benefits where it
+    can."""
+    dp = mesh.shape[zero_axis]
+
+    def shard_leaf(spec, shape):
+        if not isinstance(spec, P):
+            return spec
+        entries = list(spec) + [None] * (len(shape.shape) - len(spec))
+        for i, (e, n) in enumerate(zip(entries, shape.shape)):
+            if e is None and n % dp == 0 and n > 0:
+                entries[i] = zero_axis
+                return P(*entries)
+        return spec
+
+    return tmap(shard_leaf, opt_specs, opt_shapes,
+                is_leaf=lambda x: isinstance(x, P))
 
 
 def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
                      optimizer: optax.GradientTransformation, params,
-                     loss_and_grads=None):
+                     loss_and_grads=None, zero_axis: Optional[str] = None):
     """(opt_state, jitted step): step(params, opt, tokens, labels) ->
     (params, opt, loss).
 
@@ -54,10 +89,54 @@ def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
     supply gradients another way than reverse-mode over ``local_loss``
     (e.g. the hand-scheduled 1F1B pipeline backward); it has the
     ``value_and_grad`` signature and also runs inside shard_map.
+
+    ``zero_axis``: a mesh axis name (usually the data axis) to ZeRO-1-shard
+    the optimizer state over.  The grad computation is unchanged (grads
+    come out of shard_map replicated over the data axis, courtesy of the
+    psum transpose); the optax update then runs under plain jit with the
+    moment buffers annotated ``zero_axis``-sharded, so GSPMD compiles the
+    per-shard moment update + param-update all-gather.  Numerics are
+    bit-identical to the unsharded path; HBM for mu/nu drops by the axis
+    size.
     """
-    opt_sp = opt_partition_specs(optimizer, params, param_specs)
+    opt_sp, opt_shapes = opt_partition_specs(optimizer, params, param_specs)
     if loss_and_grads is None:
         loss_and_grads = jax.value_and_grad(local_loss)
+
+    if zero_axis is not None:
+        if zero_axis not in mesh.shape:
+            raise ValueError(f"zero_axis {zero_axis!r} not in mesh axes "
+                             f"{tuple(mesh.shape)}")
+        opt_sp = zero_shard_specs(
+            opt_sp, opt_shapes, mesh, zero_axis)
+        grads_fn = jax.shard_map(
+            loss_and_grads, mesh=mesh,
+            in_specs=(param_specs, batch_spec, batch_spec),
+            out_specs=(P(), param_specs))
+        def constrain(tree, specs):
+            # flatten_up_to semantics: ``tree``'s array leaves pair with
+            # whole P entries in ``specs`` (P is a tuple subclass, so a
+            # direct flatten of specs would recurse into it)
+            return tmap(lambda x, s: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, s)), tree, specs)
+
+        def zero_step(params, opt_state, tokens, labels):
+            loss, grads = grads_fn(params, tokens, labels)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            # the annotations below are where ZeRO lives: moments stay
+            # zero_axis-sharded (each data shard updates only its slice of
+            # the elementwise optax math), params return replicated (GSPMD
+            # all-gathers the applied updates once per step)
+            opt_state = constrain(opt_state, opt_sp)
+            params = constrain(optax.apply_updates(params, updates),
+                               param_specs)
+            return params, opt_state, loss
+
+        opt_state = jax.jit(
+            optimizer.init,
+            out_shardings=tmap(lambda s: NamedSharding(mesh, s), opt_sp,
+                               is_leaf=lambda x: isinstance(x, P)))(params)
+        return opt_state, jax.jit(zero_step, donate_argnums=(0, 1))
 
     def local_step(params, opt_state, tokens, labels):
         loss, grads = loss_and_grads(params, tokens, labels)

@@ -300,14 +300,17 @@ class ParallelTransformerLM:
 
     # -- train step -----------------------------------------------------------
     def compile_train_step(self, optimizer: optax.GradientTransformation,
-                           params):
+                           params, zero: bool = False):
         """Build (opt_state, jitted step): step(params, opt, tokens, labels)
         -> (params, opt, loss).  tokens/labels are (B, S) int32 sharded
-        ``P('data', 'seq')``."""
+        ``P('data', 'seq')``.  ``zero=True`` ZeRO-1-shards the optimizer
+        state over the data axis (identical numerics, mu/nu HBM / dp — see
+        ``train_step.build_train_step``)."""
         from .train_step import build_train_step
         data_axis, seq_axis, _ = self.axes
         return build_train_step(self.mesh, self._loss, self.param_specs(),
-                                P(data_axis, seq_axis), optimizer, params)
+                                P(data_axis, seq_axis), optimizer, params,
+                                zero_axis=data_axis if zero else None)
 
     def batch_sharding(self) -> NamedSharding:
         data_axis, seq_axis, _ = self.axes

@@ -49,6 +49,11 @@ def main():
     ap.add_argument("--accum", type=int, default=1,
                     help="gradient accumulation: average this many "
                          "mini-step gradients per optimizer update")
+    ap.add_argument("--zero", action="store_true",
+                    help="ZeRO-1: shard optimizer moments over the data "
+                         "axis (identical numerics, mu/nu HBM / dp)")
+    ap.add_argument("--sp-impl", choices=["ring", "ulysses"],
+                    default="ring", help="sequence-parallel schedule")
     args = ap.parse_args()
 
     n = args.dp * args.sp * args.tp
@@ -61,11 +66,15 @@ def main():
     mesh = Mesh(np.array(devs[:n]).reshape(args.dp, args.sp, args.tp),
                 ("data", "seq", "model"))
 
+    # ulysses reshards heads over the seq axis too, so give it tp*sp head
+    # granularity (ring has no head-count requirement)
+    heads = max(args.tp * (args.sp if args.sp_impl == "ulysses" else 1), 2)
     lm = ParallelTransformerLM(
         vocab_size=args.vocab, seq_len=args.seq_len, d_model=args.d_model,
-        num_heads=max(args.tp, 2), num_layers=args.layers,
+        num_heads=heads, num_layers=args.layers,
         mlp_dim=4 * args.d_model, mesh=mesh,
         moe_layers=(args.layers - 1,), num_experts=args.tp,
+        sp_impl=args.sp_impl,
         compute_dtype=jnp.float32 if jax.default_backend() == "cpu"
         else jnp.bfloat16)
     params = lm.init(jax.random.PRNGKey(0))
@@ -78,7 +87,7 @@ def main():
     tx = optax.adam(lr)
     if args.accum > 1:
         tx = optax.MultiSteps(tx, args.accum).gradient_transformation()
-    opt_state, step = lm.compile_train_step(tx, params)
+    opt_state, step = lm.compile_train_step(tx, params, zero=args.zero)
 
     # task: predict the next token of a shifted stream
     rng = np.random.default_rng(0)
