@@ -51,6 +51,14 @@ class Optimizer:
             return optax.rmsprop(lr, decay=h.get("rho", 0.9),
                                  eps=h.get("epsilon", 1e-7),
                                  momentum=h.get("momentum", 0.0))
+        if self.name == "nadam":
+            return optax.nadam(lr, b1=h.get("beta_1", 0.9),
+                               b2=h.get("beta_2", 0.999),
+                               eps=h.get("epsilon", 1e-7))
+        if self.name == "adamax":
+            return optax.adamax(lr, b1=h.get("beta_1", 0.9),
+                                b2=h.get("beta_2", 0.999),
+                                eps=h.get("epsilon", 1e-7))
         if self.name == "lamb":
             return optax.lamb(lr)
         raise ValueError(f"Unknown optimizer {self.name!r}")
@@ -69,10 +77,14 @@ _DEFAULT_LR = {
     "adagrad": 0.01,
     "adadelta": 1.0,
     "rmsprop": 0.001,
+    "nadam": 0.002,   # Keras-1.x Nadam/Adamax default lr
+    "adamax": 0.002,
     "lamb": 0.001,
 }
 
-_ALIASES = {"nadam": "adam", "adamax": "adam"}
+# full Keras-1.x name set resolves to true optax counterparts (the 2016
+# reference accepted any Keras optimizer string through worker_optimizer)
+_ALIASES = {}
 
 
 def SGD(learning_rate=0.01, momentum=0.0, nesterov=False):

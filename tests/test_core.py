@@ -138,7 +138,8 @@ def test_losses_closed_form():
 
 
 def test_optimizer_resolution():
-    for name in ["sgd", "adam", "adagrad", "adadelta", "rmsprop"]:
+    for name in ["sgd", "adam", "adagrad", "adadelta", "rmsprop",
+                 "nadam", "adamax", "adamw", "lamb"]:
         opt = opt_lib.get_optimizer(name)
         assert opt.to_optax() is not None
     opt = opt_lib.get_optimizer(opt_lib.SGD(learning_rate=0.5))
