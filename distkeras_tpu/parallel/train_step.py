@@ -67,6 +67,9 @@ def zero_shard_specs(opt_specs, opt_shapes, mesh: Mesh, zero_axis: str):
         if not isinstance(spec, P):
             return spec
         entries = list(spec) + [None] * (len(shape.shape) - len(spec))
+        if any(zero_axis == e or (isinstance(e, tuple) and zero_axis in e)
+               for e in entries):
+            return spec  # already partitioned over zero_axis (FSDP-style)
         for i, (e, n) in enumerate(zip(entries, shape.shape)):
             if e is None and n % dp == 0 and n > 0:
                 entries[i] = zero_axis
@@ -95,20 +98,28 @@ def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
     come out of shard_map replicated over the data axis, courtesy of the
     psum transpose); the optax update then runs under plain jit with the
     moment buffers annotated ``zero_axis``-sharded, so GSPMD compiles the
-    per-shard moment update + param-update all-gather.  Numerics are
-    bit-identical to the unsharded path; HBM for mu/nu drops by the axis
-    size.
+    per-shard moment update + param-update all-gather.  Losses match the
+    unsharded path to float tolerance (asserted at rtol 1e-6 — the update
+    math is identical, only GSPMD's fusion/reduction order differs from
+    the shard_map program's); HBM for mu/nu drops by the axis size.
     """
     opt_sp, opt_shapes = opt_partition_specs(optimizer, params, param_specs)
     if loss_and_grads is None:
         loss_and_grads = jax.value_and_grad(local_loss)
-
     if zero_axis is not None:
         if zero_axis not in mesh.shape:
             raise ValueError(f"zero_axis {zero_axis!r} not in mesh axes "
                              f"{tuple(mesh.shape)}")
-        opt_sp = zero_shard_specs(
-            opt_sp, opt_shapes, mesh, zero_axis)
+        opt_sp = zero_shard_specs(opt_sp, opt_shapes, mesh, zero_axis)
+
+    # opt_sp is final here (zero resharding included), so both step flavors
+    # share one sharded init
+    opt_state = jax.jit(
+        optimizer.init,
+        out_shardings=tmap(lambda s: NamedSharding(mesh, s), opt_sp,
+                           is_leaf=lambda x: isinstance(x, P)))(params)
+
+    if zero_axis is not None:
         grads_fn = jax.shard_map(
             loss_and_grads, mesh=mesh,
             in_specs=(param_specs, batch_spec, batch_spec),
@@ -132,10 +143,6 @@ def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
                                param_specs)
             return params, opt_state, loss
 
-        opt_state = jax.jit(
-            optimizer.init,
-            out_shardings=tmap(lambda s: NamedSharding(mesh, s), opt_sp,
-                               is_leaf=lambda x: isinstance(x, P)))(params)
         return opt_state, jax.jit(zero_step, donate_argnums=(0, 1))
 
     def local_step(params, opt_state, tokens, labels):
@@ -144,10 +151,6 @@ def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    opt_state = jax.jit(
-        optimizer.init,
-        out_shardings=tmap(lambda s: NamedSharding(mesh, s), opt_sp,
-                           is_leaf=lambda x: isinstance(x, P)))(params)
     step = jax.jit(jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(param_specs, opt_sp, batch_spec, batch_spec),

@@ -304,7 +304,7 @@ class ParallelTransformerLM:
         """Build (opt_state, jitted step): step(params, opt, tokens, labels)
         -> (params, opt, loss).  tokens/labels are (B, S) int32 sharded
         ``P('data', 'seq')``.  ``zero=True`` ZeRO-1-shards the optimizer
-        state over the data axis (identical numerics, mu/nu HBM / dp — see
+        state over the data axis (same update math, mu/nu HBM / dp — see
         ``train_step.build_train_step``)."""
         from .train_step import build_train_step
         data_axis, seq_axis, _ = self.axes

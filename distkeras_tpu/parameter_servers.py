@@ -236,6 +236,14 @@ class SocketParameterServer:
                         msg = networking.recv_data(conn)
                     except ValueError:
                         return  # torn/corrupt frame: drop the connection
+                    if isinstance(msg, dict) and "scales" in msg:
+                        # int8 wire compression (workers.PSWorker.commit):
+                        # codes x per-tensor scale -> f32 delta, decoded at
+                        # the transport boundary so every PS rule sees
+                        # ordinary float deltas
+                        msg["delta"] = [
+                            np.asarray(q, np.float32) * s
+                            for q, s in zip(msg["delta"], msg.pop("scales"))]
                     # apply-rule errors deliberately propagate (visible
                     # thread traceback) — only transport faults are silent
                     self.ps.handle_commit(msg)

@@ -290,8 +290,10 @@ class DistributedTrainer(Trainer):
             communication_window if communication_window is not None
             else self.DEFAULT_WINDOW)
         self.execution = execution
-        # host_ps wire compression for commits (e.g. "bfloat16"); the SPMD
-        # path has no wire — deltas ride ICI inside the XLA program
+        # host_ps/process_ps wire compression for commits: "bfloat16" (2x
+        # fewer delta bytes) or "int8" (4x, per-tensor scales + error
+        # feedback — workers.PSWorker.commit); the SPMD path has no wire —
+        # deltas ride ICI inside the XLA program
         self.wire_dtype = wire_dtype
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = max(int(checkpoint_every), 1)

@@ -172,10 +172,15 @@ class PSWorker(Worker):
         self.ps_host = ps_host
         self.ps_port = ps_port
         self.window = int(communication_window)
-        # e.g. "bfloat16": halve commit bytes.  Resolved eagerly so a bad
-        # name fails at construction, not mid-training in a worker thread.
+        # e.g. "bfloat16": halve commit bytes; "int8": quarter them with
+        # per-tensor affine quantization + error feedback (see commit()).
+        # Resolved eagerly so a bad name fails at construction, not
+        # mid-training in a worker thread.
+        self._quantize = wire_dtype == "int8"
         self.wire_dtype = (networking._dtype_of(wire_dtype)
-                           if wire_dtype is not None else None)
+                           if wire_dtype is not None and not self._quantize
+                           else None)
+        self._residual: Optional[List[np.ndarray]] = None
         self._sock: Optional[socket.socket] = None
         self._last_clock = 0
 
@@ -202,10 +207,42 @@ class PSWorker(Worker):
     def commit(self, delta: List[np.ndarray], worker_id: int):
         """'c': push a weight-shaped delta (reference: Worker.commit).
 
-        With ``wire_dtype="bfloat16"`` the delta is rounded to bf16 on the
-        wire (half the DCN bytes; the PS upcasts before applying) — lossy
-        compression the reference's pickle transport had no counterpart for.
+        Returns the delta the PS will actually APPLY (after any wire
+        compression) so callers whose local state must stay coupled to the
+        center — the elastic family subtracts what it committed — can use
+        the as-applied value instead of the pre-compression one.
+
+        ``wire_dtype="bfloat16"``: the delta is rounded to bf16 on the wire
+        (half the DCN bytes; the PS upcasts before applying).
+
+        ``wire_dtype="int8"``: per-tensor affine quantization — each tensor
+        ships as int8 codes + one f32 scale (max|d|/127), a 4x byte cut —
+        with ERROR FEEDBACK: the quantization error of every window is
+        carried into the next window's delta, so compression noise
+        telescopes instead of accumulating in the center (the 1-bit-SGD /
+        EF-SGD recipe).  Lossy compression the reference's pickle transport
+        had no counterpart for.
         """
+        if self._quantize:
+            if self._residual is None:
+                self._residual = [np.zeros_like(d, dtype=np.float32)
+                                  for d in delta]
+            eff = [d.astype(np.float32) + r
+                   for d, r in zip(delta, self._residual)]
+            scales = [float(np.max(np.abs(e)) / 127.0) or 1.0 for e in eff]
+            codes = [np.clip(np.rint(e / s), -127, 127).astype(np.int8)
+                     for e, s in zip(eff, scales)]
+            applied = [c.astype(np.float32) * s
+                       for c, s in zip(codes, scales)]
+            self._residual = [e - a for e, a in zip(eff, applied)]
+            networking.send_opcode(self._sock, b"c")
+            networking.send_data(self._sock, {
+                "delta": codes,
+                "scales": scales,
+                "worker_id": worker_id,
+                "clock": self._last_clock,
+            })
+            return applied
         if self.wire_dtype is not None:
             delta = [d.astype(self.wire_dtype) for d in delta]
         networking.send_opcode(self._sock, b"c")
@@ -214,6 +251,7 @@ class PSWorker(Worker):
             "worker_id": worker_id,
             "clock": self._last_clock,
         })
+        return [np.asarray(d, dtype=np.float32) for d in delta]
 
     # -- the training loop ---------------------------------------------------
     def train(self, index: int, shard: Dict[str, np.ndarray],
@@ -313,8 +351,11 @@ class AEASGDWorker(PSWorker):
         center = self.pull()
         local = self._params_to_weights(params)
         elastic = [self.alpha * (l - c) for l, c in zip(local, center)]
-        local = [l - e for l, e in zip(local, elastic)]
-        self.commit(elastic, index)
+        # subtract what the PS will actually APPLY (post-wire-compression):
+        # x and x-tilde must move by the same e or the elastic coupling
+        # drifts under lossy wire dtypes
+        applied = self.commit(elastic, index)
+        local = [l - e for l, e in zip(local, applied)]
         return self._weights_to_params(local), opt_state, loss
 
 

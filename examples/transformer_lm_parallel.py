@@ -51,7 +51,7 @@ def main():
                          "mini-step gradients per optimizer update")
     ap.add_argument("--zero", action="store_true",
                     help="ZeRO-1: shard optimizer moments over the data "
-                         "axis (identical numerics, mu/nu HBM / dp)")
+                         "axis (same update math, mu/nu HBM / dp)")
     ap.add_argument("--sp-impl", choices=["ring", "ulysses"],
                     default="ring", help="sequence-parallel schedule")
     args = ap.parse_args()

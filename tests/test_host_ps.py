@@ -238,6 +238,59 @@ def test_host_ps_bf16_wire_compression_learns():
     assert all(w.dtype == np.float32 for w in fitted.get_weights())
 
 
+def test_int8_commit_quantizes_with_error_feedback():
+    """commit(wire_dtype='int8') ships int8 codes + f32 scales, returns the
+    as-applied delta, and carries the quantization error into the next
+    window (EF-SGD): eff = delta + prev_residual == applied + new_residual
+    exactly, and |residual| <= scale/2 elementwise."""
+    from distkeras_tpu import networking as net
+    from distkeras_tpu.core.layers import Dense
+    from distkeras_tpu.core.model import Sequential, serialize_model
+    from distkeras_tpu.workers import DOWNPOURWorker
+    import jax
+
+    m = Sequential([Dense(2)], input_shape=(3,), compute_dtype="float32")
+    blob = serialize_model(m, m.init(jax.random.PRNGKey(0)))
+    wk = DOWNPOURWorker(blob, "sgd", "mse", "127.0.0.1", 1,
+                        wire_dtype="int8")
+    sent = []
+    wk._sock = object()  # never touched by the stubs below
+    orig_op, orig_send = net.send_opcode, net.send_data
+    net.send_opcode = lambda s, op: None
+    net.send_data = lambda s, msg: sent.append(msg)
+    try:
+        rng = np.random.default_rng(3)
+        d1 = [rng.standard_normal((3, 2)).astype(np.float32) * 0.01,
+              rng.standard_normal((2,)).astype(np.float32) * 0.01]
+        a1 = wk.commit(d1, 0)
+        assert all(c.dtype == np.int8 for c in sent[0]["delta"])
+        for d, a, r, s in zip(d1, a1, wk._residual, sent[0]["scales"]):
+            np.testing.assert_allclose(d, a + r, atol=1e-7)
+            assert np.all(np.abs(r) <= s / 2 + 1e-7)
+        r1 = [r.copy() for r in wk._residual]
+        d2 = [rng.standard_normal((3, 2)).astype(np.float32) * 0.01,
+              rng.standard_normal((2,)).astype(np.float32) * 0.01]
+        a2 = wk.commit(d2, 0)
+        for d, p, a, r in zip(d2, r1, a2, wk._residual):
+            np.testing.assert_allclose(d + p, a + r, atol=1e-7)
+    finally:
+        net.send_opcode, net.send_data = orig_op, orig_send
+
+
+def test_host_ps_int8_wire_compression_learns():
+    """ADAG over host_ps with int8-quantized commits (4x fewer delta bytes)
+    still trains to high accuracy — error feedback keeps the center honest."""
+    ds = make_dataset()
+    t = ADAG(make_model(), num_workers=2, batch_size=32, num_epoch=2,
+             communication_window=4, label_col="label_encoded",
+             learning_rate=0.1, execution="host_ps", wire_dtype="int8")
+    fitted = t.train(ds)
+    preds = fitted.predict(ds["features"][:256])
+    acc = float(np.mean(np.argmax(preds, axis=1) == ds["label"][:256]))
+    assert acc > 0.6, acc
+    assert all(w.dtype == np.float32 for w in fitted.get_weights())
+
+
 def test_host_ps_trains_transformer_lm():
     """The async socket-PS engine handles the sequence-model family too:
     a RoPE/GQA causal LM's loss drops through true hogwild training (the
