@@ -275,7 +275,9 @@ class DistributedTrainer(Trainer):
                  lr_schedule=None, gradient_accumulation: int = 1,
                  gradient_clip_norm: Optional[float] = None,
                  early_stopping_patience: Optional[int] = None,
-                 early_stopping_min_delta: float = 0.0):
+                 early_stopping_min_delta: float = 0.0,
+                 fault_tolerance: bool = False,
+                 fault_injection: Optional[dict] = None):
         super().__init__(keras_model, loss, worker_optimizer, learning_rate,
                          seed, lr_schedule, gradient_accumulation,
                          gradient_clip_norm,
@@ -307,6 +309,19 @@ class DistributedTrainer(Trainer):
             raise ValueError("checkpoint_backend must be 'npz' or 'orbax'")
         self.checkpoint_backend = checkpoint_backend
         self.metrics_path = metrics_path
+        # PS-engine fault story (SURVEY §5: the reference delegated worker
+        # death to Spark task retry).  fault_tolerance=True: a dying
+        # PS worker (thread exception / process exit) no longer aborts the
+        # run — survivors finish, the center keeps every commit applied
+        # before the death, and the dead ids land in ``failed_workers``.
+        # fault_injection={worker_id: n}: that worker raises at its n+1-th
+        # commit — the fault-injection hook the tests use.
+        self.fault_tolerance = bool(fault_tolerance)
+        self.fault_injection = fault_injection
+        self.failed_workers: List[int] = []
+        # worker id -> traceback text / exit code of tolerated deaths, so a
+        # genuine bug surviving under fault_tolerance stays diagnosable
+        self.worker_failures: dict = {}
         self._engine: Optional[SPMDEngine] = None
         self._state: Optional[DistState] = None
 
@@ -346,6 +361,13 @@ class DistributedTrainer(Trainer):
                     "resume is not supported on execution='process_ps'")
             from .parameter_servers import run_process_ps_training
             return run_process_ps_training(self, dataset, shuffle)
+        if self.fault_tolerance or self.fault_injection:
+            raise ValueError(
+                "fault_tolerance/fault_injection apply to the PS engines "
+                "(execution='host_ps'/'process_ps'); the SPMD program is "
+                "bulk-synchronous — a lost participant is a lost collective, "
+                "and its recovery story is checkpoint_dir + train("
+                "resume=True)")
         self.record_training_start()
         # before any resource (checkpoint manager, metrics file) opens:
         # a bad validation config must not leak them

@@ -167,11 +167,18 @@ class PSWorker(Worker):
 
     def __init__(self, model_blob, worker_optimizer, loss, ps_host: str,
                  ps_port: int, communication_window: int = 5,
-                 wire_dtype: Optional[str] = None, **kw):
+                 wire_dtype: Optional[str] = None,
+                 fault_injection: Optional[dict] = None, **kw):
         super().__init__(model_blob, worker_optimizer, loss, **kw)
         self.ps_host = ps_host
         self.ps_port = ps_port
         self.window = int(communication_window)
+        # fault injection (SURVEY §5: the reference had none): worker id ->
+        # commit budget; the worker raises at its budget+1-th commit.  Keys
+        # arrive as strings after a JSON round-trip (process engine).
+        self.fault_injection = {int(k): int(v)
+                                for k, v in (fault_injection or {}).items()}
+        self._commits = 0
         # e.g. "bfloat16": halve commit bytes; "int8": quarter them with
         # per-tensor affine quantization + error feedback (see commit()).
         # Resolved eagerly so a bad name fails at construction, not
@@ -223,6 +230,20 @@ class PSWorker(Worker):
         EF-SGD recipe).  Lossy compression the reference's pickle transport
         had no counterpart for.
         """
+        self._commits += 1
+        budget = self.fault_injection.get(worker_id)
+        if budget is not None and self._commits > budget:
+            # hard-close the socket FIRST so the unwind path's disconnect()
+            # is a no-op (no graceful b'q'): the PS sees a plain EOF,
+            # exactly the signature of a worker host falling over
+            try:
+                self._sock.close()
+            except (OSError, AttributeError):
+                pass
+            self._sock = None
+            raise RuntimeError(
+                f"injected fault: worker {worker_id} dies at commit "
+                f"{self._commits}")
         if self._quantize:
             if self._residual is None:
                 self._residual = [np.zeros_like(d, dtype=np.float32)

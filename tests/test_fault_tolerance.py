@@ -1,0 +1,98 @@
+"""Worker-death tolerance on the PS engines (SURVEY §5 fault table: the
+reference had no failure handling of its own — a dead worker was a Spark
+task retry).  Here ``fault_tolerance=True`` lets a PS run survive worker
+death: the PS already treats a dropped socket as a normal disconnect, so
+the driver's only job is to finish with the survivors and report the dead.
+``fault_injection={worker_id: n}`` makes a worker raise at its n+1-th
+commit — the fault-injection hook the reference never had.
+"""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import ADAG, DOWNPOUR
+
+from test_trainers import eval_accuracy, make_dataset, make_model
+
+
+def test_host_ps_survives_injected_worker_death():
+    """4 workers, worker 1 dies at its 3rd commit: training completes on
+    the survivors, the dead id is reported, and the model still learns."""
+    ds = make_dataset(n=1024)
+    t = ADAG(make_model(), num_workers=4, batch_size=16, num_epoch=3,
+             communication_window=4, label_col="label_encoded",
+             worker_optimizer="adam", learning_rate=2e-3,
+             execution="host_ps", fault_tolerance=True,
+             fault_injection={1: 2})
+    fitted = t.train(ds)
+    assert t.failed_workers == [1]
+    # the tolerated death stays diagnosable
+    assert "injected fault" in t.worker_failures[1]
+    assert eval_accuracy(fitted, ds) > 0.85
+    # survivors' full histories + the casualty's partial one came back
+    assert len(t.get_history()) > 0
+
+
+def test_injected_fault_without_tolerance_raises():
+    ds = make_dataset(n=512)
+    t = DOWNPOUR(make_model(), num_workers=2, batch_size=16, num_epoch=1,
+                 communication_window=2, label_col="label_encoded",
+                 worker_optimizer="sgd", learning_rate=0.05,
+                 execution="host_ps", fault_injection={0: 1})
+    with pytest.raises(RuntimeError, match="injected fault"):
+        t.train(ds)
+
+
+def test_all_workers_dead_still_raises():
+    """fault_tolerance survives SOME deaths, not total loss."""
+    ds = make_dataset(n=512)
+    t = ADAG(make_model(), num_workers=2, batch_size=16, num_epoch=1,
+             communication_window=2, label_col="label_encoded",
+             worker_optimizer="sgd", learning_rate=0.05,
+             execution="host_ps", fault_tolerance=True,
+             fault_injection={0: 1, 1: 1})
+    with pytest.raises(RuntimeError, match="all 2 workers failed"):
+        t.train(ds)
+
+
+def test_spmd_rejects_fault_kwargs():
+    ds = make_dataset(n=256)
+    for kw in (dict(fault_tolerance=True), dict(fault_injection={0: 1})):
+        t = ADAG(make_model(), num_workers=2, batch_size=16, num_epoch=1,
+                 label_col="label_encoded", **kw)
+        with pytest.raises(ValueError, match="fault_tolerance"):
+            t.train(ds)
+
+
+def test_failed_workers_reset_between_runs():
+    ds = make_dataset(n=512)
+    t = ADAG(make_model(), num_workers=2, batch_size=16, num_epoch=1,
+             communication_window=4, label_col="label_encoded",
+             worker_optimizer="adam", learning_rate=2e-3,
+             execution="host_ps", fault_tolerance=True,
+             fault_injection={0: 1})
+    t.train(ds)
+    assert t.failed_workers == [0]
+    t.fault_injection = None
+    t.train(ds)
+    assert t.failed_workers == []
+
+
+@pytest.mark.slow
+def test_process_ps_survives_worker_process_death():
+    """Cross-process flavor: one of two OS worker processes exits nonzero
+    mid-training; the driver completes with the survivor and reports it."""
+    ds = make_dataset(n=512)
+    t = ADAG(make_model(), num_workers=2, batch_size=16, num_epoch=4,
+             communication_window=4, label_col="label_encoded",
+             worker_optimizer="adam", learning_rate=4e-3,
+             execution="process_ps", fault_tolerance=True,
+             fault_injection={1: 2})
+    fitted = t.train(ds)
+    assert t.failed_workers == [1]
+    assert t.worker_failures[1].startswith("exit code")
+    # half the shard died after 2 commits: the survivor's half still
+    # carries the model well past chance (0.25 for 4 classes)
+    assert eval_accuracy(fitted, ds) > 0.7
+    # only the survivor's history came back (the casualty never wrote one)
+    assert len(t.get_history()) > 0
