@@ -135,8 +135,11 @@ def main():
     # artifact's platform/compute_dtype/batch fields label the
     # configuration.
     fallback = note is not None or probed_platform == "cpu"
+    # batch 512 won the on-chip sweep (docs/TUNING.md): 690k ex/s vs 662k
+    # at 128 and 578k at 1024 on a v5-lite — big enough to amortize per-step
+    # overhead, small enough to stay in the HBM sweet spot
     batch = int(os.environ.get("DISTKERAS_BENCH_BATCH",
-                               "128" if not fallback else "32"))
+                               "512" if not fallback else "32"))
     window = int(os.environ.get("DISTKERAS_BENCH_WINDOW",
                                 "12" if not fallback else "4"))
     n_rows = int(os.environ.get("DISTKERAS_BENCH_ROWS",
