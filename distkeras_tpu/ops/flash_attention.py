@@ -48,6 +48,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._vma import out_struct
 from .attention import validate_window
 
 NEG_INF = float("-inf")
@@ -145,11 +146,13 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
     fold = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     qf, kf, vf = fold(q), fold(k), fold(v)
 
-    out_shape = [jax.ShapeDtypeStruct(qf.shape, q.dtype)]
+    # out_struct: under shard_map (tp/ulysses paths on TPU) pallas outputs
+    # must declare the mesh axes they vary over — they vary as q does
+    out_shape = [out_struct(qf.shape, q.dtype, qf)]
     out_specs = [pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0))]
     if save_residuals:  # inference skips the O(128·S) lse write entirely
         out_shape.append(
-            jax.ShapeDtypeStruct((b * h, s, _LANES), jnp.float32))
+            out_struct((b * h, s, _LANES), jnp.float32, qf))
         out_specs.append(
             pl.BlockSpec((1, bq, _LANES), lambda bh, qi, kj: (bh, qi, 0)))
 
@@ -273,7 +276,7 @@ def _flash_backward(q, k, v, out, lse, g, scale: float, causal: bool,
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, num_k=s // bk,
                           window=window),
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        out_shape=out_struct(qf.shape, q.dtype, qf),
         grid=(b * h, s // bq, s // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0)),
@@ -292,8 +295,8 @@ def _flash_backward(q, k, v, out, lse, g, scale: float, causal: bool,
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, num_q=s // bq,
                           window=window),
-        out_shape=(jax.ShapeDtypeStruct(kf.shape, k.dtype),
-                   jax.ShapeDtypeStruct(vf.shape, v.dtype)),
+        out_shape=(out_struct(kf.shape, k.dtype, kf),
+                   out_struct(vf.shape, v.dtype, vf)),
         grid=(b * h, s // bk, s // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),

@@ -365,20 +365,22 @@ class PipelineTransformerLM:
 
     # -- train step -----------------------------------------------------------
     def compile_train_step(self, optimizer: optax.GradientTransformation,
-                           params, zero: bool = False):
+                           params, zero: bool = False, fsdp: bool = False):
         """(opt_state, jitted step): step(params, opt, tokens, labels) ->
         (params, opt, loss); tokens/labels (B, S) int32 sharded P('data').
         ``schedule='1f1b'`` swaps the autodiff GPipe backward for the
         hand-scheduled one-forward-one-backward program (same loss/grads,
         O(n) activation state).  ``zero=True`` ZeRO-1-shards the optimizer
-        state over the data axis (see ``train_step.build_train_step``)."""
+        state over the data axis; ``fsdp=True`` ZeRO-3-shards params AND
+        moments there (see ``train_step.build_train_step``)."""
         from .train_step import build_train_step
         return build_train_step(
             self.mesh, self._local_loss, self.param_specs(),
             P(self.data_axis), optimizer, params,
             loss_and_grads=(self._local_loss_and_grads_1f1b
                             if self.schedule == "1f1b" else None),
-            zero_axis=self.data_axis if zero else None)
+            zero_axis=self.data_axis if zero else None,
+            fsdp_axis=self.data_axis if fsdp else None)
 
     def batch_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P(self.data_axis))

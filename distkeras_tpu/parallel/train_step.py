@@ -53,36 +53,54 @@ def opt_partition_specs(optimizer, params, param_specs):
     return jax.tree_util.tree_map_with_path(leaf_spec, opt_shape), opt_shape
 
 
-def zero_shard_specs(opt_specs, opt_shapes, mesh: Mesh, zero_axis: str):
-    """ZeRO-1: partition each optimizer-state leaf's spec over ``zero_axis``.
+def shard_specs_over_axis(specs, shapes, mesh: Mesh, axis: str):
+    """Partition each leaf's spec over ``axis`` where a dimension allows it.
 
     For every leaf, the first dimension that is (a) unsharded in the
-    inherited spec and (b) divisible by the axis size takes ``zero_axis``;
+    inherited spec and (b) divisible by the axis size takes ``axis``;
     leaves with no such dimension (scalars, odd shapes) stay as inherited —
     per-leaf fallback, never an error, so any model shape benefits where it
-    can."""
-    dp = mesh.shape[zero_axis]
+    can.  ``shapes`` is any tree of objects with ``.shape`` (concrete arrays
+    or ShapeDtypeStructs) mirroring ``specs``."""
+    n_shards = mesh.shape[axis]
 
     def shard_leaf(spec, shape):
         if not isinstance(spec, P):
             return spec
         entries = list(spec) + [None] * (len(shape.shape) - len(spec))
-        if any(zero_axis == e or (isinstance(e, tuple) and zero_axis in e)
+        if any(axis == e or (isinstance(e, tuple) and axis in e)
                for e in entries):
-            return spec  # already partitioned over zero_axis (FSDP-style)
+            return spec  # already partitioned over this axis
         for i, (e, n) in enumerate(zip(entries, shape.shape)):
-            if e is None and n % dp == 0 and n > 0:
-                entries[i] = zero_axis
+            if e is None and n % n_shards == 0 and n > 0:
+                entries[i] = axis
                 return P(*entries)
         return spec
 
-    return tmap(shard_leaf, opt_specs, opt_shapes,
+    return tmap(shard_leaf, specs, shapes,
                 is_leaf=lambda x: isinstance(x, P))
+
+
+def zero_shard_specs(opt_specs, opt_shapes, mesh: Mesh, zero_axis: str):
+    """ZeRO-1: partition each optimizer-state leaf's spec over ``zero_axis``
+    (see ``shard_specs_over_axis`` for the per-leaf rule)."""
+    return shard_specs_over_axis(opt_specs, opt_shapes, mesh, zero_axis)
+
+
+def _constrain(mesh: Mesh, tree, specs):
+    """Annotate every array leaf of ``tree`` with its spec's NamedSharding.
+
+    flatten_up_to semantics: ``tree``'s array leaves pair with whole P
+    entries in ``specs`` (P is a tuple subclass, so a direct flatten of
+    specs would recurse into it)."""
+    return tmap(lambda x, s: jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, s)), tree, specs)
 
 
 def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
                      optimizer: optax.GradientTransformation, params,
-                     loss_and_grads=None, zero_axis: Optional[str] = None):
+                     loss_and_grads=None, zero_axis: Optional[str] = None,
+                     fsdp_axis: Optional[str] = None):
     """(opt_state, jitted step): step(params, opt, tokens, labels) ->
     (params, opt, loss).
 
@@ -102,10 +120,60 @@ def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
     unsharded path to float tolerance (asserted at rtol 1e-6 — the update
     math is identical, only GSPMD's fusion/reduction order differs from
     the shard_map program's); HBM for mu/nu drops by the axis size.
+
+    ``fsdp_axis``: ZeRO-3 / fully-sharded data parallelism — the *params
+    themselves* (not just the moments) are additionally partitioned over
+    the axis at rest, again purely through sharding annotations: the step
+    constrains params to their FSDP specs on entry and exit, the grad
+    shard_map still sees logically-full params (GSPMD compiles the
+    all-gather in, and fuses the grad psum + FSDP slice into a
+    reduce-scatter where profitable), and the optax update runs on the
+    owned 1/n slice with moments inheriting the FSDP layout.  Param,
+    grad-at-rest, and moment HBM all drop by the axis size; supersedes
+    ``zero_axis``.  The first call accepts params in any layout (outputs
+    come back FSDP-sharded, so the steady state is sharded end-to-end).
     """
-    opt_sp, opt_shapes = opt_partition_specs(optimizer, params, param_specs)
     if loss_and_grads is None:
         loss_and_grads = jax.value_and_grad(local_loss)
+
+    if fsdp_axis is not None:
+        if fsdp_axis not in mesh.shape:
+            raise ValueError(f"fsdp_axis {fsdp_axis!r} not in mesh axes "
+                             f"{tuple(mesh.shape)}")
+        store_specs = shard_specs_over_axis(param_specs, params, mesh,
+                                            fsdp_axis)
+        # moments inherit the FSDP param layout (key-path suffix match);
+        # a second pass catches leaves whose param had no divisible dim
+        # but whose moment does (none in practice — belt and braces)
+        opt_sp, opt_shapes = opt_partition_specs(optimizer, params,
+                                                 store_specs)
+        opt_sp = shard_specs_over_axis(opt_sp, opt_shapes, mesh, fsdp_axis)
+        ns = lambda tree: tmap(lambda s: NamedSharding(mesh, s), tree,
+                               is_leaf=lambda x: isinstance(x, P))
+        opt_state = jax.jit(optimizer.init, out_shardings=ns(opt_sp))(params)
+
+        grads_fn = jax.shard_map(
+            loss_and_grads, mesh=mesh,
+            in_specs=(param_specs, batch_spec, batch_spec),
+            out_specs=(P(), param_specs))
+
+        def fsdp_step(params, opt_state, tokens, labels):
+            # at-rest layout: each fsdp shard owns 1/n of every param leaf;
+            # the shard_map boundary below is where GSPMD gathers them
+            params = _constrain(mesh, params, store_specs)
+            loss, grads = grads_fn(params, tokens, labels)
+            # grads leave the shard_map replicated over the data axis; the
+            # constraint lets GSPMD lower psum + slice to a reduce-scatter
+            grads = _constrain(mesh, grads, store_specs)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            opt_state = _constrain(mesh, opt_state, opt_sp)
+            params = _constrain(mesh, optax.apply_updates(params, updates),
+                                store_specs)
+            return params, opt_state, loss
+
+        return opt_state, jax.jit(fsdp_step, donate_argnums=(0, 1))
+
+    opt_sp, opt_shapes = opt_partition_specs(optimizer, params, param_specs)
     if zero_axis is not None:
         if zero_axis not in mesh.shape:
             raise ValueError(f"zero_axis {zero_axis!r} not in mesh axes "
@@ -124,13 +192,6 @@ def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
             loss_and_grads, mesh=mesh,
             in_specs=(param_specs, batch_spec, batch_spec),
             out_specs=(P(), param_specs))
-        def constrain(tree, specs):
-            # flatten_up_to semantics: ``tree``'s array leaves pair with
-            # whole P entries in ``specs`` (P is a tuple subclass, so a
-            # direct flatten of specs would recurse into it)
-            return tmap(lambda x, s: jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, s)), tree, specs)
-
         def zero_step(params, opt_state, tokens, labels):
             loss, grads = grads_fn(params, tokens, labels)
             updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -138,9 +199,9 @@ def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
             # zero_axis-sharded (each data shard updates only its slice of
             # the elementwise optax math), params return replicated (GSPMD
             # all-gathers the applied updates once per step)
-            opt_state = constrain(opt_state, opt_sp)
-            params = constrain(optax.apply_updates(params, updates),
-                               param_specs)
+            opt_state = _constrain(mesh, opt_state, opt_sp)
+            params = _constrain(mesh, optax.apply_updates(params, updates),
+                                param_specs)
             return params, opt_state, loss
 
         return opt_state, jax.jit(zero_step, donate_argnums=(0, 1))

@@ -47,7 +47,7 @@ class ParallelTransformerLM:
                  router_aux_weight: float = 1e-2,
                  compute_dtype=jnp.bfloat16, remat: bool = False,
                  ring_block_k: Optional[int] = None,
-                 sp_impl: str = "ring",
+                 sp_impl: str = "ring", fused_ce: bool = False,
                  num_kv_heads: Optional[int] = None,
                  attention_window: Optional[int] = None,
                  positional: str = "learned",
@@ -83,6 +83,10 @@ class ParallelTransformerLM:
         # ulysses reshards the model-local heads over the seq axis: two
         # all_to_alls + a full-sequence flash attend (parallel/ulysses.py)
         self.sp_impl = sp_impl
+        # fused_ce: per-token loss via the streaming Pallas kernel
+        # (ops/fused_ce.py) instead of a materialized (T, V) log_softmax —
+        # the HBM win grows with vocab size
+        self.fused_ce = bool(fused_ce)
         if sp_impl == "ulysses" and (num_heads // self.tp) % self.sp:
             raise ValueError(
                 f"sp_impl='ulysses' needs local head count "
@@ -275,11 +279,19 @@ class ParallelTransformerLM:
         from .moe import load_balance_loss
         data_axis, seq_axis, model_axis = self.axes
         logits, router_stats = self._forward(params, tokens)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        picked = jnp.take_along_axis(
-            logp, labels.astype(jnp.int32)[..., None], axis=-1)[..., 0]
-        local_sum = -jnp.sum(picked)
-        local_cnt = jnp.asarray(picked.size, jnp.float32)
+        if self.fused_ce:
+            from ..ops.fused_ce import fused_softmax_cross_entropy
+            losses = fused_softmax_cross_entropy(
+                logits.reshape(-1, self.vocab_size),
+                labels.reshape(-1).astype(jnp.int32))
+            local_sum = jnp.sum(losses)
+            local_cnt = jnp.asarray(losses.size, jnp.float32)
+        else:
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            picked = jnp.take_along_axis(
+                logp, labels.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+            local_sum = -jnp.sum(picked)
+            local_cnt = jnp.asarray(picked.size, jnp.float32)
         total = jax.lax.psum(local_sum, (data_axis, seq_axis))
         count = jax.lax.psum(local_cnt, (data_axis, seq_axis))
         # scalar pmean over 'model': a no-op in value (every model shard
@@ -300,17 +312,20 @@ class ParallelTransformerLM:
 
     # -- train step -----------------------------------------------------------
     def compile_train_step(self, optimizer: optax.GradientTransformation,
-                           params, zero: bool = False):
+                           params, zero: bool = False, fsdp: bool = False):
         """Build (opt_state, jitted step): step(params, opt, tokens, labels)
         -> (params, opt, loss).  tokens/labels are (B, S) int32 sharded
         ``P('data', 'seq')``.  ``zero=True`` ZeRO-1-shards the optimizer
-        state over the data axis (same update math, mu/nu HBM / dp — see
-        ``train_step.build_train_step``)."""
+        state over the data axis (same update math, mu/nu HBM / dp);
+        ``fsdp=True`` goes further to ZeRO-3 — params AND moments live
+        data-axis-sharded at rest, gathered per step by GSPMD (see
+        ``train_step.build_train_step``; supersedes ``zero``)."""
         from .train_step import build_train_step
         data_axis, seq_axis, _ = self.axes
         return build_train_step(self.mesh, self._loss, self.param_specs(),
                                 P(data_axis, seq_axis), optimizer, params,
-                                zero_axis=data_axis if zero else None)
+                                zero_axis=data_axis if zero else None,
+                                fsdp_axis=data_axis if fsdp else None)
 
     def batch_sharding(self) -> NamedSharding:
         data_axis, seq_axis, _ = self.axes
