@@ -28,6 +28,8 @@ from typing import Any, List, Optional
 import jax
 import jax.numpy as jnp
 
+tmap = jax.tree_util.tree_map
+
 from .layers import (Dense, Embedding, LayerNormalization,
                      MultiHeadAttention, PositionalEmbedding,
                      TransformerBlock, _apply_activation, _project)
@@ -406,3 +408,122 @@ def generate(model, params, prompt, num_steps: int,
         [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1) \
         if num_steps > 1 else first[:, None]
     return jnp.concatenate([prompt, gen], axis=1)
+
+
+def beam_search(model, params, prompt, num_steps: int, num_beams: int = 4,
+                length_penalty: float = 0.0,
+                eos_id: Optional[int] = None,
+                pad_id: Optional[int] = None):
+    """Deterministic beam decoding: keep the ``num_beams`` highest
+    log-probability continuations of each prompt row.
+
+    prompt: (B, P) int tokens → ``(tokens (B, num_beams, P + num_steps),
+    scores (B, num_beams))``, beams sorted best-first.  Scores are summed
+    token log-probabilities; ``length_penalty`` alpha > 0 divides by
+    ``generated_length ** alpha`` before the final ranking (alpha = 0:
+    pure sum, favors short sequences when ``eos_id`` is set).
+
+    ``eos_id``: a beam that emits it is FINISHED — its score freezes, its
+    later slots fill with ``pad_id`` (default: the eos itself), and it
+    keeps competing against live beams at the frozen score.  The KV caches
+    ride at batch B·num_beams and are re-gathered to each step's surviving
+    parents, so memory is ``num_beams``× a greedy ``generate``.
+
+    Beam 0 with ``num_beams=1`` is exactly greedy ``generate`` (asserted
+    in tests); rolling-window caches are not supported here (beam
+    reordering and ring slots don't compose yet — use ``generate``).
+    """
+    _check_supported(model)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p_len = prompt.shape
+    k = int(num_beams)
+    if k < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if num_steps < 1:
+        raise ValueError(f"beam_search needs num_steps >= 1, got "
+                         f"{num_steps}")
+    if length_penalty < 0:
+        raise ValueError(f"length_penalty must be >= 0, got "
+                         f"{length_penalty}")
+    total = p_len + int(num_steps)
+    limit = _context_limit(model)
+    if limit is not None and total > limit:
+        raise ValueError(
+            f"prompt ({p_len}) + num_steps ({num_steps}) = {total} exceeds "
+            f"the model's positional-embedding range {limit}")
+    vocab = _vocab_size(model)
+    if eos_id is not None and vocab is not None \
+            and not 0 <= eos_id < vocab:
+        raise ValueError(f"eos_id {eos_id} outside the model's vocabulary "
+                         f"[0, {vocab})")
+    if pad_id is not None and eos_id is None:
+        raise ValueError("pad_id only means something with eos_id")
+    pad = jnp.int32(pad_id if pad_id is not None else (eos_id or 0))
+
+    # prefill once at batch B, then tile every cache to B·k rows laid out
+    # row-major (batch, beam) — beam j of row i lives at i·k + j
+    caches = init_cache(model, b, total)
+    logits, caches = _forward(model, params, caches, prompt, 0)
+    logp0 = jax.nn.log_softmax(logits[:, -1], axis=-1)        # (B, V)
+    v = logp0.shape[-1]
+    scores, first = jax.lax.top_k(logp0, k)                   # (B, k)
+    first = first.astype(jnp.int32)
+    caches = tmap(lambda c: jnp.repeat(c, k, axis=0), caches)
+    done = (first == eos_id) if eos_id is not None \
+        else jnp.zeros((b, k), bool)
+
+    # candidate row for a finished beam: only the pad column, at +0 — the
+    # beam's score freezes but it stays in the running
+    frozen = jnp.full((v,), -jnp.inf).at[pad].set(0.0)
+
+    def body(carry, i):
+        caches, scores, tok, done = carry
+        pos = p_len + i
+        logits, caches = decode_step(model, params, caches,
+                                     tok.reshape(b * k), pos)
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(b, k, v)
+        logp = jnp.where(done[..., None], frozen, logp)
+        cand = (scores[..., None] + logp).reshape(b, k * v)
+        scores, idx = jax.lax.top_k(cand, k)                  # (B, k)
+        parent = idx // v
+        nxt = (idx % v).astype(jnp.int32)
+        flat_parent = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+        caches = tmap(lambda c: jnp.take(c, flat_parent, axis=0), caches)
+        done = jnp.take_along_axis(done, parent, axis=1)
+        if eos_id is not None:
+            nxt = jnp.where(done, pad, nxt)
+            done = done | (nxt == eos_id)
+        return (caches, scores, nxt, done), (nxt, parent)
+
+    (caches, scores, last, done), (toks, parents) = jax.lax.scan(
+        body, (caches, scores, first, done),
+        jnp.arange(int(num_steps) - 1))
+
+    # reconstruct each surviving beam's token path by walking the parent
+    # pointers backward from the final beam order
+    steps = int(num_steps)
+    tokens = jnp.zeros((b, k, steps), jnp.int32)
+    beam = jnp.broadcast_to(jnp.arange(k), (b, k))            # final slots
+    for i in range(steps - 1, 0, -1):
+        tokens = tokens.at[:, :, i].set(
+            jnp.take_along_axis(toks[i - 1], beam, axis=1))
+        beam = jnp.take_along_axis(parents[i - 1], beam, axis=1)
+    tokens = tokens.at[:, :, 0].set(
+        jnp.take_along_axis(first, beam, axis=1))
+
+    if length_penalty > 0:
+        if eos_id is not None:
+            hit = tokens == eos_id
+            first_eos = jnp.argmax(hit, axis=-1)
+            lengths = jnp.where(hit.any(axis=-1), first_eos + 1, steps)
+        else:
+            lengths = jnp.full((b, k), steps)
+        ranked = scores / (lengths.astype(jnp.float32) ** length_penalty)
+    else:
+        ranked = scores
+    order = jnp.argsort(-ranked, axis=-1)
+    tokens = jnp.take_along_axis(tokens, order[..., None], axis=1)
+    ranked = jnp.take_along_axis(ranked, order, axis=1)
+    out = jnp.concatenate(
+        [jnp.broadcast_to(prompt[:, None], (b, k, p_len)), tokens], axis=2)
+    return out, ranked

@@ -114,8 +114,13 @@ class Sequential:
         return np.concatenate(outs, axis=0)
 
     def count_params(self, params) -> int:
+        from .quant import QuantizedTensor
+        # QuantizedTensor is one logical weight: count its .shape, not its
+        # (codes + scale) component leaves
         return sum(int(np.prod(p.shape))
-                   for p in jax.tree_util.tree_leaves(params))
+                   for p in jax.tree_util.tree_leaves(
+                       params,
+                       is_leaf=lambda x: isinstance(x, QuantizedTensor)))
 
     # -- (de)serialization ---------------------------------------------------
     def to_json(self) -> str:
@@ -177,6 +182,14 @@ class FittedModel:
     def count_params(self):
         return self.model.count_params(self.params)
 
+    def quantize(self) -> "FittedModel":
+        """Weight-only int8 post-training quantization for serving: matmul
+        kernels become (int8, per-channel scale) leaves that dequantize
+        inside the existing forward/decode code (``core.quant``); predict
+        and generate work unchanged at ~half the bf16 weight traffic."""
+        from .quant import quantize_params
+        return FittedModel(self.model, quantize_params(self.params))
+
     def generate(self, prompt, num_steps: int, temperature: float = 0.0,
                  rng=None, max_len=None, rolling: bool = False, **kw):
         """KV-cache autoregressive continuation (causal LMs only) — see
@@ -186,6 +199,15 @@ class FittedModel:
         return generate(self.model, self.params, prompt, num_steps,
                         temperature=temperature, rng=rng, max_len=max_len,
                         rolling=rolling, **kw)
+
+    def beam_search(self, prompt, num_steps: int, num_beams: int = 4, **kw):
+        """Deterministic top-``num_beams`` continuation search (causal LMs)
+        — see ``core.decode.beam_search`` (``**kw``: ``length_penalty``,
+        ``eos_id``, ``pad_id``).  Returns (tokens (B, beams, P+steps),
+        scores), best beam first."""
+        from .decode import beam_search
+        return beam_search(self.model, self.params, prompt, num_steps,
+                           num_beams=num_beams, **kw)
 
     def serialize(self) -> dict:
         return serialize_model(self.model, self.params)
@@ -225,6 +247,13 @@ def read_npz_blob(path: str) -> dict:
 def serialize_model(model: Sequential, params: Params) -> dict:
     """Parity with reference ``serialize_keras_model`` (utils.py):
     returns a picklable dict {'model': json_spec, 'weights': [ndarray...]}."""
+    from .quant import QuantizedTensor
+    if any(isinstance(l, QuantizedTensor) for l in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedTensor))):
+        raise ValueError(
+            "cannot serialize int8-quantized params (the npz/wire layout is "
+            "a flat full-precision weight list): save the unquantized model "
+            "and call .quantize() after load")
     return {"model": model.to_json(), "weights": model.get_weights(params)}
 
 

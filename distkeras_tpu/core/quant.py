@@ -1,0 +1,129 @@
+"""Weight-only int8 quantization for the inference/serving path.
+
+No reference counterpart (the reference serves full-precision Keras models;
+SURVEY.md §2.1 rows 18/23) — this exists because TPU decode is
+HBM-bandwidth-bound on *weight reads*: each generated token streams every
+matmul kernel out of HBM once, so storing them int8 with a per-output-
+channel f32 scale cuts that traffic (and the resident model footprint) 2×
+vs bf16 / 4× vs f32, while activations, biases, norms, and embeddings stay
+full precision (weight-only post-training quantization).
+
+The mechanism is a pytree leaf, not a model rewrite: ``QuantizedTensor``
+carries ``(int8 codes, f32 scale)`` and dequantizes inside ``astype`` —
+the one method every matmul site in ``core/layers.py`` / ``core/decode.py``
+already calls on its weight (``params["kernel"].astype(compute_dtype)``,
+``_project``'s ``kernel.astype``).  Under jit, XLA fuses the
+``codes.astype(f32) * scale`` dequant into the consuming matmul's operand
+stream, so nothing dequantized is ever materialized in HBM.  Quantized
+params therefore flow through the UNMODIFIED forward/decode code, jit,
+and checkpointing (the leaf flattens to its two arrays).
+
+Symmetric per-output-channel scheme: ``scale = max|w| / 127`` reduced over
+all but the last axis (the output-features axis of every (in, out) kernel
+and HWIO conv), ``codes = round(w / scale)``.  Training is untouched —
+quantize AFTER training via ``FittedModel.quantize()`` /
+``quantize_params``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Set
+
+import jax
+import jax.numpy as jnp
+
+#: matmul-kernel leaf names across Dense / Conv2D / MultiHeadAttention /
+#: TransformerBlock (layers.py) — biases, norms, and embedding tables are
+#: deliberately absent (tiny, or indexed rather than astype'd)
+QUANT_KEYS: Set[str] = {"kernel", "wq", "wk", "wv", "wo",
+                        "mlp_w1", "mlp_w2"}
+
+
+class QuantizedTensor:
+    """(int8 codes, f32 per-output-channel scale) posing as a weight array.
+
+    ``astype`` is the whole contract: it returns the dequantized array in
+    the requested dtype (f32 multiply first, then the cast — bf16-exact for
+    the magnitudes weights live at).  ``shape``/``ndim`` mirror the logical
+    array so shape-driven code keeps working.
+    """
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def dtype(self):  # the logical (dequantized) dtype
+        return jnp.float32
+
+    def astype(self, dtype):
+        return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+    def __repr__(self):
+        return f"QuantizedTensor(shape={tuple(self.shape)}, int8)"
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedTensor,
+    lambda t: ((t.q, t.scale), None),
+    lambda _, xs: QuantizedTensor(*xs))
+
+
+def quantize_tensor(w) -> QuantizedTensor:
+    """Symmetric per-output-channel int8: scale over all but the last axis."""
+    w = jnp.asarray(w, jnp.float32)
+    reduce_axes = tuple(range(w.ndim - 1))
+    amax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q, scale)
+
+
+def quantize_params(params: Any, keys: Set[str] = QUANT_KEYS) -> Any:
+    """Replace every >=2-D matmul-kernel leaf (matched by name) with a
+    ``QuantizedTensor``; everything else passes through untouched.  Works
+    on any nesting of dicts/lists (the Sequential params layout)."""
+
+    def walk(node):
+        if isinstance(node, QuantizedTensor):
+            return node  # idempotent: re-quantizing is a no-op
+        if isinstance(node, dict):
+            return {k: (quantize_tensor(v)
+                        if k in keys and getattr(v, "ndim", 0) >= 2
+                        and not isinstance(v, QuantizedTensor)
+                        else walk(v))
+                    for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        return node
+
+    return walk(params)
+
+
+def dequantize_params(params: Any) -> Any:
+    """Materialize every QuantizedTensor back to a plain f32 array (e.g.
+    to resume training from a quantized artifact, accepting the rounding)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.float32) if isinstance(x, QuantizedTensor)
+        else x,
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+
+
+def quantized_bytes(params: Any) -> int:
+    """On-device bytes of the weight leaves (int8 codes + scales for
+    quantized leaves, itemsize-true for the rest) — the footprint the
+    transform is buying down."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        total += leaf.size * jnp.dtype(leaf.dtype).itemsize
+    return total

@@ -51,6 +51,9 @@ def main():
     ap.add_argument("--epochs", type=int, default=40)
     ap.add_argument("--steps", type=int, default=48,
                     help="tokens to generate (3x the training length)")
+    ap.add_argument("--int8", action="store_true",
+                    help="serve from weight-only int8 quantized params "
+                         "(FittedModel.quantize(); decode code unchanged)")
     args = ap.parse_args()
 
     model = transformer_lm(
@@ -71,6 +74,10 @@ def main():
                            shuffle=True)
     print(f"trained {trainer.get_training_time():.1f}s "
           f"({len(jax.devices())} workers)")
+
+    if args.int8:
+        fitted = fitted.quantize()
+        print("serving int8 (weight-only, per-channel scales)")
 
     prompt = np.array([[2, 3, 4]], np.int32)
     out = np.asarray(fitted.generate(prompt, num_steps=args.steps,

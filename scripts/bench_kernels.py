@@ -109,15 +109,25 @@ def bench_decode(reps, quick, emit):
     from distkeras_tpu.core.decode import init_cache, jit_decode_step
     from distkeras_tpu.models.zoo import transformer_lm
 
+    from distkeras_tpu.core.quant import quantize_params
+
     batch = 8
-    cfgs = [("full", dict(), False), ("rolling_window", dict(
-        attention_window=256, positional="rope"), True)]
+    # int8 flavors measure the weight-only-quantization serving win (same
+    # jitted program; XLA fuses the dequant into each matmul's operand read)
+    cfgs = [("full", dict(), False, False),
+            ("full_int8", dict(), False, True),
+            ("rolling_window", dict(
+                attention_window=256, positional="rope"), True, False),
+            ("rolling_window_int8", dict(
+                attention_window=256, positional="rope"), True, True)]
     seq_len = 512 if quick else 2048
-    for name, extra, rolling in cfgs:
+    for name, extra, rolling, int8 in cfgs:
         model = transformer_lm(
             vocab_size=512, seq_len=seq_len, d_model=256, num_heads=8,
             num_layers=4, mlp_dim=1024, num_kv_heads=2, **extra)
         params = model.init(jax.random.PRNGKey(0))
+        if int8:
+            params = quantize_params(params)
         caches = init_cache(model, batch=batch,
                             max_len=extra.get("attention_window", seq_len)
                             if rolling else seq_len, rolling=rolling)
