@@ -21,8 +21,8 @@ from .trainers import (Trainer, SingleTrainer, AveragingTrainer,
                        SynchronousDistributedTrainer,
                        ADAG, DOWNPOUR, AEASGD, EAMSGD, DynSGD)
 from .predictors import Predictor, ModelPredictor
-from .evaluators import (Evaluator, AccuracyEvaluator, F1Evaluator,
-                         LossEvaluator, TopKAccuracyEvaluator)
+from .evaluators import (Evaluator, AccuracyEvaluator, AUCEvaluator,
+                         F1Evaluator, LossEvaluator, TopKAccuracyEvaluator)
 from . import utils
 from . import networking
 from . import workers

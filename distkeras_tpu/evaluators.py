@@ -144,3 +144,59 @@ class LossEvaluator(Evaluator):
         pred = jnp.asarray(dataset[self.prediction_col])
         label = jnp.asarray(dataset[self.label_col])
         return float(self.loss_fn(label, pred))
+
+
+class AUCEvaluator(Evaluator):
+    """Area under the ROC curve for binary tasks (extra over reference —
+    the Higgs workload upstream reports accuracy only, but AUC is the
+    standard metric for that dataset).
+
+    ``prediction`` column holds a positive-class score per row: either a
+    (N,) score/probability vector, a (N, 1) column, or (N, 2) class
+    probabilities (column 1 is used).  Labels are 0/1 (or one-hot).
+    Computed by the rank statistic (Mann-Whitney U), ties handled by
+    midranks — exact for any score distribution, O(N log N).
+    """
+
+    def __init__(self, prediction_col: str = "prediction",
+                 label_col: str = "label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataset: Dataset) -> float:
+        score = np.asarray(dataset[self.prediction_col], np.float64)
+        if score.ndim == 2 and score.shape[1] == 2:
+            score = score[:, 1]
+        score = score.reshape(-1)
+        label = _labels_1d(np.asarray(dataset[self.label_col]))
+        if score.shape[0] != label.shape[0]:
+            raise ValueError(
+                f"prediction/label length mismatch: {score.shape[0]} vs "
+                f"{label.shape[0]}")
+        classes = np.unique(label)
+        if not np.isin(classes, (0, 1)).all():
+            raise ValueError(
+                f"AUC is binary: labels must be 0/1, got classes {classes}")
+        pos = label == 1
+        n_pos = int(pos.sum())
+        n_neg = label.shape[0] - n_pos
+        if n_pos == 0 or n_neg == 0:
+            raise ValueError("AUC undefined: need both classes present")
+        # midranks (average rank within tied groups), vectorized: group
+        # starts where the sorted score changes; each element's midrank is
+        # the mean of its group's first and last 1-based positions
+        order = np.argsort(score, kind="mergesort")
+        sorted_scores = score[order]
+        n = len(sorted_scores)
+        new_group = np.empty(n, bool)
+        new_group[0] = True
+        np.not_equal(sorted_scores[1:], sorted_scores[:-1],
+                     out=new_group[1:])
+        starts = np.nonzero(new_group)[0]
+        ends = np.append(starts[1:], n) - 1
+        group_of = np.cumsum(new_group) - 1
+        midrank = 0.5 * (starts + ends) + 1.0
+        ranks = np.empty_like(score)
+        ranks[order] = midrank[group_of]
+        u = ranks[pos].sum() - n_pos * (n_pos + 1) / 2.0
+        return float(u / (n_pos * n_neg))

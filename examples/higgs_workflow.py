@@ -23,15 +23,18 @@ import jax
 from distkeras_tpu import (SingleTrainer, ADAG, DOWNPOUR, AEASGD, EAMSGD,
                            StandardScaleTransformer, OneHotTransformer,
                            ModelPredictor, LabelIndexTransformer,
-                           AccuracyEvaluator)
+                           AccuracyEvaluator, AUCEvaluator)
 from distkeras_tpu.data.datasets import load_atlas_higgs
 from distkeras_tpu.models.zoo import higgs_mlp
 
 
-def evaluate(fitted, test) -> float:
+def evaluate(fitted, test):
     predicted = ModelPredictor(fitted).predict(test)
+    # AUC from the class-probability column (the standard Higgs metric),
+    # accuracy from the argmax index
+    auc = AUCEvaluator().evaluate(predicted)
     predicted = LabelIndexTransformer().transform(predicted)
-    return AccuracyEvaluator().evaluate(predicted)
+    return AccuracyEvaluator().evaluate(predicted), auc
 
 
 def main():
@@ -69,11 +72,12 @@ def main():
                                           "worker_optimizer")})),
     ]
 
-    print(f"{'algorithm':<14} {'accuracy':>9} {'time (s)':>9}")
+    print(f"{'algorithm':<14} {'accuracy':>9} {'auc':>7} {'time (s)':>9}")
     for name, trainer in trainers:
         fitted = trainer.train(train, shuffle=True)
-        acc = evaluate(fitted, test)
-        print(f"{name:<14} {acc:>9.4f} {trainer.get_training_time():>9.2f}")
+        acc, auc = evaluate(fitted, test)
+        print(f"{name:<14} {acc:>9.4f} {auc:>7.4f} "
+              f"{trainer.get_training_time():>9.2f}")
 
 
 if __name__ == "__main__":

@@ -95,3 +95,40 @@ def test_topk_accuracy():
     assert TopKAccuracyEvaluator(k=10).evaluate(ds) == 1.0
     with pytest.raises(ValueError, match="k must be"):
         TopKAccuracyEvaluator(k=0)
+
+
+def test_auc_matches_sklearn():
+    sk = pytest.importorskip("sklearn.metrics")
+    from distkeras_tpu import AUCEvaluator
+    rng = np.random.default_rng(3)
+    label = rng.integers(0, 2, 400)
+    score = np.clip(label * 0.4 + rng.normal(0.3, 0.3, 400), 0, 1)
+    ds = Dataset({"prediction": score, "label": label})
+    got = AUCEvaluator().evaluate(ds)
+    want = sk.roc_auc_score(label, score)
+    np.testing.assert_allclose(got, want, atol=1e-12)
+    # ties: quantized scores exercise the midrank path
+    q = np.round(score * 4) / 4
+    np.testing.assert_allclose(
+        AUCEvaluator().evaluate(Dataset({"prediction": q, "label": label})),
+        sk.roc_auc_score(label, q), atol=1e-12)
+
+
+def test_auc_shapes_and_validation():
+    from distkeras_tpu import AUCEvaluator
+    label = np.array([0, 1, 0, 1])
+    # (N, 2) class probabilities: column 1 is the positive score
+    two_col = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4], [0.3, 0.7]])
+    perfect = AUCEvaluator().evaluate(
+        Dataset({"prediction": two_col, "label": label}))
+    assert perfect == 1.0
+    # one-hot labels collapse through _labels_1d
+    onehot = np.eye(2)[label]
+    assert AUCEvaluator().evaluate(
+        Dataset({"prediction": two_col, "label": onehot})) == 1.0
+    with pytest.raises(ValueError, match="binary"):
+        AUCEvaluator().evaluate(
+            Dataset({"prediction": np.ones(3), "label": np.array([0, 1, 2])}))
+    with pytest.raises(ValueError, match="both classes"):
+        AUCEvaluator().evaluate(
+            Dataset({"prediction": np.ones(3), "label": np.ones(3)}))
