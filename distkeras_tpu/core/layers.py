@@ -582,8 +582,12 @@ class MultiHeadAttention(Layer):
                           bo=jnp.zeros((d,), jnp.float32))
         return params, tuple(in_shape)
 
+    #: Sequential.apply threads a packed batch's segment ids to layers
+    #: that declare this (see data/packing.py)
+    takes_segment_ids = True
+
     def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
-              rng=None):
+              rng=None, segment_ids=None):
         from ..ops.attention import attention
         b, s, _ = x.shape
         dh = self.key_dim
@@ -602,7 +606,8 @@ class MultiHeadAttention(Layer):
             q, k = apply_rope(q, pos), apply_rope(k, pos)
         out = attention(q, k, v,
                         causal=self.causal, impl=self.attention_impl,
-                        window=self.attention_window)
+                        window=self.attention_window,
+                        segment_ids=segment_ids)
         out = out.reshape(b, s, self.num_heads * dh)
         bias_o = params.get("bo") if self.use_bias else None
         return _project(out, params["wo"], bias_o, compute_dtype)
@@ -668,15 +673,18 @@ class TransformerBlock(Layer):
         }
         return params, tuple(in_shape)
 
+    takes_segment_ids = True
+
     def apply(self, params, x, *, compute_dtype=jnp.bfloat16, train=False,
-              rng=None):
+              rng=None, segment_ids=None):
         ln = LayerNormalization()
         drop_rngs = (jax.random.split(rng, 2) if rng is not None else
                      (None, None))
 
         h = ln.apply(params["ln1"], x, compute_dtype=compute_dtype)
         h = self._mha().apply(params["attn"], h, compute_dtype=compute_dtype,
-                              train=train, rng=None)
+                              train=train, rng=None,
+                              segment_ids=segment_ids)
         x = x + _dropout(drop_rngs[0], self.dropout, h.astype(x.dtype), train)
 
         h = ln.apply(params["ln2"], x, compute_dtype=compute_dtype)

@@ -36,6 +36,24 @@ def sparse_categorical_crossentropy(y_true, y_pred, from_logits: bool = False):
     return -jnp.mean(picked)
 
 
+def masked_sparse_categorical_crossentropy(y_true, y_pred,
+                                           from_logits: bool = False):
+    """Sparse CE that skips label < 0 (the sequence-packing convention:
+    ``data/packing.py :: packed_lm_labels`` marks cross-document and
+    padding positions -1).  Mean over the VALID positions only."""
+    y_pred = y_pred.astype(jnp.float32)
+    if from_logits:
+        logp = jax.nn.log_softmax(y_pred, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(y_pred, _EPS, 1.0))
+    idx = y_true.astype(jnp.int32)
+    valid = idx >= 0
+    picked = jnp.take_along_axis(
+        logp, jnp.maximum(idx, 0)[..., None], axis=-1)[..., 0]
+    count = jnp.maximum(jnp.sum(valid), 1)
+    return -jnp.sum(jnp.where(valid, picked, 0.0)) / count
+
+
 def binary_crossentropy(y_true, y_pred, from_logits: bool = False):
     y_true = y_true.astype(jnp.float32)
     y_pred = y_pred.astype(jnp.float32)
@@ -71,6 +89,10 @@ _LOSSES = {
         _from_logits(categorical_crossentropy),
     "sparse_categorical_crossentropy_from_logits":
         _from_logits(sparse_categorical_crossentropy),
+    "sparse_categorical_crossentropy_masked":
+        masked_sparse_categorical_crossentropy,
+    "sparse_categorical_crossentropy_masked_from_logits":
+        _from_logits(masked_sparse_categorical_crossentropy),
     "binary_crossentropy_from_logits": _from_logits(binary_crossentropy),
     "mean_squared_error": mean_squared_error,
     "mse": mean_squared_error,

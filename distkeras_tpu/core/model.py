@@ -62,19 +62,39 @@ class Sequential:
         return params
 
     def apply(self, params: Params, x, *, train: bool = False, rng=None,
-              stats_out: Optional[dict] = None):
+              stats_out: Optional[dict] = None, segment_ids=None):
         """Pure forward pass. Safe to jit / grad / vmap / shard_map.
 
         ``stats_out``: optional dict filled (at trace time) with
         ``{layer_index: new_stats}`` for stat-carrying layers (BatchNorm) when
         ``train=True`` — the train step merges these back into params via
         ``merge_stats`` after the optimizer update.
+
+        ``segment_ids`` (B, S): sequence-packing isolation — forwarded to
+        every attention-bearing layer (``takes_segment_ids``) so packed
+        documents attend only within themselves (``data/packing.py``).
+        Requires relative positions: an absolute additive table
+        (``PositionalEmbedding``) would hand a mid-row document shifted
+        position vectors — silently different training than unpacked —
+        so that combination is refused.
         """
+        if segment_ids is not None:
+            from .layers import PositionalEmbedding
+            if any(isinstance(l, PositionalEmbedding) for l in self.layers):
+                raise ValueError(
+                    "sequence packing (segment_ids) requires relative "
+                    "positions: this model has an absolute "
+                    "PositionalEmbedding table, which would give packed "
+                    "documents position-shifted embeddings — build the "
+                    "model with positional='rope'")
         cdtype = self._cdtype
         for i, layer in enumerate(self.layers):
             sub = None
             if rng is not None:
                 rng, sub = jax.random.split(rng)
+            kw = ({"segment_ids": segment_ids}
+                  if segment_ids is not None
+                  and getattr(layer, "takes_segment_ids", False) else {})
             if (train and stats_out is not None
                     and hasattr(layer, "apply_with_stats")):
                 x, new_stats = layer.apply_with_stats(
@@ -82,7 +102,7 @@ class Sequential:
                 stats_out[i] = new_stats
             else:
                 x = layer.apply(params[i], x, compute_dtype=cdtype,
-                                train=train, rng=sub)
+                                train=train, rng=sub, **kw)
         return x
 
     @staticmethod

@@ -5,6 +5,7 @@ from .transformers import (Transformer, MinMaxTransformer,
                            LabelIndexTransformer, LabelVectorTransformerUDF)
 from .datasets import load_mnist, load_cifar10, load_atlas_higgs, read_csv
 from .pipeline import round_stream, prefetch_to_device
+from .packing import pack_documents, packed_lm_labels, packing_efficiency
 
 __all__ = [
     "Dataset", "Transformer", "MinMaxTransformer", "StandardScaleTransformer",
@@ -12,4 +13,5 @@ __all__ = [
     "LabelIndexTransformer", "LabelVectorTransformerUDF",
     "load_mnist", "load_cifar10", "load_atlas_higgs", "read_csv",
     "round_stream", "prefetch_to_device",
+    "pack_documents", "packed_lm_labels", "packing_efficiency",
 ]

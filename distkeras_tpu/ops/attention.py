@@ -40,7 +40,7 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
                           scale: Optional[float] = None,
                           q_offset=None, kv_length=None,
                           window: Optional[int] = None,
-                          kv_positions=None):
+                          kv_positions=None, segment_ids=None):
     """Softmax(q·kᵀ)·v with f32 softmax arithmetic.
 
     q: (B, Sq, H, Dh); k, v: (B, Sk, Hkv, Dh) → (B, Sq, H, Dh), in q.dtype.
@@ -66,6 +66,14 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     caches, where slot order ≠ position order — negative = empty slot),
     overriding the identity slot→position layout that ``causal``/
     ``kv_length`` otherwise assume.  All accept tracers.
+
+    ``segment_ids`` (B, S) int: sequence-packing isolation — query and key
+    attend only within equal segment ids (on top of causal/window), so
+    several documents packed into one row never see each other.  Id 0 is
+    the padding convention (``data/packing.py``); padded slots still see
+    themselves under ``causal``, so no softmax row is ever empty.  With
+    RoPE (relative positions) each packed document attends exactly as it
+    would unpacked.  Self-attention only (Sq == Sk).
     """
     *_, d = q.shape
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
@@ -78,6 +86,10 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
         raise ValueError("kv_positions (rolling-cache slot positions) "
                          "requires causal=True — its empty-slot masking "
                          "lives in the causal mask")
+    if segment_ids is not None and k.shape[1] != sq:
+        raise ValueError("segment_ids (sequence packing) is a "
+                         "self-attention feature: Sq must equal Sk, got "
+                         f"{sq} vs {k.shape[1]}")
     g = h // hkv
     qg = q.reshape(b, sq, hkv, g, d)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
@@ -95,24 +107,36 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     if kv_length is not None:
         scores = jnp.where((k_pos < kv_length)[None, None, None, None],
                            scores, NEG_INF)
+    if segment_ids is not None:
+        seg = jnp.asarray(segment_ids)
+        cross = seg[:, :, None] != seg[:, None, :]        # (B, Sq, Sk)
+        scores = jnp.where(cross[:, None, None], NEG_INF, scores)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v)
     return out.reshape(b, sq, h, d)
 
 
 def attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,
-              impl: Optional[str] = None, window: Optional[int] = None):
+              impl: Optional[str] = None, window: Optional[int] = None,
+              segment_ids=None):
     """Dispatching entry point used by the MultiHeadAttention layer."""
     # validate before the window>=S normalization below, so the error
     # doesn't depend on the window size
     window = validate_window(window, causal)
     if window is not None and window >= k.shape[1]:
         window = None  # covers every key: mathematically plain causal
+    if segment_ids is not None and impl == "pallas":
+        # packing isolation is mask-level — the flash kernel has no
+        # segment support, so packed batches take the XLA path
+        raise ValueError("segment_ids (sequence packing) is not "
+                         "supported by the pallas flash kernel — use "
+                         "impl='xla' (or leave impl unset)")
     if impl is None:
-        impl = "pallas" if _pallas_eligible(q, k) else "xla"
+        impl = ("pallas" if segment_ids is None and _pallas_eligible(q, k)
+                else "xla")
     if impl == "xla":
         return dot_product_attention(q, k, v, causal=causal, scale=scale,
-                                     window=window)
+                                     window=window, segment_ids=segment_ids)
     if impl == "pallas":
         from .flash_attention import flash_attention
         if k.shape[2] != q.shape[2]:
