@@ -138,8 +138,8 @@ def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype,
         # by their own positions (RoPE scores depend only on distance)
         from ..ops.rope import apply_rope
         positions = pos + jnp.arange(length)
-        q = apply_rope(q, positions)
-        k_t = apply_rope(k_t, positions)
+        q = apply_rope(q, positions, mha.rope_theta, mha.rope_scale)
+        k_t = apply_rope(k_t, positions, mha.rope_theta, mha.rope_scale)
     if rolling:
         # ring buffer of the block's window: slot p % W holds position p.
         # Single-token writes only — generate() prefills with a full cache

@@ -535,12 +535,15 @@ class MultiHeadAttention(Layer):
     num_kv_heads: Optional[int] = None  # None = same as num_heads
     attention_window: Optional[int] = None  # None = full causal context
     rope: bool = False  # rotary position embeddings on q/k
+    rope_theta: float = 10000.0  # RoPE base (raise via ntk_theta to extend)
+    rope_scale: float = 1.0      # linear position-interpolation factor
 
     def __init__(self, num_heads: int, key_dim: int, causal: bool = False,
                  use_bias: bool = True, attention_impl: Optional[str] = None,
                  num_kv_heads: Optional[int] = None,
                  attention_window: Optional[int] = None,
-                 rope: bool = False):
+                 rope: bool = False, rope_theta: float = 10000.0,
+                 rope_scale: float = 1.0):
         self.num_heads = int(num_heads)
         self.key_dim = int(key_dim)  # per-head dim
         self.causal = bool(causal)
@@ -559,6 +562,10 @@ class MultiHeadAttention(Layer):
             from ..ops.rope import validate_rope_dim
             validate_rope_dim(self.key_dim)
             self.rope = True
+        if rope_theta != 10000.0 or rope_scale != 1.0:
+            from ..ops.rope import validate_rope_scaling
+            self.rope_theta, self.rope_scale = validate_rope_scaling(
+                rope_theta, rope_scale)
 
     def _kv_heads(self) -> int:
         return (self.num_kv_heads if self.num_kv_heads is not None
@@ -603,7 +610,8 @@ class MultiHeadAttention(Layer):
         if self.rope:
             from ..ops.rope import apply_rope
             pos = jnp.arange(s)
-            q, k = apply_rope(q, pos), apply_rope(k, pos)
+            q = apply_rope(q, pos, self.rope_theta, self.rope_scale)
+            k = apply_rope(k, pos, self.rope_theta, self.rope_scale)
         out = attention(q, k, v,
                         causal=self.causal, impl=self.attention_impl,
                         window=self.attention_window,
@@ -624,6 +632,8 @@ class TransformerBlock(Layer):
     num_kv_heads: Optional[int] = None
     attention_window: Optional[int] = None
     rope: bool = False
+    rope_theta: float = 10000.0
+    rope_scale: float = 1.0
 
     def __init__(self, num_heads: int, key_dim: int, mlp_dim: int,
                  dropout: float = 0.0, causal: bool = False,
@@ -631,7 +641,8 @@ class TransformerBlock(Layer):
                  attention_impl: Optional[str] = None,
                  num_kv_heads: Optional[int] = None,
                  attention_window: Optional[int] = None,
-                 rope: bool = False):
+                 rope: bool = False, rope_theta: float = 10000.0,
+                 rope_scale: float = 1.0):
         self.num_heads = int(num_heads)
         self.key_dim = int(key_dim)
         self.mlp_dim = int(mlp_dim)
@@ -648,6 +659,10 @@ class TransformerBlock(Layer):
             from ..ops.rope import validate_rope_dim
             validate_rope_dim(self.key_dim)  # eager, like MultiHeadAttention
             self.rope = True
+        if rope_theta != 10000.0 or rope_scale != 1.0:
+            from ..ops.rope import validate_rope_scaling
+            self.rope_theta, self.rope_scale = validate_rope_scaling(
+                rope_theta, rope_scale)
 
     def _mha(self) -> MultiHeadAttention:
         return MultiHeadAttention(self.num_heads, self.key_dim,
@@ -655,7 +670,9 @@ class TransformerBlock(Layer):
                                   attention_impl=self.attention_impl,
                                   num_kv_heads=self.num_kv_heads,
                                   attention_window=self.attention_window,
-                                  rope=self.rope)
+                                  rope=self.rope,
+                                  rope_theta=self.rope_theta,
+                                  rope_scale=self.rope_scale)
 
     def init(self, rng, in_shape):
         s, d = in_shape

@@ -83,7 +83,9 @@ def transformer_lm(vocab_size: int = 256, seq_len: int = 128,
                    dropout: float = 0.0, compute_dtype: str = "bfloat16",
                    attention_impl=None, num_kv_heads=None,
                    attention_window=None,
-                   positional: str = "learned") -> Sequential:
+                   positional: str = "learned",
+                   rope_theta: float = 10000.0,
+                   rope_scale: float = 1.0) -> Sequential:
     """Decoder-only causal transformer LM — the long-context flagship.
 
     No reference counterpart (SURVEY.md §2.3: attention/sequence models are
@@ -104,7 +106,7 @@ def transformer_lm(vocab_size: int = 256, seq_len: int = 128,
             num_heads, d_model // num_heads, mlp_dim, dropout=dropout,
             causal=True, attention_impl=attention_impl,
             num_kv_heads=num_kv_heads, attention_window=attention_window,
-            rope=rope))
+            rope=rope, rope_theta=rope_theta, rope_scale=rope_scale))
     layers += [LayerNormalization(), Dense(vocab_size)]
     return Sequential(layers, input_shape=(seq_len,),
                       compute_dtype=compute_dtype, name="transformer_lm")

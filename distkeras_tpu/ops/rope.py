@@ -26,21 +26,46 @@ def validate_rope_dim(dim: int) -> int:
     return int(dim)
 
 
-def rope_angles(positions, dim: int, theta: float = 10000.0):
-    """(S,) integer positions → (S, dim/2) rotation angles."""
+def rope_angles(positions, dim: int, theta: float = 10000.0,
+                scale: float = 1.0):
+    """(S,) integer positions → (S, dim/2) rotation angles.
+
+    ``scale`` > 1 is LINEAR position-interpolation context extension
+    (Chen et al. 2023): positions are divided by ``scale``, squeezing a
+    ``scale``× longer context into the rotation range the model trained
+    on.  For the NTK-aware variant keep ``scale`` at 1 and raise ``theta``
+    via ``ntk_theta``."""
     validate_rope_dim(dim)
     freqs = theta ** (-jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
-    return positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    pos = positions.astype(jnp.float32) / scale
+    return pos[:, None] * freqs[None, :]
 
 
-def apply_rope(x, positions, theta: float = 10000.0):
+def ntk_theta(factor: float, dim: int, theta: float = 10000.0) -> float:
+    """NTK-aware context extension: the base-``theta`` adjustment
+    ``theta · factor^(dim / (dim - 2))`` that stretches the low-frequency
+    channels by ~``factor`` while leaving the high-frequency (local
+    order) channels nearly untouched — extends context without the
+    high-frequency aliasing plain linear interpolation causes.  Pass the
+    result as ``rope_theta`` (training-free extension by ~``factor``×)."""
+    validate_rope_dim(dim)
+    if dim <= 2:
+        raise ValueError(f"ntk_theta needs head dim > 2 (the exponent is "
+                         f"dim/(dim-2)), got {dim}")
+    if factor < 1.0:
+        raise ValueError(f"extension factor must be >= 1, got {factor}")
+    return float(theta * factor ** (dim / (dim - 2)))
+
+
+def apply_rope(x, positions, theta: float = 10000.0, scale: float = 1.0):
     """Rotate (B, S, H, D) q or k by per-position angles.
 
     ``positions``: (S,) absolute token positions — pass the true offsets
-    when decoding a suffix against a cache.
+    when decoding a suffix against a cache.  ``theta``/``scale``: see
+    ``rope_angles`` (context-extension knobs; defaults = classic RoPE).
     """
     b, s, h, d = x.shape
-    ang = rope_angles(positions, d, theta)            # (S, d/2)
+    ang = rope_angles(positions, d, theta, scale)     # (S, d/2)
     cos = jnp.cos(ang)[None, :, None, :]
     sin = jnp.sin(ang)[None, :, None, :]
     x32 = x.astype(jnp.float32)
@@ -48,3 +73,11 @@ def apply_rope(x, positions, theta: float = 10000.0):
     out = jnp.stack([x1 * cos - x2 * sin,
                      x1 * sin + x2 * cos], axis=-1).reshape(b, s, h, d)
     return out.astype(x.dtype)
+
+
+def validate_rope_scaling(theta: float, scale: float):
+    """The single rope_theta/rope_scale rule, shared by every constructor
+    that exposes the context-extension knobs."""
+    if scale < 1.0:
+        raise ValueError(f"rope_scale must be >= 1, got {scale}")
+    return float(theta), float(scale)

@@ -74,7 +74,9 @@ def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
                       num_local_kv_heads: Optional[int] = None,
                       window: Optional[int] = None,
                       rope_positions=None,
-                      sp_impl: str = "ring"):
+                      sp_impl: str = "ring",
+                      rope_theta: float = 10000.0,
+                      rope_scale: float = 1.0):
     """Head-parallel self-attention: each model-axis shard owns
     ``num_local_heads`` heads end to end (qkv column-split by head, local
     attention, output row-split) — one psum per block.  With ``seq_axis``
@@ -92,6 +94,9 @@ def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
     rows — when set, q/k are RoPE-rotated before attention; rotation is
     per-position, so it is valid under the ring too (k blocks arrive
     already rotated by their own global positions).
+    ``rope_theta``/``rope_scale``: the context-extension knobs
+    (``ops.rope``) — must match the values the checkpoint was trained
+    with, as on the Sequential/decode paths.
 
     ``sp_impl``: which sequence-parallel schedule carries the attend when
     ``seq_axis`` is set — ``"ring"`` (k/v rotation, overlapped, no head
@@ -115,8 +120,8 @@ def tp_self_attention(x, wq, wk, wv, wo, *, num_local_heads: int,
     q, k, v = proj(wq, h), proj(wk, hkv), proj(wv, hkv)
     if rope_positions is not None:
         from ..ops.rope import apply_rope
-        q = apply_rope(q, rope_positions)
-        k = apply_rope(k, rope_positions)
+        q = apply_rope(q, rope_positions, rope_theta, rope_scale)
+        k = apply_rope(k, rope_positions, rope_theta, rope_scale)
     if seq_axis is not None and sp_impl == "ulysses":
         out = ulysses_attention(q, k, v, seq_axis, causal=causal,
                                 window=window)

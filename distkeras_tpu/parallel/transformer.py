@@ -51,6 +51,7 @@ class ParallelTransformerLM:
                  num_kv_heads: Optional[int] = None,
                  attention_window: Optional[int] = None,
                  positional: str = "learned",
+                 rope_theta: float = 10000.0, rope_scale: float = 1.0,
                  data_axis: str = "data", seq_axis: str = "seq",
                  model_axis: str = "model"):
         self.vocab_size = vocab_size
@@ -111,8 +112,13 @@ class ParallelTransformerLM:
                              f"got {positional!r}")
         self.positional = positional
         if positional == "rope":
-            from ..ops.rope import validate_rope_dim
+            from ..ops.rope import validate_rope_dim, validate_rope_scaling
             validate_rope_dim(d_model // num_heads)
+            self.rope_theta, self.rope_scale = validate_rope_scaling(
+                rope_theta, rope_scale)
+        else:
+            self.rope_theta, self.rope_scale = float(rope_theta), float(
+                rope_scale)
         if mlp_dim % self.tp:
             raise ValueError(f"mlp_dim {mlp_dim} % tp {self.tp} != 0")
         if seq_len % self.sp:
@@ -240,7 +246,9 @@ class ParallelTransformerLM:
                     ring_block_k=self.ring_block_k,
                     num_local_kv_heads=self.num_kv_heads // self.tp,
                     window=self.attention_window,
-                    rope_positions=rope_pos, sp_impl=self.sp_impl)
+                    rope_positions=rope_pos, sp_impl=self.sp_impl,
+                    rope_theta=self.rope_theta,
+                    rope_scale=self.rope_scale)
                 x = x + attn.astype(cdt)
                 h = ln(lp["ln2"], x)
                 stats = None

@@ -207,3 +207,15 @@ def test_host_ps_schedule_and_accumulation_converge(eight_devices):
              execution="host_ps")
     fitted = t.train(ds)
     assert eval_accuracy(fitted, ds) > 0.9
+
+
+def test_lion_optimizer_resolves_and_steps():
+    import jax.numpy as jnp
+    from distkeras_tpu.core.optimizers import get_optimizer
+    tx = get_optimizer("lion").to_optax()
+    params = {"w": jnp.ones((4,))}
+    state = tx.init(params)
+    updates, state = tx.update({"w": jnp.full((4,), 0.5)}, state, params)
+    # lion: sign-based updates scaled by lr (1e-4 default)
+    np.testing.assert_allclose(np.asarray(updates["w"]),
+                               -1e-4 * np.ones(4), rtol=1e-5)
