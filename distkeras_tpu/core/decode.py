@@ -410,6 +410,129 @@ def generate(model, params, prompt, num_steps: int,
     return jnp.concatenate([prompt, gen], axis=1)
 
 
+def speculative_generate(model, params, draft_model, draft_params, prompt,
+                         num_steps: int, draft_len: int = 4,
+                         max_len: Optional[int] = None,
+                         return_stats: bool = False):
+    """Greedy decoding accelerated by a cheaper draft model — greedy-exact:
+    every committed token is the TARGET's own argmax, whatever the draft
+    proposes.  (The argmax comes from the batched verify forward; it can
+    differ from single-token ``generate`` only where two logits tie to
+    within the fusion-order rounding between an L-token and a 1-token
+    program — measure-zero for trained models, asserted bit-identical
+    across this suite's CI models and drafts.)
+
+    Each round the draft greedily proposes ``draft_len`` tokens one at a
+    time; the target then scores ALL of them in ONE batched forward (the
+    MXU-shaped win: k positions per target call instead of 1) and commits
+    the longest prefix that matches its own argmax plus one bonus token
+    from the mismatch position.  A good draft commits ``draft_len + 1``
+    tokens per target call; a useless draft still commits 1, so the method
+    never produces different tokens, only different wall-clock.
+
+    No cache rollback is needed on rejection: rejected positions hold
+    stale k/v, but every attention in this walker masks slots ``>=
+    kv_length``, and the next round overwrites them before they can be
+    unmasked.  Batched prompts commit the MINIMUM accepted length across
+    rows (every committed token is the target's own argmax for every row,
+    so exactness holds row-wise).
+
+    Both models must share the vocabulary.  Greedy only (temperature
+    sampling needs the rejection-sampling correction — not implemented);
+    ``eos_id`` stopping is not supported here, use ``generate``.
+    ``return_stats=True`` additionally returns
+    ``{"target_calls", "drafted", "accepted"}``.
+    """
+    _check_supported(model)
+    _check_supported(draft_model)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p_len = prompt.shape
+    if num_steps < 1:
+        raise ValueError(f"speculative_generate needs num_steps >= 1, got "
+                         f"{num_steps}")
+    if draft_len < 1:
+        raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+    tv, dv = _vocab_size(model), _vocab_size(draft_model)
+    if tv is not None and dv is not None and tv != dv:
+        raise ValueError(f"target and draft vocabularies differ: {tv} vs "
+                         f"{dv} — argmax agreement would be meaningless")
+    total = p_len + int(num_steps)
+    if max_len is None:
+        max_len = total
+    if max_len < total:
+        raise ValueError(f"max_len {max_len} < prompt+steps {total}")
+    for name, m in (("target", model), ("draft", draft_model)):
+        limit = _context_limit(m)
+        if limit is not None and total > limit:
+            raise ValueError(
+                f"prompt + num_steps = {total} exceeds the {name} model's "
+                f"positional-embedding range {limit}")
+
+    t_caches = init_cache(model, b, max_len)
+    d_caches = init_cache(draft_model, b, max_len)
+    logits, t_caches = _forward(model, params, t_caches, prompt, 0)
+    _, d_caches = _forward(draft_model, draft_params, d_caches, prompt, 0)
+    cur = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)  # (B,)
+
+    # model closes over (it shapes the program); params stay a traced arg
+    verify = jax.jit(lambda p, caches, toks, pos: _forward(
+        model, p, caches, toks, pos))
+    d_step = jit_decode_step(draft_model)
+
+    out = [cur]
+    pos = p_len - 1  # cur continues from here; its cache slot is pos + 1
+    stats = {"target_calls": 0, "drafted": 0, "accepted": 0}
+    while len(out) < num_steps:
+        # k drafted tokens commit at most k + 1 outputs, and the verify
+        # writes k + 1 cache slots starting at pos + 1
+        k = min(int(draft_len), num_steps - len(out) - 1,
+                max_len - (pos + 1) - 1)
+        k = max(k, 0)
+        # draft k tokens greedily from cur
+        d_toks = []
+        tok = cur
+        for i in range(k):
+            dl, d_caches = d_step(draft_params, d_caches, tok, pos + 1 + i)
+            tok = jnp.argmax(dl, axis=-1).astype(jnp.int32)
+            d_toks.append(tok)
+        # one target forward over [cur, d_1 .. d_k] (L = k + 1): logits[i]
+        # scores the token FOLLOWING fed[i], so a fully-accepted round
+        # still has a bonus logit at index k
+        fed = jnp.stack([cur] + d_toks, axis=1)               # (B, k + 1)
+        logits, t_caches = verify(params, t_caches, fed, pos + 1)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
+        stats["target_calls"] += 1
+        stats["drafted"] += k
+        if k == 0:
+            out.append(greedy[:, 0])
+            cur = out[-1]
+            pos += 1
+            continue
+        drafted = jnp.stack(d_toks, axis=1)                   # (B, k)
+        match = drafted == greedy[:, :k]                      # (B, k)
+        # per-row accepted prefix length; commit the batch minimum
+        prefix = jnp.cumprod(match.astype(jnp.int32), axis=1)
+        a = int(jnp.min(jnp.sum(prefix, axis=1)))
+        for i in range(a):
+            out.append(greedy[:, i])          # == accepted draft tokens
+        out.append(greedy[:, a])              # bonus / correction token
+        stats["accepted"] += a
+        cur = out[-1]
+        pos += a + 1
+        if a == k and len(out) < num_steps:
+            # fully-accepted round: d_k was committed (position pos, the
+            # new continuation point) but never FED to the draft, so its
+            # draft-cache slot would stay a zero hole inside every later
+            # step's attended range, quietly eroding draft quality.  One
+            # catch-up step writes it (logits discarded).
+            _, d_caches = d_step(draft_params, d_caches, drafted[:, -1],
+                                 pos)
+
+    gen = jnp.stack(out[:num_steps], axis=1)
+    result = jnp.concatenate([prompt, gen], axis=1)
+    return (result, stats) if return_stats else result
+
+
 def beam_search(model, params, prompt, num_steps: int, num_beams: int = 4,
                 length_penalty: float = 0.0,
                 eos_id: Optional[int] = None,

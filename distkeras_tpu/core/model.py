@@ -220,6 +220,17 @@ class FittedModel:
                         temperature=temperature, rng=rng, max_len=max_len,
                         rolling=rolling, **kw)
 
+    def speculative_generate(self, draft: "FittedModel", prompt,
+                             num_steps: int, draft_len: int = 4, **kw):
+        """Greedy decoding accelerated by a cheaper ``draft`` model —
+        bit-identical to ``generate`` (see
+        ``core.decode.speculative_generate``; ``**kw``: ``max_len``,
+        ``return_stats``)."""
+        from .decode import speculative_generate
+        return speculative_generate(self.model, self.params, draft.model,
+                                    draft.params, prompt, num_steps,
+                                    draft_len=draft_len, **kw)
+
     def beam_search(self, prompt, num_steps: int, num_beams: int = 4, **kw):
         """Deterministic top-``num_beams`` continuation search (causal LMs)
         — see ``core.decode.beam_search`` (``**kw``: ``length_penalty``,

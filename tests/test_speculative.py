@@ -1,0 +1,138 @@
+"""Speculative decoding (core/decode.py :: speculative_generate).
+
+The contract is EXACTNESS: whatever the draft proposes, the output equals
+plain greedy ``generate`` on the target model, bit for bit.  A good draft
+only changes how many target forwards that takes (asserted via stats).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.core.decode import generate, speculative_generate
+from distkeras_tpu.models.zoo import transformer_lm
+
+
+def make_lm(layers=2, seed=0, vocab=16, seq_len=32):
+    model = transformer_lm(vocab_size=vocab, seq_len=seq_len, d_model=32,
+                           num_heads=4, num_layers=layers, mlp_dim=64,
+                           compute_dtype="float32")
+    return model, model.init(jax.random.PRNGKey(seed))
+
+
+PROMPT = np.array([[3, 4, 5], [9, 2, 7]], np.int32)
+
+
+def test_exact_with_random_draft():
+    """An UNTRAINED draft (near-zero accept rate) still yields exactly the
+    greedy output."""
+    model, params = make_lm(seed=0)
+    draft, dparams = make_lm(layers=1, seed=99)
+    want = np.asarray(generate(model, params, PROMPT, 10))
+    got, stats = speculative_generate(model, params, draft, dparams,
+                                      PROMPT, 10, draft_len=3,
+                                      return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["drafted"] > 0
+
+
+def test_exact_with_self_draft_and_fewer_calls():
+    """Draft == target: output identical and most proposals accepted, so
+    target forwards collapse well below one-per-token.  (Acceptance is
+    high, not total: the draft steps single-token while the verify runs
+    batched, and on an UNTRAINED model near-tie logits can argmax apart
+    under the two fusion orders — exactness never depends on acceptance.)
+    """
+    model, params = make_lm(seed=1)
+    want = np.asarray(generate(model, params, PROMPT, 12))
+    got, stats = speculative_generate(model, params, model, params,
+                                      PROMPT, 12, draft_len=3,
+                                      return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["accepted"] >= stats["drafted"] // 2
+    assert stats["target_calls"] < 12
+
+
+@pytest.mark.parametrize("steps,k", [(1, 4), (5, 1), (7, 16)])
+def test_exact_across_step_and_draft_lengths(steps, k):
+    model, params = make_lm(seed=2)
+    draft, dparams = make_lm(layers=1, seed=3)
+    want = np.asarray(generate(model, params, PROMPT, steps))
+    got = np.asarray(speculative_generate(model, params, draft, dparams,
+                                          PROMPT, steps, draft_len=k))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_trained_draft_accepts_most():
+    """A draft trained on the same x+1 task accepts nearly everything."""
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.trainers import SingleTrainer
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 16, (256, 12)).astype(np.int32)
+    y = (x + 1) % 16
+
+    def train(layers):
+        model = transformer_lm(vocab_size=16, seq_len=24, d_model=32,
+                               num_heads=4, num_layers=layers, mlp_dim=64,
+                               compute_dtype="float32")
+        t = SingleTrainer(model, batch_size=32, num_epoch=25,
+                          loss="sparse_categorical_crossentropy_from_logits",
+                          worker_optimizer="adam", learning_rate=3e-3)
+        f = t.train(Dataset({"features": x, "label": y}))
+        return f.model, f.params
+
+    model, params = train(2)
+    draft, dparams = train(1)
+    prompt = np.array([[3, 4, 5, 6]], np.int32)
+    want = np.asarray(generate(model, params, prompt, 16))
+    got, stats = speculative_generate(model, params, draft, dparams,
+                                      prompt, 16, draft_len=4,
+                                      return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    # both learned x+1, so the draft's proposals almost all land
+    assert stats["accepted"] / stats["drafted"] > 0.8
+    assert stats["target_calls"] < 16
+
+
+def test_validation():
+    model, params = make_lm()
+    draft, dparams = make_lm(layers=1, vocab=8)
+    with pytest.raises(ValueError, match="vocabularies differ"):
+        speculative_generate(model, params, draft, dparams, PROMPT, 4)
+    draft, dparams = make_lm(layers=1)
+    with pytest.raises(ValueError, match="num_steps"):
+        speculative_generate(model, params, draft, dparams, PROMPT, 0)
+    with pytest.raises(ValueError, match="draft_len"):
+        speculative_generate(model, params, draft, dparams, PROMPT, 4,
+                             draft_len=0)
+
+
+def test_long_self_draft_acceptance_does_not_decay():
+    """Regression for the draft-cache hole: fully-accepted rounds used to
+    leave one unwritten (zero) draft slot each, quietly diluting every
+    later draft forward.  With the back-fill, a trained self-draft keeps
+    accepting across a LONG generation."""
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.trainers import SingleTrainer
+
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 16, (256, 12)).astype(np.int32)
+    model = transformer_lm(vocab_size=16, seq_len=64, d_model=32,
+                           num_heads=4, num_layers=2, mlp_dim=64,
+                           compute_dtype="float32")
+    t = SingleTrainer(model, batch_size=32, num_epoch=25,
+                      loss="sparse_categorical_crossentropy_from_logits",
+                      worker_optimizer="adam", learning_rate=3e-3)
+    f = t.train(Dataset({"features": x, "label": (x + 1) % 16}))
+
+    prompt = np.array([[3, 4, 5, 6]], np.int32)
+    want = np.asarray(generate(f.model, f.params, prompt, 48))
+    got, stats = speculative_generate(f.model, f.params, f.model, f.params,
+                                      prompt, 48, draft_len=4,
+                                      return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["accepted"] / stats["drafted"] > 0.9
+    # sustained acceptance => far fewer target calls than tokens
+    assert stats["target_calls"] <= 48 // 4 + 2
