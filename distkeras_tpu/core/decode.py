@@ -441,7 +441,9 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
     sampling needs the rejection-sampling correction — not implemented);
     ``eos_id`` stopping is not supported here, use ``generate``.
     ``return_stats=True`` additionally returns
-    ``{"target_calls", "drafted", "accepted"}``.
+    ``{"target_calls", "drafted", "accepted"}`` — ``target_calls`` counts
+    the decode-phase verify forwards (the prompt prefill is one more
+    target forward on top).
     """
     _check_supported(model)
     _check_supported(draft_model)
@@ -468,8 +470,20 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
                 f"prompt + num_steps = {total} exceeds the {name} model's "
                 f"positional-embedding range {limit}")
 
-    t_caches = init_cache(model, b, max_len)
-    d_caches = init_cache(draft_model, b, max_len)
+    # allocate draft_len slots of slack so every round can draft and
+    # verify at the SAME (B, draft_len + 1) shape — without it the tail
+    # rounds shrink k and each distinct width pays a fresh XLA compile.
+    # Slack slots only ever hold discarded writes (kv_length-masked);
+    # learned-positional models cap the slack at their trained range and
+    # may shrink on the final rounds.
+    def alloc_for(m):
+        limit = _context_limit(m)
+        want = max_len + int(draft_len)
+        return want if limit is None else min(want, limit)
+
+    t_caches = init_cache(model, b, alloc_for(model))
+    d_caches = init_cache(draft_model, b, alloc_for(draft_model))
+    alloc = min(alloc_for(model), alloc_for(draft_model))
     logits, t_caches = _forward(model, params, t_caches, prompt, 0)
     _, d_caches = _forward(draft_model, draft_params, d_caches, prompt, 0)
     cur = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)  # (B,)
@@ -483,11 +497,10 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
     pos = p_len - 1  # cur continues from here; its cache slot is pos + 1
     stats = {"target_calls": 0, "drafted": 0, "accepted": 0}
     while len(out) < num_steps:
-        # k drafted tokens commit at most k + 1 outputs, and the verify
-        # writes k + 1 cache slots starting at pos + 1
-        k = min(int(draft_len), num_steps - len(out) - 1,
-                max_len - (pos + 1) - 1)
-        k = max(k, 0)
+        # fixed k = draft_len whenever the allocation allows (one compiled
+        # verify shape); the commit clamp below keeps outputs exact even
+        # when more is drafted than remains to emit
+        k = max(min(int(draft_len), alloc - (pos + 1) - 1), 0)
         # draft k tokens greedily from cur
         d_toks = []
         tok = cur
@@ -513,6 +526,7 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
         # per-row accepted prefix length; commit the batch minimum
         prefix = jnp.cumprod(match.astype(jnp.int32), axis=1)
         a = int(jnp.min(jnp.sum(prefix, axis=1)))
+        a = min(a, num_steps - len(out) - 1)  # never emit past num_steps
         for i in range(a):
             out.append(greedy[:, i])          # == accepted draft tokens
         out.append(greedy[:, a])              # bonus / correction token

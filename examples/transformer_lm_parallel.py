@@ -52,6 +52,12 @@ def main():
     ap.add_argument("--zero", action="store_true",
                     help="ZeRO-1: shard optimizer moments over the data "
                          "axis (same update math, mu/nu HBM / dp)")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="ZeRO-3/FSDP: params AND moments sharded over "
+                         "the data axis at rest (supersedes --zero)")
+    ap.add_argument("--fused-ce", action="store_true",
+                    help="fused Pallas cross-entropy (TPU; XLA fallback "
+                         "under the CPU mesh)")
     ap.add_argument("--sp-impl", choices=["ring", "ulysses"],
                     default="ring", help="sequence-parallel schedule")
     args = ap.parse_args()
@@ -74,7 +80,7 @@ def main():
         num_heads=heads, num_layers=args.layers,
         mlp_dim=4 * args.d_model, mesh=mesh,
         moe_layers=(args.layers - 1,), num_experts=args.tp,
-        sp_impl=args.sp_impl,
+        sp_impl=args.sp_impl, fused_ce=args.fused_ce,
         compute_dtype=jnp.float32 if jax.default_backend() == "cpu"
         else jnp.bfloat16)
     params = lm.init(jax.random.PRNGKey(0))
@@ -87,7 +93,8 @@ def main():
     tx = optax.adam(lr)
     if args.accum > 1:
         tx = optax.MultiSteps(tx, args.accum).gradient_transformation()
-    opt_state, step = lm.compile_train_step(tx, params, zero=args.zero)
+    opt_state, step = lm.compile_train_step(tx, params, zero=args.zero,
+                                            fsdp=args.fsdp)
 
     # task: predict the next token of a shifted stream
     rng = np.random.default_rng(0)
