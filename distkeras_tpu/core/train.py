@@ -46,17 +46,21 @@ def make_loss_fn(model: Sequential, loss) -> Callable:
 
 
 def make_masked_loss_fn(model: Sequential, loss) -> Callable:
-    """(params, x, y, w, rng) -> (masked-mean loss, stats_aux).
+    """(params, x, y, w, rng[, seg]) -> (masked-mean loss, stats_aux).
 
     ``w`` is a per-example weight vector (1 real, 0 padding): the loss is
     Σ wᵢ·lossᵢ / max(Σ w, 1), so padded examples contribute exactly zero to
     value and gradient (``shape_epoch_data`` pads the tail round by wrapping
-    real rows, keeping BatchNorm batch statistics sane)."""
+    real rows, keeping BatchNorm batch statistics sane).  ``seg`` (optional
+    trailing arg): per-row segment ids for sequence packing, threaded into
+    the forward (``data/packing.py``)."""
     per_ex = per_example(get_loss(loss))
 
-    def compute(params, x, y, w, rng):
+    def compute(params, x, y, w, rng, seg=None):
         stats: dict = {}
-        pred = model.apply(params, x, train=True, rng=rng, stats_out=stats)
+        kw = {"segment_ids": seg} if seg is not None else {}
+        pred = model.apply(params, x, train=True, rng=rng, stats_out=stats,
+                           **kw)
         losses = per_ex(y, pred)
         w = w.astype(jnp.float32)
         return jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0), stats
@@ -69,7 +73,8 @@ def make_masked_step(model: Sequential, loss,
     """The one masked minibatch step shared by all three engines
     (``make_epoch_runner``, the SPMD window scan, the host-PS worker window).
 
-    (params, opt_state, x, y, w, rng) -> (params, opt_state, loss, wsum).
+    (params, opt_state, x, y, w, rng[, seg]) -> (params, opt_state, loss,
+    wsum) — ``seg`` as in ``make_masked_loss_fn``.
 
     A fully-padded batch (wsum == 0) is a TRUE no-op: the masked loss gives
     zero gradient, but e.g. Adam still moves parameters on a zero gradient
@@ -78,9 +83,9 @@ def make_masked_step(model: Sequential, loss,
     """
     compute = make_masked_loss_fn(model, loss)
 
-    def step(params, opt_state, x, y, w, rng):
+    def step(params, opt_state, x, y, w, rng, seg=None):
         (l, stats), grads = jax.value_and_grad(compute, has_aux=True)(
-            params, x, y, w, rng)
+            params, x, y, w, rng, seg)
         updates, new_opt = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         new_params = Sequential.merge_stats(new_params, stats)
@@ -136,21 +141,57 @@ def make_epoch_runner(model: Sequential, loss, tx) -> Callable:
     return jax.jit(epoch)
 
 
-def batch_epoch_data(x: np.ndarray, y: np.ndarray, batch_size: int):
-    """Stack a flat epoch into (num_batches, batch, ...) + mask, wrap-padding
-    the tail batch instead of dropping it (single-device analogue of
-    ``parallel.spmd.shape_epoch_data``)."""
-    n_rows = len(x)
+def batch_epoch_arrays(batch_size: int, *arrays):
+    """Stack flat epoch arrays into (num_batches, batch, ...) + mask,
+    wrap-padding the tail batch instead of dropping it.  All arrays share
+    one row order; returns ``(*stacked, mask, num_batches)``."""
+    n_rows = len(arrays[0])
     if n_rows == 0:
         raise ValueError("empty dataset")
+    if any(len(a) != n_rows for a in arrays):
+        raise ValueError("epoch arrays must share their row count")
     nb = -(-n_rows // batch_size)  # ceil: pad up, never drop
     rows = nb * batch_size
     idx = np.arange(rows) % n_rows
     mask = (np.arange(rows) < n_rows).astype(np.float32)
     shape = (nb, batch_size)
-    return (np.asarray(x)[idx].reshape(shape + x.shape[1:]),
-            np.asarray(y)[idx].reshape(shape + y.shape[1:]),
-            mask.reshape(shape), nb)
+    stacked = tuple(np.asarray(a)[idx].reshape(shape + np.asarray(a).shape[1:])
+                    for a in arrays)
+    return stacked + (mask.reshape(shape), nb)
+
+
+def batch_epoch_data(x: np.ndarray, y: np.ndarray, batch_size: int):
+    """Stack a flat epoch into (num_batches, batch, ...) + mask, wrap-padding
+    the tail batch instead of dropping it (single-device analogue of
+    ``parallel.spmd.shape_epoch_data``)."""
+    xb, yb, mask, nb = batch_epoch_arrays(batch_size, x, y)
+    return xb, yb, mask, nb
+
+
+def make_packed_epoch_runner(model: Sequential, loss, tx) -> Callable:
+    """Sequence-packing variant of ``make_epoch_runner``: every batch
+    carries a (batch, S) ``segment_ids`` array threaded into the forward
+    (attention isolation — ``data/packing.py``), and ``loss`` should be a
+    ``*_masked`` variant so cross-document label -1 positions drop out.
+    Per-ROW weights gate wrap-padded tail rows through the SAME
+    ``make_masked_step`` every engine shares (one copy of the
+    fully-padded-batch gating)."""
+    step = make_masked_step(model, loss, tx)
+
+    def epoch(state: TrainState, xb, yb, sb, mb, rng):
+        def body(carry, inp):
+            st, key = carry
+            x, y, seg, w = inp
+            key, sub = jax.random.split(key)
+            params, opt_state, l, _ = step(st.params, st.opt_state, x, y,
+                                           w, sub, seg)
+            return (TrainState(params, opt_state, st.step + 1), key), l
+
+        (state, _), losses = jax.lax.scan(body, (state, rng),
+                                          (xb, yb, sb, mb))
+        return state, losses
+
+    return jax.jit(epoch)
 
 
 def init_state(model: Sequential, rng, input_shape, optimizer,

@@ -198,7 +198,8 @@ class SingleTrainer(Trainer):
                  lr_schedule=None, gradient_accumulation: int = 1,
                  gradient_clip_norm: Optional[float] = None,
                  early_stopping_patience: Optional[int] = None,
-                 early_stopping_min_delta: float = 0.0):
+                 early_stopping_min_delta: float = 0.0,
+                 segment_col: Optional[str] = None):
         super().__init__(keras_model, loss, worker_optimizer, learning_rate,
                          seed, lr_schedule, gradient_accumulation,
                          gradient_clip_norm,
@@ -207,9 +208,20 @@ class SingleTrainer(Trainer):
         self.label_col = label_col
         self.batch_size = int(batch_size)
         self.num_epoch = int(num_epoch)
+        # sequence packing (data/packing.py): name of the segment-ids
+        # column; attention isolates documents and the loss should be a
+        # *_masked variant so cross-document label -1 positions drop out
+        self.segment_col = segment_col
 
     def train(self, dataset: Dataset, shuffle: bool = False,
               validation_data: Optional[Dataset] = None) -> FittedModel:
+        if self.segment_col is not None and validation_data is not None:
+            # fail fast, before any state is built: the validation forward
+            # would ignore the segment isolation
+            raise ValueError(
+                "validation_data with segment_col is not supported: "
+                "the validation forward would ignore the segment "
+                "isolation — evaluate packed models explicitly")
         self.record_training_start()
         x = dataset[self.features_col]
         y = dataset[self.label_col]
@@ -227,19 +239,23 @@ class SingleTrainer(Trainer):
                                total_updates, self.gradient_accumulation,
                                self.gradient_clip_norm)
         state = state._replace(params=params)
-        runner = make_epoch_runner(self.master_model, self.loss, tx)
+        packed = self.segment_col is not None
+        from .core.train import batch_epoch_arrays, make_packed_epoch_runner
+        runner = (make_packed_epoch_runner(self.master_model, self.loss, tx)
+                  if packed
+                  else make_epoch_runner(self.master_model, self.loss, tx))
+        cols = {"x": x, "y": y}
+        if packed:
+            cols["s"] = dataset[self.segment_col]
         rng = jax.random.PRNGKey(self.seed + 1)
         val_fn = self._setup_validation(validation_data)
         for epoch in range(self.num_epoch):
-            if shuffle:
-                ds = Dataset({"x": x, "y": y}).shuffle(self.seed + epoch)
-                xe, ye = ds["x"], ds["y"]
-            else:
-                xe, ye = x, y
-            xb, yb, mb, nb = batch_epoch_data(np.asarray(xe), np.asarray(ye),
-                                              self.batch_size)
+            ds = (Dataset(cols).shuffle(self.seed + epoch) if shuffle
+                  else Dataset(cols))
+            *stacked, mb, nb = batch_epoch_arrays(
+                self.batch_size, *(np.asarray(ds[k]) for k in cols))
             rng, sub = jax.random.split(rng)
-            state, losses = runner(state, jnp.asarray(xb), jnp.asarray(yb),
+            state, losses = runner(state, *map(jnp.asarray, stacked),
                                    jnp.asarray(mb), sub)
             self.history.extend(np.asarray(losses).tolist())
             if val_fn is not None and self._validate_epoch(
