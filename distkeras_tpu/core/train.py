@@ -115,27 +115,37 @@ def make_train_step(model: Sequential, loss, tx: optax.GradientTransformation,
     return step
 
 
-def make_epoch_runner(model: Sequential, loss, tx) -> Callable:
+def make_epoch_runner(model: Sequential, loss, tx,
+                      packed: bool = False) -> Callable:
     """Scan stacked batch arrays through train steps inside one XLA program.
 
     ``xb``/``yb``/``mb`` have shape (num_batches, batch, ...); ``mb`` is the
     per-example real/padding mask (``batch_epoch_data``) so the tail batch
     is padded+masked instead of dropped.  Returns (state, per-batch losses);
     each loss is the exact mean over that batch's real examples.
+
+    ``packed=True`` (sequence packing, ``data/packing.py``): the epoch
+    additionally scans a stacked ``sb`` segment-ids array —
+    ``epoch(state, xb, yb, sb, mb, rng)`` — threaded into the shared
+    masked step's forward; use a ``*_masked`` loss so cross-document
+    label -1 positions drop out.
     """
     step = make_masked_step(model, loss, tx)
 
-    def epoch(state: TrainState, xb, yb, mb, rng):
+    def epoch(state: TrainState, xb, yb, *rest):
+        (sb, mb, rng) = rest if packed else (None,) + rest
+
         def body(carry, inp):
             st, key = carry
-            x, y, w = inp
+            x, y, seg, w = inp if packed else inp[:2] + (None,) + inp[2:]
             key, sub = jax.random.split(key)
             params, opt_state, l, _ = step(st.params, st.opt_state, x, y, w,
-                                           sub)
+                                           sub, seg)
             st = TrainState(params, opt_state, st.step + 1)
             return (st, key), l
 
-        (state, _), losses = jax.lax.scan(body, (state, rng), (xb, yb, mb))
+        xs = (xb, yb, sb, mb) if packed else (xb, yb, mb)
+        (state, _), losses = jax.lax.scan(body, (state, rng), xs)
         return state, losses
 
     return jax.jit(epoch)
@@ -169,29 +179,9 @@ def batch_epoch_data(x: np.ndarray, y: np.ndarray, batch_size: int):
 
 
 def make_packed_epoch_runner(model: Sequential, loss, tx) -> Callable:
-    """Sequence-packing variant of ``make_epoch_runner``: every batch
-    carries a (batch, S) ``segment_ids`` array threaded into the forward
-    (attention isolation — ``data/packing.py``), and ``loss`` should be a
-    ``*_masked`` variant so cross-document label -1 positions drop out.
-    Per-ROW weights gate wrap-padded tail rows through the SAME
-    ``make_masked_step`` every engine shares (one copy of the
-    fully-padded-batch gating)."""
-    step = make_masked_step(model, loss, tx)
-
-    def epoch(state: TrainState, xb, yb, sb, mb, rng):
-        def body(carry, inp):
-            st, key = carry
-            x, y, seg, w = inp
-            key, sub = jax.random.split(key)
-            params, opt_state, l, _ = step(st.params, st.opt_state, x, y,
-                                           w, sub, seg)
-            return (TrainState(params, opt_state, st.step + 1), key), l
-
-        (state, _), losses = jax.lax.scan(body, (state, rng),
-                                          (xb, yb, sb, mb))
-        return state, losses
-
-    return jax.jit(epoch)
+    """``make_epoch_runner(packed=True)`` — one scan body for both
+    paths; see there."""
+    return make_epoch_runner(model, loss, tx, packed=True)
 
 
 def init_state(model: Sequential, rng, input_shape, optimizer,

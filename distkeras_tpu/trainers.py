@@ -30,7 +30,8 @@ import numpy as np
 
 from .core.model import Sequential, FittedModel, serialize_model
 from .core import optimizers as opt_lib
-from .core.train import batch_epoch_data, init_state, make_epoch_runner
+from .core.train import (batch_epoch_arrays, init_state,
+                         make_epoch_runner, make_packed_epoch_runner)
 from .data.dataset import Dataset
 from .parallel import mesh as mesh_lib
 from .parallel.spmd import SPMDEngine, DistState, shape_epoch_data
@@ -222,6 +223,15 @@ class SingleTrainer(Trainer):
                 "validation_data with segment_col is not supported: "
                 "the validation forward would ignore the segment "
                 "isolation — evaluate packed models explicitly")
+        if self.segment_col is not None and isinstance(self.loss, str) \
+                and "masked" not in self.loss:
+            # packed labels carry -1 sentinels; a plain sparse CE would
+            # clamp them to class 0 and silently train boundaries wrong
+            raise ValueError(
+                f"segment_col needs a *_masked loss (packed labels mark "
+                f"cross-document/padding positions -1), got "
+                f"{self.loss!r} — use e.g. "
+                "'sparse_categorical_crossentropy_masked_from_logits'")
         self.record_training_start()
         x = dataset[self.features_col]
         y = dataset[self.label_col]
@@ -240,7 +250,6 @@ class SingleTrainer(Trainer):
                                self.gradient_clip_norm)
         state = state._replace(params=params)
         packed = self.segment_col is not None
-        from .core.train import batch_epoch_arrays, make_packed_epoch_runner
         runner = (make_packed_epoch_runner(self.master_model, self.loss, tx)
                   if packed
                   else make_epoch_runner(self.master_model, self.loss, tx))

@@ -224,3 +224,16 @@ def test_single_trainer_packed_path():
                           "segment_ids": segs}),
                  validation_data=Dataset({"features": tokens,
                                           "label": labels}))
+
+
+def test_segment_col_requires_masked_loss():
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.trainers import SingleTrainer
+    model = lm(seq_len=8)
+    t = SingleTrainer(model, segment_col="segment_ids",
+                      loss="sparse_categorical_crossentropy_from_logits")
+    ds = Dataset({"features": np.zeros((4, 8), np.int32),
+                  "label": np.zeros((4, 8), np.int32),
+                  "segment_ids": np.ones((4, 8), np.int32)})
+    with pytest.raises(ValueError, match="masked"):
+        t.train(ds)
