@@ -75,6 +75,54 @@ def mean_absolute_error(y_true, y_pred):
         y_true.astype(jnp.float32) - y_pred.astype(jnp.float32)))
 
 
+def mean_absolute_percentage_error(y_true, y_pred):
+    y_true = y_true.astype(jnp.float32)
+    diff = jnp.abs((y_true - y_pred.astype(jnp.float32))
+                   / jnp.clip(jnp.abs(y_true), _EPS, None))
+    return 100.0 * jnp.mean(diff)
+
+
+def mean_squared_logarithmic_error(y_true, y_pred):
+    fl = jnp.log1p(jnp.clip(y_pred.astype(jnp.float32), _EPS, None))
+    sl = jnp.log1p(jnp.clip(y_true.astype(jnp.float32), _EPS, None))
+    return jnp.mean(jnp.square(fl - sl))
+
+
+def kullback_leibler_divergence(y_true, y_pred):
+    y_true = jnp.clip(y_true.astype(jnp.float32), _EPS, 1.0)
+    y_pred = jnp.clip(y_pred.astype(jnp.float32), _EPS, 1.0)
+    return jnp.mean(jnp.sum(y_true * jnp.log(y_true / y_pred), axis=-1))
+
+
+def hinge(y_true, y_pred):
+    # Keras convention: y_true in {-1, 1} (or {0, 1}, converted)
+    y_true = y_true.astype(jnp.float32)
+    y_true = jnp.where(y_true == 0.0, -1.0, y_true)
+    return jnp.mean(jnp.maximum(
+        1.0 - y_true * y_pred.astype(jnp.float32), 0.0))
+
+
+def squared_hinge(y_true, y_pred):
+    y_true = y_true.astype(jnp.float32)
+    y_true = jnp.where(y_true == 0.0, -1.0, y_true)
+    return jnp.mean(jnp.square(jnp.maximum(
+        1.0 - y_true * y_pred.astype(jnp.float32), 0.0)))
+
+
+def poisson(y_true, y_pred):
+    y_pred = jnp.clip(y_pred.astype(jnp.float32), _EPS, None)
+    return jnp.mean(y_pred - y_true.astype(jnp.float32) * jnp.log(y_pred))
+
+
+def cosine_proximity(y_true, y_pred):
+    # Keras-1 sign convention: minimizing drives vectors together (-1 best)
+    yt = y_true.astype(jnp.float32)
+    yp = y_pred.astype(jnp.float32)
+    yt = yt / jnp.clip(jnp.linalg.norm(yt, axis=-1, keepdims=True), _EPS)
+    yp = yp / jnp.clip(jnp.linalg.norm(yp, axis=-1, keepdims=True), _EPS)
+    return -jnp.mean(jnp.sum(yt * yp, axis=-1))
+
+
 def _from_logits(fn):
     def wrapped(y_true, y_pred):
         return fn(y_true, y_pred, from_logits=True)
@@ -98,6 +146,17 @@ _LOSSES = {
     "mse": mean_squared_error,
     "mean_absolute_error": mean_absolute_error,
     "mae": mean_absolute_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+    "mape": mean_absolute_percentage_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "msle": mean_squared_logarithmic_error,
+    "kullback_leibler_divergence": kullback_leibler_divergence,
+    "kld": kullback_leibler_divergence,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "cosine": cosine_proximity,
 }
 
 

@@ -147,3 +147,43 @@ def test_fsdp_rejects_unknown_axis(eight_devices):
         build_train_step(lm.mesh, lm._loss, lm.param_specs(),
                          P("data", "seq"), optax.adam(1e-2), params,
                          fsdp_axis="nope")
+
+
+def test_fsdp_state_orbax_roundtrip(eight_devices, tmp_path):
+    """Pod-resume integration: FSDP-sharded params + moments survive an
+    orbax save/restore with their NamedShardings intact, and training
+    continues bit-identically from the restored state."""
+    pytest.importorskip("orbax.checkpoint")
+    from distkeras_tpu.checkpoint import OrbaxCheckpointer
+
+    lm = make_lm(mesh_of((4, 1, 2)))
+    params = lm.init(jax.random.PRNGKey(7))
+    opt_state, step = lm.compile_train_step(optax.adam(1e-2), params,
+                                            fsdp=True)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, lm.vocab_size, (8, lm.seq_len)).astype(np.int32)
+    labels = (toks + 1) % lm.vocab_size
+    sh = lm.batch_sharding()
+    toks, labels = jax.device_put(toks, sh), jax.device_put(labels, sh)
+
+    params, opt_state, _ = step(params, opt_state, toks, labels)
+
+    ck = OrbaxCheckpointer(str(tmp_path / "fsdp_ck"), async_save=False)
+    ck.save(1, {"params": params, "opt": opt_state})
+    ck.wait()
+    restored = ck.restore({"params": params, "opt": opt_state})
+
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(restored["params"])):
+        assert a.sharding == b.sharding  # FSDP layout survives
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # continuing from the restored state matches continuing in-memory
+    # (step donates its state args: the restored copies are separate
+    # buffers, and params/opt_state are not reused after this call)
+    p1, o1, l1 = step(params, opt_state, toks, labels)
+    p2, o2, l2 = step(restored["params"], restored["opt"], toks, labels)
+    np.testing.assert_array_equal(float(l1), float(l2))
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,70 @@
+"""The widened Keras-1 loss-name family (core/losses.py).
+
+The reference accepted any Keras loss string through ``loss=`` (SURVEY.md
+§2.1 rows 1-11); these pin the added names against hand computations /
+closed forms on small arrays.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.core.losses import get_loss
+
+
+Y = jnp.asarray(np.array([[1.0, 0.0], [0.5, 0.5]]))
+P = jnp.asarray(np.array([[0.8, 0.2], [0.25, 0.75]]))
+
+
+def test_kld_matches_hand_sum():
+    got = float(get_loss("kld")(Y, P))
+    rows = [1.0 * np.log(1.0 / 0.8),
+            0.5 * np.log(0.5 / 0.25) + 0.5 * np.log(0.5 / 0.75)]
+    # row 0's zero entry contributes eps-level noise only
+    np.testing.assert_allclose(got, np.mean(rows), rtol=1e-4, atol=1e-4)
+
+
+def test_hinge_conventions():
+    yt = jnp.asarray([[1.0, -1.0]])
+    yp = jnp.asarray([[0.3, 0.4]])
+    np.testing.assert_allclose(float(get_loss("hinge")(yt, yp)),
+                               ((1 - 0.3) + (1 + 0.4)) / 2, rtol=1e-6)
+    # 0/1 labels convert to -1/1
+    yt01 = jnp.asarray([[1.0, 0.0]])
+    np.testing.assert_allclose(float(get_loss("hinge")(yt01, yp)),
+                               ((1 - 0.3) + (1 + 0.4)) / 2, rtol=1e-6)
+    np.testing.assert_allclose(
+        float(get_loss("squared_hinge")(yt, yp)),
+        ((1 - 0.3) ** 2 + (1 + 0.4) ** 2) / 2, rtol=1e-6)
+
+
+def test_poisson_and_msle_and_mape():
+    yt = jnp.asarray([[2.0, 0.5]])
+    yp = jnp.asarray([[1.5, 1.0]])
+    np.testing.assert_allclose(
+        float(get_loss("poisson")(yt, yp)),
+        np.mean([1.5 - 2.0 * np.log(1.5), 1.0 - 0.5 * np.log(1.0)]),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        float(get_loss("msle")(yt, yp)),
+        np.mean((np.log1p([1.5, 1.0]) - np.log1p([2.0, 0.5])) ** 2),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        float(get_loss("mape")(yt, yp)),
+        100 * np.mean([0.5 / 2.0, 0.5 / 0.5]), rtol=1e-6)
+
+
+def test_cosine_proximity_extremes():
+    a = jnp.asarray([[1.0, 0.0]])
+    assert float(get_loss("cosine")(a, a)) == pytest.approx(-1.0)
+    assert float(get_loss("cosine")(a, jnp.asarray([[0.0, 1.0]]))) == \
+        pytest.approx(0.0, abs=1e-6)
+    assert float(get_loss("cosine")(a, -a)) == pytest.approx(1.0)
+
+
+def test_all_new_names_resolve_and_reduce_to_scalar():
+    for name in ("mape", "msle", "kld", "hinge", "squared_hinge",
+                 "poisson", "cosine_proximity"):
+        v = get_loss(name)(Y, P)
+        assert v.shape == (), name
+        assert np.isfinite(float(v)), name
