@@ -249,6 +249,23 @@ def jit_decode_step(model, rolling: bool = False):
     return step
 
 
+def _validate_sampling(temperature: float, rng,
+                       top_k: Optional[int], top_p: Optional[float]):
+    """The one sampling-surface rule set, shared by ``generate`` and
+    ``speculative_generate``."""
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 sampling needs rng")
+    if top_k is not None or top_p is not None:
+        if temperature <= 0.0:
+            raise ValueError(
+                "top_k/top_p shape the SAMPLING distribution — pass "
+                "temperature > 0 (greedy argmax ignores them)")
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
 def _filter_logits(logits, top_k: Optional[int], top_p: Optional[float]):
     """Restrict a (B, V) logit row to the top-k tokens and/or the smallest
     nucleus whose probability mass reaches top_p (the top token always
@@ -332,17 +349,7 @@ def generate(model, params, prompt, num_steps: int,
         raise ValueError(
             f"prompt ({p_len}) + num_steps ({num_steps}) = {total} exceeds "
             f"the model's positional-embedding range {limit}")
-    if temperature > 0.0 and rng is None:
-        raise ValueError("temperature > 0 sampling needs rng")
-    if top_k is not None or top_p is not None:
-        if temperature <= 0.0:
-            raise ValueError(
-                "top_k/top_p shape the SAMPLING distribution — pass "
-                "temperature > 0 (greedy argmax ignores them)")
-        if top_k is not None and top_k < 1:
-            raise ValueError(f"top_k must be >= 1, got {top_k}")
-        if top_p is not None and not 0.0 < top_p <= 1.0:
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    _validate_sampling(temperature, rng, top_k, top_p)
     if pad_id is not None and eos_id is None:
         raise ValueError("pad_id only means something with eos_id")
     if eos_id is not None:
@@ -351,6 +358,12 @@ def generate(model, params, prompt, num_steps: int,
             raise ValueError(
                 f"eos_id {eos_id} outside the model's vocabulary "
                 f"[0, {vocab}) — stopping could never trigger")
+        if pad_id is not None and vocab is not None \
+                and not 0 <= pad_id < vocab:
+            # same rule as eos_id: without it the .at[].set scatter and the
+            # embedding gather silently clamp an out-of-range pad token
+            raise ValueError(f"pad_id {pad_id} outside the model's "
+                             f"vocabulary [0, {vocab})")
     if rolling:
         # the prefill below still uses a full P-slot cache (one batched
         # forward), which then collapses to rings — peak memory O(P + W),
@@ -413,37 +426,51 @@ def generate(model, params, prompt, num_steps: int,
 def speculative_generate(model, params, draft_model, draft_params, prompt,
                          num_steps: int, draft_len: int = 4,
                          max_len: Optional[int] = None,
+                         temperature: float = 0.0,
+                         rng: Optional[jax.Array] = None,
+                         top_k: Optional[int] = None,
+                         top_p: Optional[float] = None,
                          return_stats: bool = False):
-    """Greedy decoding accelerated by a cheaper draft model — greedy-exact:
-    every committed token is the TARGET's own argmax, whatever the draft
-    proposes.  (The argmax comes from the batched verify forward; it can
-    differ from single-token ``generate`` only where two logits tie to
-    within the fusion-order rounding between an L-token and a 1-token
-    program — measure-zero for trained models, asserted bit-identical
-    across this suite's CI models and drafts.)
+    """Decoding accelerated by a cheaper draft model — distribution-exact.
 
-    Each round the draft greedily proposes ``draft_len`` tokens one at a
-    time; the target then scores ALL of them in ONE batched forward (the
-    MXU-shaped win: k positions per target call instead of 1) and commits
-    the longest prefix that matches its own argmax plus one bonus token
-    from the mismatch position.  A good draft commits ``draft_len + 1``
-    tokens per target call; a useless draft still commits 1, so the method
-    never produces different tokens, only different wall-clock.
+    ``temperature == 0`` (default): greedy-exact — every committed token is
+    the TARGET's own argmax, whatever the draft proposes.  (The argmax
+    comes from the batched verify forward; it can differ from single-token
+    ``generate`` only where two logits tie to within the fusion-order
+    rounding between an L-token and a 1-token program — measure-zero for
+    trained models, asserted bit-identical across this suite's CI models
+    and drafts.)
+
+    ``temperature > 0`` (needs ``rng``): SPECULATIVE SAMPLING (Leviathan
+    et al. 2022 / Chen et al. 2023 rejection rule).  Both distributions
+    are first warped identically (temperature, then ``top_k``/``top_p``
+    as in ``generate``); each drafted token x ~ q is accepted with
+    probability min(1, p(x)/q(x)), and the first rejection draws from the
+    residual norm(max(p − q, 0)).  The committed-token distribution is
+    EXACTLY the warped target distribution — the draft changes wall-clock
+    only, never statistics (asserted against closed-form marginals in
+    tests/test_speculative.py).
+
+    Each round the draft proposes ``draft_len`` tokens one at a time; the
+    target then scores ALL of them in ONE batched forward (the MXU-shaped
+    win: k positions per target call instead of 1) and commits the
+    accepted prefix plus one bonus/correction token.  A good draft commits
+    ``draft_len + 1`` tokens per target call; a useless draft still
+    commits 1.
 
     No cache rollback is needed on rejection: rejected positions hold
     stale k/v, but every attention in this walker masks slots ``>=
     kv_length``, and the next round overwrites them before they can be
     unmasked.  Batched prompts commit the MINIMUM accepted length across
-    rows (every committed token is the target's own argmax for every row,
-    so exactness holds row-wise).
+    rows (greedy: every committed token is the target's own argmax for
+    every row; sampling: truncating a row's accepted run early never
+    conditions on later randomness — exactness holds row-wise either way).
 
-    Both models must share the vocabulary.  Greedy only (temperature
-    sampling needs the rejection-sampling correction — not implemented);
-    ``eos_id`` stopping is not supported here, use ``generate``.
-    ``return_stats=True`` additionally returns
-    ``{"target_calls", "drafted", "accepted"}`` — ``target_calls`` counts
-    the decode-phase verify forwards (the prompt prefill is one more
-    target forward on top).
+    Both models must share the vocabulary.  ``eos_id`` stopping is not
+    supported here, use ``generate``.  ``return_stats=True`` additionally
+    returns ``{"target_calls", "drafted", "accepted"}`` — ``target_calls``
+    counts the decode-phase verify forwards (the prompt prefill is one
+    more target forward on top).
     """
     _check_supported(model)
     _check_supported(draft_model)
@@ -454,6 +481,7 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
                          f"{num_steps}")
     if draft_len < 1:
         raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+    _validate_sampling(temperature, rng, top_k, top_p)
     tv, dv = _vocab_size(model), _vocab_size(draft_model)
     if tv is not None and dv is not None and tv != dv:
         raise ValueError(f"target and draft vocabularies differ: {tv} vs "
@@ -486,7 +514,27 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
     alloc = min(alloc_for(model), alloc_for(draft_model))
     logits, t_caches = _forward(model, params, t_caches, prompt, 0)
     _, d_caches = _forward(draft_model, draft_params, d_caches, prompt, 0)
-    cur = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)  # (B,)
+
+    sampled = temperature > 0.0
+
+    def warp(l):
+        # identical warp for target and draft — the rejection rule is
+        # exact for whatever pair of distributions it compares, so
+        # warping both reproduces plain warped-target sampling
+        return _filter_logits(l / temperature, top_k, top_p) if sampled \
+            else l
+
+    _draw = [0]  # host-side draw counter -> a fresh fold per random draw
+
+    def _key():
+        _draw[0] += 1
+        return jax.random.fold_in(rng, _draw[0])
+
+    if sampled:
+        cur = jax.random.categorical(
+            _key(), warp(logits[:, -1])).astype(jnp.int32)
+    else:
+        cur = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)  # (B,)
 
     # model closes over (it shapes the program); params stay a traced arg
     verify = jax.jit(lambda p, caches, toks, pos: _forward(
@@ -501,35 +549,74 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
         # verify shape); the commit clamp below keeps outputs exact even
         # when more is drafted than remains to emit
         k = max(min(int(draft_len), alloc - (pos + 1) - 1), 0)
-        # draft k tokens greedily from cur
-        d_toks = []
+        # draft k tokens from cur (argmax, or a sample from warped q)
+        d_toks, q_logits = [], []
         tok = cur
         for i in range(k):
             dl, d_caches = d_step(draft_params, d_caches, tok, pos + 1 + i)
-            tok = jnp.argmax(dl, axis=-1).astype(jnp.int32)
+            wl = warp(dl)
+            tok = (jax.random.categorical(_key(), wl) if sampled
+                   else jnp.argmax(dl, axis=-1)).astype(jnp.int32)
             d_toks.append(tok)
+            q_logits.append(wl)
         # one target forward over [cur, d_1 .. d_k] (L = k + 1): logits[i]
         # scores the token FOLLOWING fed[i], so a fully-accepted round
         # still has a bonus logit at index k
         fed = jnp.stack([cur] + d_toks, axis=1)               # (B, k + 1)
         logits, t_caches = verify(params, t_caches, fed, pos + 1)
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
         stats["target_calls"] += 1
         stats["drafted"] += k
         if k == 0:
-            out.append(greedy[:, 0])
+            nxt = (jax.random.categorical(_key(), warp(logits[:, 0]))
+                   if sampled else jnp.argmax(logits[:, 0], axis=-1))
+            out.append(nxt.astype(jnp.int32))
             cur = out[-1]
             pos += 1
             continue
         drafted = jnp.stack(d_toks, axis=1)                   # (B, k)
-        match = drafted == greedy[:, :k]                      # (B, k)
-        # per-row accepted prefix length; commit the batch minimum
-        prefix = jnp.cumprod(match.astype(jnp.int32), axis=1)
-        a = int(jnp.min(jnp.sum(prefix, axis=1)))
-        a = min(a, num_steps - len(out) - 1)  # never emit past num_steps
-        for i in range(a):
-            out.append(greedy[:, i])          # == accepted draft tokens
-        out.append(greedy[:, a])              # bonus / correction token
+        if sampled:
+            # rejection rule: accept x ~ q with prob min(1, p(x)/q(x));
+            # the first rejection redraws from norm(max(p - q, 0))
+            p = jax.nn.softmax(warp(logits[:, :k]), axis=-1)  # (B, k, V)
+            q = jax.nn.softmax(jnp.stack(q_logits, axis=1), axis=-1)
+            px = jnp.take_along_axis(
+                p, drafted[..., None], axis=-1)[..., 0]       # (B, k)
+            qx = jnp.take_along_axis(q, drafted[..., None], axis=-1)[..., 0]
+            u = jax.random.uniform(_key(), (b, k))
+            accept = u * jnp.maximum(qx, 1e-30) < px          # u < p/q
+            prefix = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+            n_row = jnp.sum(prefix, axis=1)                   # (B,)
+            a = int(jnp.min(n_row))
+            a = min(a, num_steps - len(out) - 1)
+            for i in range(a):
+                out.append(drafted[:, i])     # accepted by every row
+            if a == k:
+                # fully accepted: bonus token straight from warped p
+                tok_a = jax.random.categorical(
+                    _key(), warp(logits[:, k])).astype(jnp.int32)
+            else:
+                res = jnp.maximum(p[:, a] - q[:, a], 0.0)
+                rsum = jnp.sum(res, axis=-1, keepdims=True)
+                # res == 0 iff p <= q everywhere, i.e. p == q: fall back
+                res = jnp.where(rsum > 0.0, res / jnp.maximum(rsum, 1e-38),
+                                p[:, a])
+                rej = jax.random.categorical(
+                    _key(), jnp.log(jnp.maximum(res, 1e-38)))
+                # rows that accepted position a keep their drafted token
+                # (truncation never conditions on later randomness)
+                tok_a = jnp.where(n_row > a, drafted[:, a],
+                                  rej).astype(jnp.int32)
+            out.append(tok_a)
+        else:
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            match = drafted == greedy[:, :k]                  # (B, k)
+            # per-row accepted prefix length; commit the batch minimum
+            prefix = jnp.cumprod(match.astype(jnp.int32), axis=1)
+            a = int(jnp.min(jnp.sum(prefix, axis=1)))
+            a = min(a, num_steps - len(out) - 1)
+            for i in range(a):
+                out.append(greedy[:, i])      # == accepted draft tokens
+            out.append(greedy[:, a])          # bonus / correction token
         stats["accepted"] += a
         cur = out[-1]
         pos += a + 1
@@ -595,6 +682,11 @@ def beam_search(model, params, prompt, num_steps: int, num_beams: int = 4,
                          f"[0, {vocab})")
     if pad_id is not None and eos_id is None:
         raise ValueError("pad_id only means something with eos_id")
+    if pad_id is not None and vocab is not None \
+            and not 0 <= pad_id < vocab:
+        # mirror the eos_id range check: scatter/gather would silently clamp
+        raise ValueError(f"pad_id {pad_id} outside the model's vocabulary "
+                         f"[0, {vocab})")
     pad = jnp.int32(pad_id if pad_id is not None else (eos_id or 0))
 
     # prefill once at batch B, then tile every cache to B·k rows laid out

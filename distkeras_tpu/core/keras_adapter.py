@@ -1,12 +1,16 @@
 """Keras → native model conversion.
 
 The reference's public API takes an actual ``keras.Model``
-(reference: ``distkeras/trainers.py :: Trainer.__init__(keras_model=...)``).
-For drop-in familiarity our trainers accept one too: this adapter converts a
-Keras ``Sequential`` of supported layer types into the native declarative
-``Sequential`` (whose forward pass is a pure jittable function), and extracts
-the Keras weights **re-ordered into the native pytree leaf order** so a
-converted model starts from identical parameters.
+(reference: ``distkeras/trainers.py :: Trainer.__init__(keras_model=...)``;
+its own MNIST-ConvNet examples build FUNCTIONAL models, not just
+Sequential).  For drop-in familiarity our trainers accept one too: this
+adapter converts a Keras ``Sequential`` OR a single-input single-output
+linear-chain ``Functional`` model of supported layer types into the native
+declarative ``Sequential`` (whose forward pass is a pure jittable
+function), and extracts the Keras weights **re-ordered into the native
+pytree leaf order** so a converted model starts from identical parameters.
+Branching graphs (skip connections, merges, shared layers) are rejected
+loudly — converting them to a chain would silently change the function.
 
 Import of ``keras`` is deferred and optional — the framework itself never
 needs it; only users handing us Keras objects do.
@@ -89,20 +93,91 @@ def _convert_layer(kl) -> List[L.Layer]:
                                      cfg.get("epsilon", 1e-3))]
     if t == "Embedding":
         return [L.Embedding(cfg["input_dim"], cfg["output_dim"])]
+    if t == "LayerNormalization":
+        axis = cfg.get("axis", -1)
+        axis = axis[0] if isinstance(axis, (list, tuple)) else axis
+        if axis != -1 or not cfg.get("center", True) \
+                or not cfg.get("scale", True):
+            raise ValueError(
+                "LayerNormalization with axis != -1 or center/scale=False "
+                "is not supported by the converter")
+        return [L.LayerNormalization(cfg.get("epsilon", 1e-3))]
     if t == "InputLayer":
         return []
     raise ValueError(f"Unsupported Keras layer type {t!r}")
 
 
+def _ordered_layers(km) -> List:
+    """Layers in forward (data-flow) order.
+
+    Keras ``Sequential``: ``km.layers`` as listed.  Functional
+    ``keras.Model``: the unique input→output chain, recovered from the
+    inbound-node graph; anything non-linear — multiple inputs/outputs, a
+    layer called twice, a merge (Add/Concatenate), a branch — is rejected
+    with a specific message rather than silently mis-converted.
+    """
+    keras = _require_keras()
+    if isinstance(km, keras.Sequential):
+        return list(km.layers)
+    if not isinstance(km, keras.Model):
+        raise TypeError(f"expected a keras.Model, got {type(km)!r}")
+    inputs = getattr(km, "inputs", None) or []
+    outputs = getattr(km, "outputs", None) or []
+    if len(inputs) != 1 or len(outputs) != 1:
+        raise ValueError(
+            f"only single-input single-output Keras models convert "
+            f"(got {len(inputs)} inputs, {len(outputs)} outputs)")
+    parents = {}
+    for kl in km.layers:
+        nodes = getattr(kl, "_inbound_nodes", [])
+        if len(nodes) != 1:
+            raise ValueError(
+                f"Keras layer {kl.name!r} is called {len(nodes)} times — "
+                "shared layers are not linear-chain convertible")
+        ps = [t._keras_history[0].name for t in nodes[0].input_tensors]
+        if len(ps) > 1:
+            raise ValueError(
+                f"Keras layer {kl.name!r} merges {len(ps)} inputs — "
+                "skip connections/merges are not linear-chain convertible")
+        parents[kl.name] = ps
+    child = {}
+    for name, ps in parents.items():
+        for p in ps:
+            if p in child:
+                raise ValueError(
+                    f"Keras layer {p!r} feeds both {child[p]!r} and "
+                    f"{name!r} — branching graphs are not linear-chain "
+                    "convertible")
+            child[p] = name
+    by_name = {kl.name: kl for kl in km.layers}
+    roots = [kl for kl in km.layers if not parents[kl.name]]
+    if len(roots) != 1:
+        raise ValueError(f"expected one root (InputLayer), found "
+                         f"{[r.name for r in roots]}")
+    chain = [roots[0]]
+    while chain[-1].name in child:
+        chain.append(by_name[child[chain[-1].name]])
+    if len(chain) != len(km.layers):
+        missing = sorted(set(by_name) - {kl.name for kl in chain})
+        raise ValueError(f"layers {missing} are not on the input→output "
+                         "chain — not a linear model")
+    out_name = outputs[0]._keras_history[0].name
+    if chain[-1].name != out_name:
+        raise ValueError(f"chain ends at {chain[-1].name!r} but the model "
+                         f"output comes from {out_name!r}")
+    return chain
+
+
 def convert_keras_model(km) -> Sequential:
-    """Convert a Keras Sequential to the native spec (no weights)."""
+    """Convert a Keras Sequential or linear-chain functional model to the
+    native spec (no weights)."""
     _require_keras()
     in_shape = getattr(km, "input_shape", None)
     if in_shape is None:
         raise ValueError("Keras model must be built (call it once or pass "
                          "input_shape) before conversion")
     native_layers: List[L.Layer] = []
-    for kl in km.layers:
+    for kl in _ordered_layers(km):
         native_layers.extend(_convert_layer(kl))
     return Sequential(native_layers, input_shape=tuple(in_shape[1:]),
                       name=getattr(km, "name", "converted"))
@@ -114,11 +189,12 @@ def keras_weights(km) -> List[np.ndarray]:
     Native leaves per layer are dict keys in sorted order
     (Dense: bias, kernel; BatchNorm: offset, scale, stats.mean, stats.var),
     while Keras ``get_weights`` returns [kernel, bias] / [gamma, beta,
-    moving_mean, moving_var].
+    moving_mean, moving_var].  Iterates the same forward order as
+    ``convert_keras_model`` (chain order for functional models).
     """
     _require_keras()
     out: List[np.ndarray] = []
-    for kl in km.layers:
+    for kl in _ordered_layers(km):
         t = type(kl).__name__
         w = [np.asarray(a) for a in kl.get_weights()]
         if t in ("Dense", "Conv2D"):
@@ -136,6 +212,13 @@ def keras_weights(km) -> List[np.ndarray]:
             out.extend([beta, gamma, mean, var])
         elif t == "Embedding":
             out.extend(w)
+        elif t == "LayerNormalization":
+            if len(w) != 2:
+                raise ValueError(
+                    f"LayerNormalization layer {kl.name!r} has {len(w)} "
+                    "weight arrays (expected 2: gamma, beta)")
+            gamma, beta = w
+            out.extend([beta, gamma])  # native sorted order: offset, scale
         elif w:
             raise ValueError(f"Unexpected weights on Keras layer {t!r}")
     return out

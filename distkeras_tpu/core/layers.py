@@ -563,6 +563,13 @@ class MultiHeadAttention(Layer):
             validate_rope_dim(self.key_dim)
             self.rope = True
         if rope_theta != 10000.0 or rope_scale != 1.0:
+            if not rope:
+                # the knobs only feed apply_rope; silently ignoring them
+                # would hide a config mistake
+                raise ValueError(
+                    f"rope_theta={rope_theta}/rope_scale={rope_scale} set "
+                    "but rope=False — pass rope=True to enable rotary "
+                    "embeddings, or drop the knobs")
             from ..ops.rope import validate_rope_scaling
             self.rope_theta, self.rope_scale = validate_rope_scaling(
                 rope_theta, rope_scale)
@@ -660,6 +667,11 @@ class TransformerBlock(Layer):
             validate_rope_dim(self.key_dim)  # eager, like MultiHeadAttention
             self.rope = True
         if rope_theta != 10000.0 or rope_scale != 1.0:
+            if not rope:
+                raise ValueError(
+                    f"rope_theta={rope_theta}/rope_scale={rope_scale} set "
+                    "but rope=False — pass rope=True to enable rotary "
+                    "embeddings, or drop the knobs")
             from ..ops.rope import validate_rope_scaling
             self.rope_theta, self.rope_scale = validate_rope_scaling(
                 rope_theta, rope_scale)

@@ -95,7 +95,15 @@ def kullback_leibler_divergence(y_true, y_pred):
 
 
 def hinge(y_true, y_pred):
-    # Keras convention: y_true in {-1, 1} (or {0, 1}, converted)
+    """Hinge loss with {0,1} labels auto-converted to {-1,1}.
+
+    DELIBERATE MODERNIZATION vs Keras-1: upstream Keras-1 performed no label
+    conversion (that arrived in Keras 2), so a reference workflow feeding
+    0/1 labels under this name effectively trained on a different objective
+    (the 0-label rows contribute a constant margin).  We adopt the Keras-2+
+    conversion because 0/1 one-hot labels are what this framework's own
+    pipeline produces; documented here and in docs/API.md.
+    """
     y_true = y_true.astype(jnp.float32)
     y_true = jnp.where(y_true == 0.0, -1.0, y_true)
     return jnp.mean(jnp.maximum(
@@ -103,6 +111,7 @@ def hinge(y_true, y_pred):
 
 
 def squared_hinge(y_true, y_pred):
+    # same deliberate {0,1}->{-1,1} modernization as ``hinge`` above
     y_true = y_true.astype(jnp.float32)
     y_true = jnp.where(y_true == 0.0, -1.0, y_true)
     return jnp.mean(jnp.square(jnp.maximum(
@@ -115,12 +124,20 @@ def poisson(y_true, y_pred):
 
 
 def cosine_proximity(y_true, y_pred):
-    # Keras-1 sign convention: minimizing drives vectors together (-1 best)
+    """Keras-1 cosine proximity, reduction included.
+
+    Keras-1 computed ``-mean(l2_normalize(y_true) * l2_normalize(y_pred))``
+    — the mean runs over ALL elements, not per-row, so a perfectly aligned
+    pair scores ``-1/feature_dim`` (NOT -1).  We reproduce that exactly so
+    migrated configs using this loss name keep the same values and gradient
+    scale as the reference (a per-row mean would be feature_dim x larger).
+    Minimizing still drives vectors together.
+    """
     yt = y_true.astype(jnp.float32)
     yp = y_pred.astype(jnp.float32)
     yt = yt / jnp.clip(jnp.linalg.norm(yt, axis=-1, keepdims=True), _EPS)
     yp = yp / jnp.clip(jnp.linalg.norm(yp, axis=-1, keepdims=True), _EPS)
-    return -jnp.mean(jnp.sum(yt * yp, axis=-1))
+    return -jnp.mean(yt * yp)
 
 
 def _from_logits(fn):

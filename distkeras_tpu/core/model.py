@@ -222,10 +222,11 @@ class FittedModel:
 
     def speculative_generate(self, draft: "FittedModel", prompt,
                              num_steps: int, draft_len: int = 4, **kw):
-        """Greedy decoding accelerated by a cheaper ``draft`` model —
-        bit-identical to ``generate`` (see
-        ``core.decode.speculative_generate``; ``**kw``: ``max_len``,
-        ``return_stats``)."""
+        """Decoding accelerated by a cheaper ``draft`` model — greedy by
+        default; with ``temperature``/``top_k``/``top_p``/``rng`` it is
+        distribution-exact speculative SAMPLING (see
+        ``core.decode.speculative_generate``; ``**kw`` also takes
+        ``max_len``, ``return_stats``)."""
         from .decode import speculative_generate
         return speculative_generate(self.model, self.params, draft.model,
                                     draft.params, prompt, num_steps,

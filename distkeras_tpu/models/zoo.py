@@ -49,6 +49,27 @@ def digits_mlp(compute_dtype: str = "bfloat16") -> Sequential:
     ], input_shape=(64,), compute_dtype=compute_dtype, name="digits_mlp")
 
 
+def digits_convnet(compute_dtype: str = "bfloat16") -> Sequential:
+    """ConvNet on the REAL sklearn-digits workload: flat 64-dim rows
+    reshaped to 8x8x1 through a small Conv2D stack — the conv analogue of
+    ``digits_mlp`` so the real-pixel accuracy-parity gate covers the
+    north-star MODEL FAMILY (MNIST ConvNet, SURVEY.md §6), not just an
+    MLP.  'same' padding keeps the tiny 8x8 plane from vanishing before
+    the pool."""
+    return Sequential([
+        Reshape((8, 8, 1)),
+        Conv2D(16, 3, activation="relu", padding="same"),
+        Conv2D(16, 3, activation="relu", padding="same"),
+        MaxPooling2D(2),
+        Conv2D(32, 3, activation="relu", padding="same"),
+        MaxPooling2D(2),
+        Flatten(),
+        Dense(64, activation="relu"),
+        Dense(10, activation="softmax"),
+    ], input_shape=(64,), compute_dtype=compute_dtype,
+        name="digits_convnet")
+
+
 def cifar10_convnet(compute_dtype: str = "bfloat16") -> Sequential:
     """Small ConvNet on 32x32x3 CIFAR-10 (reference DOWNPOUR config)."""
     return Sequential([

@@ -78,6 +78,10 @@ def apply_rope(x, positions, theta: float = 10000.0, scale: float = 1.0):
 def validate_rope_scaling(theta: float, scale: float):
     """The single rope_theta/rope_scale rule, shared by every constructor
     that exposes the context-extension knobs."""
+    if theta <= 0.0:
+        # theta**(-2i/d) is undefined/NaN for theta <= 0 and would only
+        # surface as silent NaNs at the first forward
+        raise ValueError(f"rope_theta must be > 0, got {theta}")
     if scale < 1.0:
         raise ValueError(f"rope_scale must be >= 1, got {scale}")
     return float(theta), float(scale)

@@ -126,5 +126,8 @@ def test_validation():
         beam_search(model, params, PROMPT, 4, eos_id=99)
     with pytest.raises(ValueError, match="pad_id"):
         beam_search(model, params, PROMPT, 4, pad_id=0)
+    with pytest.raises(ValueError, match="pad_id"):
+        # out of vocabulary range (ADVICE r4: mirror the eos_id check)
+        beam_search(model, params, PROMPT, 4, eos_id=1, pad_id=99)
     with pytest.raises(ValueError, match="positional"):
         beam_search(model, params, PROMPT, 30)  # past the context limit

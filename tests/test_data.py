@@ -264,3 +264,58 @@ def test_read_csv_native_big_multithreaded(tmp_path):
     bad.write_text("a,b,label\n1,2,0\n1,2\n")
     with pytest.raises(ValueError, match="fields"):
         datasets.read_csv(str(bad), label_column="label")
+
+
+def test_ingest_mnist_idx_roundtrip(tmp_path, monkeypatch):
+    """scripts/ingest_mnist_idx.py: fake IDX files -> mnist.npz ->
+    load_mnist serves the REAL pixels (data upgrade with zero code
+    changes, gzip and raw variants both parsed)."""
+    import gzip
+    import os
+    import struct
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    x_tr = rng.integers(0, 256, (32, 28, 28)).astype(np.uint8)
+    y_tr = rng.integers(0, 10, 32).astype(np.uint8)
+    x_te = rng.integers(0, 256, (8, 28, 28)).astype(np.uint8)
+    y_te = rng.integers(0, 10, 8).astype(np.uint8)
+
+    src = tmp_path / "idx"
+    src.mkdir()
+
+    def write_images(name, arr, gz):
+        blob = struct.pack(">IIII", 2051, len(arr), 28, 28) + arr.tobytes()
+        p = src / (name + (".gz" if gz else ""))
+        p.write_bytes(gzip.compress(blob) if gz else blob)
+
+    def write_labels(name, arr, gz):
+        blob = struct.pack(">II", 2049, len(arr)) + arr.tobytes()
+        p = src / (name + (".gz" if gz else ""))
+        p.write_bytes(gzip.compress(blob) if gz else blob)
+
+    write_images("train-images-idx3-ubyte", x_tr, gz=True)   # .gz variant
+    write_labels("train-labels-idx1-ubyte", y_tr, gz=False)  # raw variant
+    write_images("t10k-images-idx3-ubyte", x_te, gz=False)
+    write_labels("t10k-labels-idx1-ubyte", y_te, gz=True)
+
+    out = tmp_path / "data"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "ingest_mnist_idx.py"),
+         str(src), "--out", str(out)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert (out / "mnist.npz").exists()
+
+    from distkeras_tpu.data import datasets as dsmod
+    monkeypatch.setattr(dsmod, "_DATA_DIRS", [str(out)])
+    assert dsmod.has_real_data("mnist")
+    train, test = dsmod.load_mnist(n_train=32, n_test=8)
+    np.testing.assert_array_equal(
+        train["features"], x_tr.reshape(-1, 784).astype(np.float32))
+    np.testing.assert_array_equal(train["label"], y_tr.astype(np.int64))
+    np.testing.assert_array_equal(test["label"], y_te.astype(np.int64))

@@ -198,6 +198,9 @@ def test_generate_eos_stopping(increment_lm):
     # out-of-vocab eos could never trigger: refused, not silently ignored
     with pytest.raises(ValueError, match="eos_id"):
         generate(model, params, prompt, 2, eos_id=16)
+    # out-of-vocab pad would be silently clamped by scatter/gather: refuse
+    with pytest.raises(ValueError, match="pad_id"):
+        generate(model, params, prompt, 2, eos_id=7, pad_id=16)
 
 
 def test_jit_decode_step_entry_point():

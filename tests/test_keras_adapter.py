@@ -95,3 +95,104 @@ def test_unsupported_layer_raises():
     ])
     with pytest.raises(ValueError, match="Unsupported Keras layer"):
         convert_keras_model(km)
+
+
+def make_functional_convnet():
+    """The reference's own MNIST-ConvNet idiom was a FUNCTIONAL model
+    (SURVEY.md §2.1 rows 1/12) — a linear chain built with the functional
+    API, not keras.Sequential."""
+    inp = keras.layers.Input((8, 8, 1))
+    h = keras.layers.Conv2D(4, 3, padding="same", activation="relu")(inp)
+    h = keras.layers.MaxPooling2D(2)(h)
+    h = keras.layers.Conv2D(8, 3, padding="valid", activation="relu")(h)
+    h = keras.layers.Flatten()(h)
+    h = keras.layers.Dense(16, activation="relu")(h)
+    h = keras.layers.Dropout(0.1)(h)
+    out = keras.layers.Dense(4, activation="softmax")(h)
+    return keras.Model(inp, out)
+
+
+def test_functional_convnet_forward_matches_keras():
+    km = make_functional_convnet()
+    x = np.random.default_rng(4).standard_normal((4, 8, 8, 1)).astype(
+        np.float32)
+    want = np.asarray(km(x, training=False))
+    native, params = convert_with_weights(km)
+    native.compute_dtype = "float32"
+    got = np.asarray(native.apply(params, x))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_functional_layernorm_matches_keras():
+    inp = keras.layers.Input((16,))
+    h = keras.layers.Dense(8)(inp)
+    h = keras.layers.LayerNormalization(epsilon=1e-5)(h)
+    out = keras.layers.Dense(4)(h)
+    km = keras.Model(inp, out)
+    km.layers[2].set_weights([  # non-trivial gamma/beta
+        np.linspace(0.5, 1.5, 8).astype(np.float32),
+        np.linspace(-0.2, 0.2, 8).astype(np.float32)])
+    x = np.random.default_rng(5).standard_normal((6, 16)).astype(np.float32)
+    want = np.asarray(km(x, training=False))
+    native, params = convert_with_weights(km)
+    native.compute_dtype = "float32"
+    np.testing.assert_allclose(np.asarray(native.apply(params, x)), want,
+                               atol=1e-5)
+
+
+def test_functional_trains_and_matches_sequential_twin():
+    """A functional model and its layer-identical Sequential twin convert
+    to the same native spec; transplant the SAME keras weights into both
+    and a short deterministic training run stays identical."""
+    from distkeras_tpu.core.keras_adapter import keras_weights
+
+    km_f = make_functional_convnet()
+    km_s = keras.Sequential([
+        keras.layers.Input((8, 8, 1)),
+        keras.layers.Conv2D(4, 3, padding="same", activation="relu"),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Conv2D(8, 3, padding="valid", activation="relu"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dropout(0.1),
+        keras.layers.Dense(4, activation="softmax"),
+    ])
+    km_s.set_weights(km_f.get_weights())  # same starting point
+
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((128, 8, 8, 1)).astype(np.float32)
+    labels = rng.integers(0, 4, 128)
+    y = np.eye(4, dtype=np.float32)[labels]
+
+    def fit(km):
+        t = SingleTrainer(km, batch_size=32, num_epoch=3,
+                          worker_optimizer="sgd", learning_rate=0.1, seed=0)
+        f = t.train(Dataset({"features": x, "label": y}))
+        return t, f
+
+    tf_, ff = fit(km_f)
+    ts_, fs = fit(km_s)
+    np.testing.assert_allclose(tf_.history, ts_.history, rtol=1e-6)
+    np.testing.assert_allclose(ff.predict(x[:16]), fs.predict(x[:16]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_nonlinear_graphs_rejected():
+    # skip connection (merge)
+    inp = keras.layers.Input((16,))
+    h = keras.layers.Dense(16, activation="relu")(inp)
+    out = keras.layers.Add()([inp, h])
+    with pytest.raises(ValueError, match="merge"):
+        convert_keras_model(keras.Model(inp, out))
+    # shared layer (called twice)
+    inp2 = keras.layers.Input((16,))
+    shared = keras.layers.Dense(16)
+    out2 = shared(shared(inp2))
+    with pytest.raises(ValueError, match="called 2 times"):
+        convert_keras_model(keras.Model(inp2, out2))
+    # multi-output
+    inp3 = keras.layers.Input((16,))
+    a = keras.layers.Dense(4)(inp3)
+    b = keras.layers.Dense(2)(inp3)
+    with pytest.raises(ValueError, match="outputs"):
+        convert_keras_model(keras.Model(inp3, [a, b]))

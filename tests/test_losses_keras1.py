@@ -55,11 +55,17 @@ def test_poisson_and_msle_and_mape():
 
 
 def test_cosine_proximity_extremes():
+    # Keras-1 reduction: mean over ALL elements, so a perfectly aligned
+    # dim-2 pair scores -1/2, not -1 (ADVICE r4: gradient-scale parity for
+    # migrated configs)
     a = jnp.asarray([[1.0, 0.0]])
-    assert float(get_loss("cosine")(a, a)) == pytest.approx(-1.0)
+    assert float(get_loss("cosine")(a, a)) == pytest.approx(-0.5)
     assert float(get_loss("cosine")(a, jnp.asarray([[0.0, 1.0]]))) == \
         pytest.approx(0.0, abs=1e-6)
-    assert float(get_loss("cosine")(a, -a)) == pytest.approx(1.0)
+    assert float(get_loss("cosine")(a, -a)) == pytest.approx(0.5)
+    # row-count invariance of the global mean: duplicating rows is a no-op
+    two = jnp.concatenate([a, a])
+    assert float(get_loss("cosine")(two, two)) == pytest.approx(-0.5)
 
 
 def test_all_new_names_resolve_and_reduce_to_scalar():

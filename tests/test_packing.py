@@ -216,14 +216,62 @@ def test_single_trainer_packed_path():
     want = (prompt[:, -1:] + np.arange(1, 6) - 1) % 31 + 1
     np.testing.assert_array_equal(out[:, 3:], want)
 
-    import pytest as _pytest
-    with _pytest.raises(ValueError, match="validation_data"):
+
+def test_packed_validation_matches_unpacked():
+    """Packed validation (round-4 VERDICT weak #4): ``validation_data``
+    with ``segment_col`` runs through the masked loss with segment
+    isolation, and the packed val loss equals the SAME documents evaluated
+    unpacked one-row-per-document (RoPE + segment mask make the two
+    forwards identical; the masked mean runs over the same label set)."""
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.trainers import SingleTrainer
+
+    rng = np.random.default_rng(7)
+    seq_len = 16
+    docs = [list(rng.integers(1, 32, int(rng.integers(3, 8))))
+            for _ in range(24)]
+    train_docs, val_docs = docs[:16], docs[16:]
+    tok_tr, seg_tr = pack_documents(train_docs, seq_len)
+    lab_tr = packed_lm_labels(tok_tr, seg_tr)
+    tok_v, seg_v = pack_documents(val_docs, seq_len)
+    lab_v = packed_lm_labels(tok_v, seg_v)
+
+    model = lm(seq_len=seq_len)
+    t = SingleTrainer(
+        model, batch_size=8, num_epoch=1,
+        loss="sparse_categorical_crossentropy_masked_from_logits",
+        worker_optimizer="adam", learning_rate=1e-3,
+        segment_col="segment_ids")
+    fitted = t.train(
+        Dataset({"features": tok_tr, "label": lab_tr,
+                 "segment_ids": seg_tr}),
+        validation_data=Dataset({"features": tok_v, "label": lab_v,
+                                 "segment_ids": seg_v}))
+    assert len(t.validation_history) == 1
+
+    # unpacked equivalent: one row per validation document
+    n = len(val_docs)
+    tok_u = np.zeros((n, seq_len), np.int32)
+    seg_u = np.zeros((n, seq_len), np.int32)
+    for i, d in enumerate(val_docs):
+        tok_u[i, :len(d)] = d
+        seg_u[i, :len(d)] = 1
+    lab_u = packed_lm_labels(tok_u, seg_u)
+    loss = get_loss("sparse_categorical_crossentropy_masked_from_logits")
+    pred = fitted.model.apply(fitted.params, jnp.asarray(tok_u),
+                              segment_ids=jnp.asarray(seg_u))
+    want = float(loss(jnp.asarray(lab_u), pred))
+    np.testing.assert_allclose(t.validation_history[0], want,
+                               rtol=2e-4, atol=2e-4)
+
+    # validation data missing the segment column is still refused
+    with pytest.raises(ValueError, match="segment"):
         t2 = SingleTrainer(model, segment_col="segment_ids",
                            loss="sparse_categorical_crossentropy_masked")
-        t2.train(Dataset({"features": tokens, "label": labels,
-                          "segment_ids": segs}),
-                 validation_data=Dataset({"features": tokens,
-                                          "label": labels}))
+        t2.train(Dataset({"features": tok_tr, "label": lab_tr,
+                          "segment_ids": seg_tr}),
+                 validation_data=Dataset({"features": tok_v,
+                                          "label": lab_v}))
 
 
 def test_segment_col_requires_masked_loss():

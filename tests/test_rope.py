@@ -189,3 +189,21 @@ def test_parallel_lm_threads_rope_scaling(eight_devices):
     assert abs(base - scaled) > 1e-6
     with pytest.raises(ValueError, match="rope_scale"):
         loss_of(rope_scale=0.5)
+
+
+def test_rope_theta_and_knob_guards():
+    """ADVICE r4: theta <= 0 must raise eagerly (not NaN at first forward),
+    and rope knobs without rope=True must raise instead of silently no-op."""
+    from distkeras_tpu.core.layers import MultiHeadAttention, TransformerBlock
+    from distkeras_tpu.ops.rope import validate_rope_scaling
+    with pytest.raises(ValueError, match="rope_theta"):
+        validate_rope_scaling(0.0, 1.0)
+    with pytest.raises(ValueError, match="rope_theta"):
+        validate_rope_scaling(-10000.0, 2.0)
+    with pytest.raises(ValueError, match="rope=False"):
+        MultiHeadAttention(2, 4, rope_theta=50000.0)
+    with pytest.raises(ValueError, match="rope=False"):
+        TransformerBlock(2, 4, 8, rope_scale=2.0)
+    # the valid combinations still construct
+    MultiHeadAttention(2, 4, rope=True, rope_theta=50000.0, rope_scale=2.0)
+    TransformerBlock(2, 4, 8, rope=True, rope_theta=50000.0)
