@@ -266,6 +266,23 @@ def _validate_sampling(temperature: float, rng,
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
 
+def _validate_stopping(eos_id: Optional[int], pad_id: Optional[int],
+                       vocab: Optional[int]):
+    """The one eos_id/pad_id rule set, shared by ``generate`` and
+    ``beam_search``.  Out-of-range ids would be silently clamped by the
+    ``.at[].set`` scatter and the embedding gather — refuse instead."""
+    if pad_id is not None and eos_id is None:
+        raise ValueError("pad_id only means something with eos_id")
+    if eos_id is not None and vocab is not None \
+            and not 0 <= eos_id < vocab:
+        raise ValueError(f"eos_id {eos_id} outside the model's vocabulary "
+                         f"[0, {vocab}) — stopping could never trigger")
+    if pad_id is not None and vocab is not None \
+            and not 0 <= pad_id < vocab:
+        raise ValueError(f"pad_id {pad_id} outside the model's vocabulary "
+                         f"[0, {vocab})")
+
+
 def _filter_logits(logits, top_k: Optional[int], top_p: Optional[float]):
     """Restrict a (B, V) logit row to the top-k tokens and/or the smallest
     nucleus whose probability mass reaches top_p (the top token always
@@ -350,20 +367,7 @@ def generate(model, params, prompt, num_steps: int,
             f"prompt ({p_len}) + num_steps ({num_steps}) = {total} exceeds "
             f"the model's positional-embedding range {limit}")
     _validate_sampling(temperature, rng, top_k, top_p)
-    if pad_id is not None and eos_id is None:
-        raise ValueError("pad_id only means something with eos_id")
-    if eos_id is not None:
-        vocab = _vocab_size(model)
-        if vocab is not None and not 0 <= eos_id < vocab:
-            raise ValueError(
-                f"eos_id {eos_id} outside the model's vocabulary "
-                f"[0, {vocab}) — stopping could never trigger")
-        if pad_id is not None and vocab is not None \
-                and not 0 <= pad_id < vocab:
-            # same rule as eos_id: without it the .at[].set scatter and the
-            # embedding gather silently clamp an out-of-range pad token
-            raise ValueError(f"pad_id {pad_id} outside the model's "
-                             f"vocabulary [0, {vocab})")
+    _validate_stopping(eos_id, pad_id, _vocab_size(model))
     if rolling:
         # the prefill below still uses a full P-slot cache (one batched
         # forward), which then collapses to rings — peak memory O(P + W),
@@ -676,17 +680,7 @@ def beam_search(model, params, prompt, num_steps: int, num_beams: int = 4,
             f"prompt ({p_len}) + num_steps ({num_steps}) = {total} exceeds "
             f"the model's positional-embedding range {limit}")
     vocab = _vocab_size(model)
-    if eos_id is not None and vocab is not None \
-            and not 0 <= eos_id < vocab:
-        raise ValueError(f"eos_id {eos_id} outside the model's vocabulary "
-                         f"[0, {vocab})")
-    if pad_id is not None and eos_id is None:
-        raise ValueError("pad_id only means something with eos_id")
-    if pad_id is not None and vocab is not None \
-            and not 0 <= pad_id < vocab:
-        # mirror the eos_id range check: scatter/gather would silently clamp
-        raise ValueError(f"pad_id {pad_id} outside the model's vocabulary "
-                         f"[0, {vocab})")
+    _validate_stopping(eos_id, pad_id, vocab)
     pad = jnp.int32(pad_id if pad_id is not None else (eos_id or 0))
 
     # prefill once at batch B, then tile every cache to B·k rows laid out

@@ -1,0 +1,152 @@
+"""ParallelTransformerLM train-step MFU on one chip — the artifact the
+transformer stack was missing.
+
+Round-4 VERDICT missing #1: the ConvNet north-star had a hardware MFU
+number but the stack where MFU engineering actually pays (the
+beyond-parity transformer path) had none.  This bench compiles the
+``ParallelTransformerLM`` train step on a single-chip (1,1,1) mesh and
+measures steady-state step time across a batch × seq_len sweep with
+``fused_ce`` off and on, reporting tokens/sec and analytic MFU.
+
+FLOP accounting (forward, per token; ×3 for backward — the same
+convention as ``metrics.flops_per_example``):
+  per layer: qkv+out projections ``2d(inner + 2·inner_kv) + 2·inner·d``,
+  attention score/value matmuls ``2·2·ctx·inner`` (ctx = full S, the
+  PaLM-style convention — causality would halve it), MLP ``4·d·mlp``;
+  plus the logits matmul ``2·d·V``.
+
+Run:  python scripts/bench_transformer.py [--quick]
+``--quick`` = tiny shapes on CPU (smoke only, artifact not written).
+On an accelerator the results land in ``TRANSFORMER_TPU.json`` (same
+preserve-the-hardware-signal policy as BENCH_TPU.json / KERNELS_TPU.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from distkeras_tpu.utils import honor_platform_env  # noqa: E402
+
+honor_platform_env()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def lm_train_flops_per_token(lm) -> float:
+    """Analytic matmul FLOPs to TRAIN one token (forward ×3)."""
+    d, s, v = lm.d_model, lm.seq_len, lm.vocab_size
+    inner = lm.num_heads * (d // lm.num_heads)
+    inner_kv = lm.num_kv_heads * (d // lm.num_heads)
+    win = lm.attention_window
+    ctx = float(min(s, win + 1)) if win is not None else float(s)
+    per_layer = (2.0 * d * (inner + 2.0 * inner_kv)   # q, k, v proj
+                 + 2.0 * inner * d                    # out proj
+                 + 2.0 * 2.0 * ctx * inner            # qk^T, scores@v
+                 + 2.0 * d * lm.mlp_dim * 2.0)        # mlp in + out
+    return 3.0 * (lm.num_layers * per_layer + 2.0 * d * v)
+
+
+def bench_config(mesh, *, batch, seq, fused_ce, cfg, reps, optax):
+    from distkeras_tpu.parallel.transformer import ParallelTransformerLM
+
+    lm = ParallelTransformerLM(mesh=mesh, seq_len=seq, fused_ce=fused_ce,
+                               **cfg)
+    params = lm.init(jax.random.PRNGKey(0))
+    opt_state, step = lm.compile_train_step(optax.adam(1e-3), params)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, lm.vocab_size, (batch, seq)).astype(np.int32)
+    labels = (toks + 1) % lm.vocab_size
+    sh = lm.batch_sharding()
+    toks, labels = jax.device_put(toks, sh), jax.device_put(labels, sh)
+
+    params, opt_state, loss = step(params, opt_state, toks, labels)
+    float(loss)                                     # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        params, opt_state, loss = step(params, opt_state, toks, labels)
+    float(loss)                                     # one sync for the run
+    dt = (time.perf_counter() - t0) / reps
+    return lm, dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny CPU smoke (no artifact)")
+    ap.add_argument("--batches", default=None,
+                    help="comma list; default 8,16,32 (quick: 2)")
+    ap.add_argument("--seqs", default=None,
+                    help="comma list; default 512,1024,2048 (quick: 64)")
+    ap.add_argument("--reps", type=int, default=None)
+    args = ap.parse_args()
+
+    import optax
+    from jax.sharding import Mesh
+    from distkeras_tpu.metrics import peak_flops
+
+    quick = args.quick
+    batches = [int(b) for b in (args.batches or
+                                ("2" if quick else "8,16,32")).split(",")]
+    seqs = [int(s) for s in (args.seqs or
+                             ("64" if quick else "512,1024,2048")).split(",")]
+    reps = args.reps or (2 if quick else 20)
+    cfg = (dict(vocab_size=512, d_model=64, num_heads=4, num_layers=2,
+                mlp_dim=128, compute_dtype=np.float32) if quick else
+           dict(vocab_size=32768, d_model=512, num_heads=8, num_layers=8,
+                mlp_dim=2048, positional="rope"))
+
+    dev = jax.devices()[0]
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1),
+                ("data", "seq", "model"))
+    peak = peak_flops(dev.device_kind)
+
+    rows = []
+    for fused in (False, True):
+        for seq in seqs:
+            for batch in batches:
+                lm, dt = bench_config(mesh, batch=batch, seq=seq,
+                                      fused_ce=fused, cfg=cfg, reps=reps,
+                                      optax=optax)
+                f_tok = lm_train_flops_per_token(lm)
+                tps = batch * seq / dt
+                row = {
+                    "batch": batch, "seq": seq, "fused_ce": fused,
+                    "step_ms": round(dt * 1e3, 3),
+                    "tokens_per_sec": round(tps, 1),
+                    "flops_per_token": f_tok,
+                    "mfu": (round(tps * f_tok / peak, 4)
+                            if peak else None),
+                }
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+
+    best = max(rows, key=lambda r: r["tokens_per_sec"])
+    out = {
+        "captured_unix": round(time.time(), 1),
+        "platform": dev.platform, "device_kind": dev.device_kind,
+        "model": {k: v for k, v in cfg.items() if k != "compute_dtype"},
+        "compute_dtype": "float32" if quick else "bfloat16",
+        "reps": reps,
+        "grid": rows,
+        "best": best,
+    }
+    if dev.platform != "cpu":
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "TRANSFORMER_TPU.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+            f.write("\n")
+        print(f"wrote {path}", file=sys.stderr)
+    print(json.dumps({"best": best, "platform": dev.platform}))
+
+
+if __name__ == "__main__":
+    main()

@@ -132,10 +132,20 @@ def main():
     n_params = int(sum(np.prod(l.shape)
                        for l in jax.tree_util.tree_leaves(params)))
 
+    usable = [n for n in device_counts if n <= len(jax.devices())]
+    for n in sorted(set(device_counts) - set(usable)):
+        print(f"[bench_window] WARNING: skipping n_devices={n} — only "
+              f"{len(jax.devices())} device(s) visible; the written "
+              "artifact will lack those rows", file=sys.stderr)
+    if not usable:
+        # e.g. a pre-set XLA_FLAGS suppressed the virtual-device forcing:
+        # refuse rather than clobber WINDOW_SWEEP.json with an empty grid
+        raise SystemExit(
+            f"no requested mesh size {device_counts} fits the "
+            f"{len(jax.devices())} visible device(s) — check XLA_FLAGS "
+            "includes --xla_force_host_platform_device_count=8")
     grid = []
-    for n in device_counts:
-        if n > len(jax.devices()):
-            continue
+    for n in usable:
         mesh = get_mesh(num_workers=n)
         t_ex = measure_exchange(mesh, params)
         t_step = measure_step(mesh, model, batch, window=4)

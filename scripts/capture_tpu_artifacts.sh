@@ -1,0 +1,35 @@
+#!/bin/bash
+# One-shot on-silicon artifact capture — run the moment the TPU tunnel is up.
+#
+# Round-4 VERDICT missing #1: the transformer/serving stack had zero hardware
+# numbers.  This captures, in priority order (most-wanted first, so a tunnel
+# that drops mid-run still leaves the top artifacts):
+#   1. KERNELS_TPU.json      — flash attention + KV-decode microbenches
+#   2. SMOKE_TPU.json        — timestamped pass log of the on-chip smoke suite
+#   3. TRANSFORMER_TPU.json  — ParallelTransformerLM train-step MFU sweep
+#   4. BENCH_TPU.json        — north-star ConvNet refresh (bench.py)
+# Continues past individual failures; prints a summary. Artifacts are written
+# into the repo root for committing.
+set -u
+cd "$(dirname "$0")/.."
+LOG="${TPU_CAPTURE_LOG:-/tmp/tpu_capture.log}"
+summary=()
+
+run() {
+  local name="$1"; shift
+  echo "[capture $(date +%H:%M:%S)] $name: $*" | tee -a "$LOG"
+  if timeout "${TPU_CAPTURE_TIMEOUT:-1200}" "$@" >> "$LOG" 2>&1; then
+    summary+=("$name: OK")
+  else
+    summary+=("$name: FAILED (rc=$?)")
+  fi
+}
+
+run kernels      python scripts/bench_kernels.py
+run smoke        python scripts/run_tpu_smoke.py
+run transformer  python scripts/bench_transformer.py
+run bench        python bench.py
+
+echo "== capture summary =="
+printf '%s\n' "${summary[@]}"
+ls -la KERNELS_TPU.json SMOKE_TPU.json TRANSFORMER_TPU.json BENCH_TPU.json 2>/dev/null
