@@ -71,7 +71,8 @@ class SPMDEngine:
                  alpha: Optional[float] = None,
                  lr_schedule=None, schedule_steps: Optional[int] = None,
                  gradient_accumulation: int = 1,
-                 gradient_clip_norm=None):
+                 gradient_clip_norm=None,
+                 packed: bool = False):
         self.model = model
         self.loss_fn = get_loss(loss)
         self.mesh = mesh
@@ -84,6 +85,11 @@ class SPMDEngine:
         self.schedule_steps = schedule_steps
         self.gradient_accumulation = int(gradient_accumulation)
         self.gradient_clip_norm = gradient_clip_norm
+        # packed=True: the epoch/round programs additionally scan a
+        # segment-ids array (sequence packing, data/packing.py) threaded
+        # into the masked step's forward so attention keeps per-document
+        # isolation — the distributed twin of SingleTrainer(segment_col=…)
+        self.packed = bool(packed)
         self.tx = None  # built in init_state (needs params for masking)
         self._epoch_fn = None
         self._round_step = None
@@ -125,26 +131,30 @@ class SPMDEngine:
                                      jnp.int32))
 
     # -- the per-round SPMD body ---------------------------------------------
-    def _local_window(self, params, opt_state, xw, yw, mw, rng):
+    def _local_window(self, params, opt_state, xw, yw, mw, rng, sw=None):
         """Run ``window`` minibatch steps on one worker's shard (in-graph).
 
         ``mw``: (window, batch) per-example weights — 1 for real rows, 0 for
         the wrap-padding ``shape_epoch_data`` adds to fill the tail round.
-        Returns the example-weighted loss sum and the weight sum so the
-        caller can form an exact mean over *real* examples only.
+        ``sw`` (packed engines): (window, batch, S) segment ids threaded
+        into the forward.  Returns the example-weighted loss sum and the
+        weight sum so the caller can form an exact mean over *real*
+        examples only.
         """
         from ..core.train import make_masked_step
         step = make_masked_step(self.model, self.loss_fn, self.tx)
+        packed = sw is not None
 
         def body(carry, inp):
             p, s, key = carry
-            x, y, w = inp
+            x, y, seg, w = inp if packed else inp[:2] + (None,) + inp[2:]
             key, sub = jax.random.split(key)
-            p, s, l, wsum = step(p, s, x, y, w, sub)
+            p, s, l, wsum = step(p, s, x, y, w, sub, seg)
             return (p, s, key), (l, wsum)
 
+        xs = (xw, yw, sw, mw) if packed else (xw, yw, mw)
         (params, opt_state, _), (losses, wsums) = jax.lax.scan(
-            body, (params, opt_state, rng), (xw, yw, mw))
+            body, (params, opt_state, rng), xs)
         return params, opt_state, jnp.sum(losses * wsums), jnp.sum(wsums)
 
     def _sync_stats(self, new_p, center):
@@ -174,18 +184,22 @@ class SPMDEngine:
         algo = self.algorithm
         alpha = self.alpha
 
-        def round_fn(center, local, opt_state, round_idx, xw, yw, mw, rngs):
+        packed = self.packed
+
+        def round_fn(center, local, opt_state, round_idx, xw, yw, *rest):
             # Block shapes inside shard_map: local/opt_state leaves and the
             # rng carry a leading worker axis of size 1; the batch data is
             # (window, workers=1, batch, ...) — squeeze the *worker* axis in
             # each (xw[:, 0], NOT xw[0]: that would squeeze the window axis
             # and silently train on only the first batch of every window).
+            (sw, mw, rngs) = rest if packed else (None,) + rest
             squeeze = lambda t: tmap(lambda v: v[0], t)
             local_p = squeeze(local)
             opt_s = squeeze(opt_state)
             x = xw[:, 0]
             y = yw[:, 0]
             m = mw[:, 0]
+            s = sw[:, 0] if packed else None
             rng = rngs[0]
 
             if algo in ("adag", "downpour", "dynsgd"):
@@ -197,7 +211,7 @@ class SPMDEngine:
             else:  # EASGD family + 'local' keep persistent local params
                 start = local_p
             new_p, new_s, loss_sum, wsum = self._local_window(
-                start, opt_s, x, y, m, rng)
+                start, opt_s, x, y, m, rng, s)
             if algo != "local" and self.model.has_stats():
                 # 'local' = independent training: per-worker stats persist
                 new_p, center = self._sync_stats(new_p, center)
@@ -246,74 +260,87 @@ class SPMDEngine:
     def _shmapped_round(self) -> Callable:
         """The single shard_map'd round program — the one contract both the
         scanned epoch and the streaming path execute."""
+        data_spec = (P(None, WORKER_AXIS),) * (4 if self.packed else 3)
         return jax.shard_map(
             self._make_round_fn(),
             mesh=self.mesh,
-            in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(),
-                      P(None, WORKER_AXIS), P(None, WORKER_AXIS),
-                      P(None, WORKER_AXIS), P(WORKER_AXIS)),
+            in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P())
+            + data_spec + (P(WORKER_AXIS),),
             out_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P()),
         )
 
     @staticmethod
-    def _run_round(shmapped, state: DistState, x, y, m, rngs):
+    def _run_round(shmapped, state: DistState, data, rngs):
         """One round: fold the per-worker keys with the round clock, execute,
-        re-wrap the state (shared by epoch scan and streaming)."""
+        re-wrap the state (shared by epoch scan and streaming).  ``data`` =
+        (x, y, m) or (x, y, seg, m) on the packed engine."""
         keys = jax.vmap(
             lambda k: jax.random.fold_in(k, state.round_idx))(rngs)
         center, local, opt_state, loss = shmapped(
             state.center, state.local, state.opt_state, state.round_idx,
-            x, y, m, keys)
+            *data, keys)
         return (DistState(center, local, opt_state, state.round_idx + 1),
                 loss)
 
     def _build_epoch_fn(self) -> Callable:
         shmapped = self._shmapped_round()
 
-        def epoch(state: DistState, xb, yb, mb, rngs):
-            # xb, yb, mb: (rounds, window, workers, batch, ...) on axis 2
+        def epoch(state: DistState, xb, yb, *rest):
+            # xb, yb, [sb,] mb: (rounds, window, workers, batch, ...) on
+            # axis 2; rngs last
+            *data_rest, rngs = rest
+
             def body(st, inp):
-                st, loss = self._run_round(shmapped, st, inp[0], inp[1],
-                                           inp[2], rngs)
+                st, loss = self._run_round(shmapped, st, inp, rngs)
                 return st, loss
 
-            return jax.lax.scan(body, state, (xb, yb, mb))
+            return jax.lax.scan(body, state, (xb, yb) + tuple(data_rest))
 
         return jax.jit(epoch, donate_argnums=(0,))
 
-    def run_epoch(self, state: DistState, xb, yb, mb, rngs
+    def run_epoch(self, state: DistState, xb, yb, mb, rngs, sb=None
                   ) -> Tuple[DistState, np.ndarray]:
         """xb/yb/mb: np arrays shaped (rounds, window, workers, batch, ...);
         ``mb`` is the per-example real/padding mask from
-        ``shape_epoch_data``."""
+        ``shape_epoch_data``; ``sb`` (packed engines) the segment ids."""
+        self._check_packed(sb)
         if self._epoch_fn is None:
             self._epoch_fn = self._build_epoch_fn()
         sh = NamedSharding(self.mesh, P(None, None, WORKER_AXIS))
-        xb = jax.device_put(xb, sh)
-        yb = jax.device_put(yb, sh)
-        mb = jax.device_put(mb, sh)
-        state, losses = self._epoch_fn(state, xb, yb, mb, rngs)
+        arrays = (xb, yb) + ((sb,) if self.packed else ()) + (mb,)
+        arrays = tuple(jax.device_put(a, sh) for a in arrays)
+        state, losses = self._epoch_fn(state, *arrays, rngs)
         return state, losses
 
-    def run_round(self, state: DistState, x, y, m, rngs
+    def run_round(self, state: DistState, x, y, m, rngs, s=None
                   ) -> Tuple[DistState, jnp.ndarray]:
         """One jitted round from host arrays shaped (window, workers, batch,
         ...) — the round-granular checkpointing path.  Same math as the
         epoch scan (both execute the one shard_map'd round program), at the
         cost of one jit call + device_put per round."""
+        self._check_packed(s)
         if self._round_step is None:
             self._round_step = self._build_round_step()
         sh = NamedSharding(self.mesh, P(None, WORKER_AXIS))
-        return self._round_step(state, jax.device_put(x, sh),
-                                jax.device_put(y, sh),
-                                jax.device_put(m, sh), rngs)
+        data = (x, y) + ((s,) if self.packed else ()) + (m,)
+        return self._round_step(state,
+                                *(jax.device_put(a, sh) for a in data),
+                                rngs)
+
+    def _check_packed(self, seg):
+        if self.packed and seg is None:
+            raise ValueError("packed engine needs segment ids")
+        if seg is not None and not self.packed:
+            raise ValueError("segment ids passed to an unpacked engine — "
+                             "construct SPMDEngine(packed=True)")
 
     # -- streaming epoch (datasets larger than HBM) ---------------------------
     def _build_round_step(self) -> Callable:
         shmapped = self._shmapped_round()
 
-        def step(state: DistState, x, y, m, rngs):
-            return self._run_round(shmapped, state, x, y, m, rngs)
+        def step(state: DistState, *args):
+            *data, rngs = args
+            return self._run_round(shmapped, state, tuple(data), rngs)
 
         return jax.jit(step, donate_argnums=(0,))
 
@@ -326,6 +353,9 @@ class SPMDEngine:
         epoch — for datasets that cannot live in HBM whole.
         """
         from ..data.pipeline import prefetch_to_device
+        if self.packed:
+            raise ValueError("streaming epochs are not wired for packed "
+                             "engines yet — use run_epoch")
         if self._round_step is None:
             self._round_step = self._build_round_step()
         sh = NamedSharding(self.mesh, P(None, WORKER_AXIS))
@@ -343,7 +373,8 @@ class SPMDEngine:
 
 
 def shape_epoch_data(columns_x: np.ndarray, columns_y: np.ndarray,
-                     num_workers: int, window: int, batch_size: int):
+                     num_workers: int, window: int, batch_size: int,
+                     columns_seg: Optional[np.ndarray] = None):
     """Reshape flat (rows, ...) arrays into (rounds, window, workers, batch,
     ...) plus a per-example mask, padding the tail to a whole round.
 
@@ -361,7 +392,9 @@ def shape_epoch_data(columns_x: np.ndarray, columns_y: np.ndarray,
     of rows to workers so padding never concentrates on one worker) lives in
     ``data.pipeline.round_block``, shared with the streaming path.
 
-    Returns ``(xb, yb, mask, rounds)``; every real row appears exactly once.
+    Returns ``(xb, yb, mask, rounds)``, or ``(xb, yb, sb, mask, rounds)``
+    when ``columns_seg`` (sequence-packing segment ids, same row order) is
+    given; every real row appears exactly once.
     """
     from ..data.pipeline import num_rounds, round_block
     n, w, b = num_workers, window, batch_size
@@ -370,4 +403,7 @@ def shape_epoch_data(columns_x: np.ndarray, columns_y: np.ndarray,
     mask = np.empty((rounds, w, n, b), np.float32)
     for r in range(rounds):
         sel[r], mask[r] = round_block(len(columns_x), n, w, b, r)
+    if columns_seg is not None:
+        return (columns_x[sel], columns_y[sel], columns_seg[sel], mask,
+                rounds)
     return columns_x[sel], columns_y[sel], mask, rounds

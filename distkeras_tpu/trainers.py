@@ -308,11 +308,16 @@ class DistributedTrainer(Trainer):
                  early_stopping_patience: Optional[int] = None,
                  early_stopping_min_delta: float = 0.0,
                  fault_tolerance: bool = False,
-                 fault_injection: Optional[dict] = None):
+                 fault_injection: Optional[dict] = None,
+                 segment_col: Optional[str] = None):
         super().__init__(keras_model, loss, worker_optimizer, learning_rate,
                          seed, lr_schedule, gradient_accumulation,
                          gradient_clip_norm,
                          early_stopping_patience, early_stopping_min_delta)
+        # sequence packing on the distributed engine (the SPMD twin of
+        # SingleTrainer(segment_col=…)): name of the segment-ids column;
+        # needs a *_masked loss, SPMD execution only
+        self.segment_col = segment_col
         self.mesh = mesh if mesh is not None else mesh_lib.get_mesh(num_workers)
         self.num_workers = int(self.mesh.devices.size)
         self.batch_size = int(batch_size)
@@ -367,7 +372,8 @@ class DistributedTrainer(Trainer):
             alpha=self._elastic_alpha(), lr_schedule=self.lr_schedule,
             schedule_steps=getattr(self, "_schedule_steps", None),
             gradient_accumulation=self.gradient_accumulation,
-            gradient_clip_norm=self.gradient_clip_norm)
+            gradient_clip_norm=self.gradient_clip_norm,
+            packed=self.segment_col is not None)
         self._state = engine.init_state(
             jax.random.PRNGKey(self.seed), self._input_shape,
             initial_params=self._initial_params(self._input_shape))
@@ -383,6 +389,20 @@ class DistributedTrainer(Trainer):
                 "validation_data/early stopping run between SPMD epochs; "
                 "the async PS engines have no between-epoch hook (workers "
                 "own their epoch loops) — use execution='spmd'")
+        if self.segment_col is not None:
+            if self.execution != "spmd":
+                raise ValueError(
+                    "segment_col (packed training) runs on the SPMD "
+                    "engine only — the PS workers don't thread segment "
+                    "ids; use execution='spmd'")
+            if isinstance(self.loss, str) and "masked" not in self.loss:
+                # packed labels carry -1 sentinels; a plain sparse CE would
+                # clamp them to class 0 and silently train boundaries wrong
+                raise ValueError(
+                    f"segment_col needs a *_masked loss (packed labels "
+                    f"mark cross-document/padding positions -1), got "
+                    f"{self.loss!r} — use e.g. "
+                    "'sparse_categorical_crossentropy_masked_from_logits'")
         if self.execution == "host_ps":
             from .parameter_servers import run_host_ps_training
             return run_host_ps_training(self, dataset, shuffle, resume=resume)
@@ -405,6 +425,8 @@ class DistributedTrainer(Trainer):
         val_fn = self._setup_validation(validation_data)
         x = np.asarray(dataset[self.features_col])
         y = np.asarray(dataset[self.label_col])
+        seg = (np.asarray(dataset[self.segment_col])
+               if self.segment_col is not None else None)
         self._input_shape = x.shape[1:]
         from .data.pipeline import num_rounds
         rpe = num_rounds(len(x), self.num_workers, self.communication_window,
@@ -484,11 +506,16 @@ class DistributedTrainer(Trainer):
                     perm = np.random.default_rng(
                         self.seed + epoch).permutation(len(x))
                     xe, ye = x[perm], y[perm]
+                    se = seg[perm] if seg is not None else None
                 else:
-                    xe, ye = x, y
-                xb, yb, mb, rounds = shape_epoch_data(
+                    xe, ye, se = x, y, seg
+                shaped = shape_epoch_data(
                     xe, ye, self.num_workers, self.communication_window,
-                    self.batch_size)
+                    self.batch_size, columns_seg=se)
+                if se is not None:
+                    xb, yb, sb, mb, rounds = shaped
+                else:
+                    (xb, yb, mb, rounds), sb = shaped, None
                 first = skip_rounds if epoch == start_epoch else 0
                 if self.checkpoint_unit == "round" and ckpt is not None:
                     # per-round stepping: same round program as the epoch
@@ -500,7 +527,8 @@ class DistributedTrainer(Trainer):
                     done = int(self._state.round_idx)
                     for r in range(first, rounds):
                         self._state, loss = engine.run_round(
-                            self._state, xb[r], yb[r], mb[r], rngs)
+                            self._state, xb[r], yb[r], mb[r], rngs,
+                            s=sb[r] if sb is not None else None)
                         losses.append(loss)
                         done += 1
                         if done % self.checkpoint_every == 0:
@@ -516,7 +544,7 @@ class DistributedTrainer(Trainer):
                               if losses else np.zeros((0,), np.float32))
                 else:
                     self._state, losses = engine.run_epoch(
-                        self._state, xb, yb, mb, rngs)
+                        self._state, xb, yb, mb, rngs, sb=sb)
                     losses = np.asarray(losses)
                 self.history.extend(losses.tolist())
                 # every real row trains exactly once (tail is padded+masked,

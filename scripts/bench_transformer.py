@@ -138,7 +138,9 @@ def main():
         "grid": rows,
         "best": best,
     }
-    if dev.platform != "cpu":
+    if dev.platform != "cpu" and not quick:
+        # --quick on a live accelerator must not clobber the real artifact
+        # with tiny-shape numbers
         path = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "TRANSFORMER_TPU.json")
         with open(path, "w") as f:

@@ -8,8 +8,10 @@ per-test status + timestamp + device kind — so every hardware pass
 leaves an artifact the way ``BENCH_TPU.json`` does.
 
 Run (when the tunnel is up):  python scripts/run_tpu_smoke.py
-Exits non-zero (and writes nothing) if the backend is CPU (all-skip runs
-prove nothing) or any test fails.
+Exits non-zero if the backend is CPU (all-skip runs prove nothing — no
+artifact written) or any test fails (failure recorded in
+``SMOKE_TPU_FAILED.json``; a previously captured all-PASSED
+``SMOKE_TPU.json`` is never overwritten by a bad run).
 """
 
 from __future__ import annotations
@@ -59,7 +61,12 @@ def main():
         "results": results,
         "ok": ok,
     }
-    path = os.path.join(_REPO, "SMOKE_TPU.json")
+    # preserve-the-hardware-signal policy (same as BENCH_TPU.json): only
+    # an all-PASSED run may replace SMOKE_TPU.json; failures land in a
+    # side artifact so they are diagnosable without erasing the last good
+    # pass log
+    name = "SMOKE_TPU.json" if ok else "SMOKE_TPU_FAILED.json"
+    path = os.path.join(_REPO, name)
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)
         f.write("\n")
