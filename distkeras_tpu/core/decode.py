@@ -434,6 +434,8 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
                          rng: Optional[jax.Array] = None,
                          top_k: Optional[int] = None,
                          top_p: Optional[float] = None,
+                         eos_id: Optional[int] = None,
+                         pad_id: Optional[int] = None,
                          return_stats: bool = False):
     """Decoding accelerated by a cheaper draft model — distribution-exact.
 
@@ -470,11 +472,15 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
     every row; sampling: truncating a row's accepted run early never
     conditions on later randomness — exactness holds row-wise either way).
 
-    Both models must share the vocabulary.  ``eos_id`` stopping is not
-    supported here, use ``generate``.  ``return_stats=True`` additionally
-    returns ``{"target_calls", "drafted", "accepted"}`` — ``target_calls``
-    counts the decode-phase verify forwards (the prompt prefill is one
-    more target forward on top).
+    Both models must share the vocabulary.  ``eos_id``/``pad_id`` behave
+    exactly as in ``generate``: once a row emits eos, its later slots are
+    ``pad_id`` (default: the eos itself), the output keeps its static
+    shape — and a batch whose EVERY row has finished stops issuing
+    draft/verify calls entirely (the speculative serving win compounds).
+    ``return_stats=True`` additionally returns ``{"target_calls",
+    "drafted", "accepted"}`` — ``target_calls`` counts the decode-phase
+    verify forwards (the prompt prefill is one more target forward on
+    top).
     """
     _check_supported(model)
     _check_supported(draft_model)
@@ -487,6 +493,7 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
         raise ValueError(f"draft_len must be >= 1, got {draft_len}")
     _validate_sampling(temperature, rng, top_k, top_p)
     tv, dv = _vocab_size(model), _vocab_size(draft_model)
+    _validate_stopping(eos_id, pad_id, tv)
     if tv is not None and dv is not None and tv != dv:
         raise ValueError(f"target and draft vocabularies differ: {tv} vs "
                          f"{dv} — argmax agreement would be meaningless")
@@ -545,10 +552,32 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
         model, p, caches, toks, pos))
     d_step = jit_decode_step(draft_model)
 
-    out = [cur]
+    # eos stopping, same semantics as generate: a row that emitted eos
+    # gets pad in every later slot.  Applied per COMMITTED token in commit
+    # order, so it composes with both the greedy and the sampled rule
+    # (padding is a row-wise post-map; exactness is untouched).
+    pad_tok = jnp.int32(pad_id if pad_id is not None else (eos_id or 0))
+    done = jnp.zeros((b,), bool)
+    out = []
+
+    def commit(tok):
+        nonlocal done
+        if eos_id is not None:
+            tok = jnp.where(done, pad_tok, tok)
+            done = done | (tok == eos_id)
+        out.append(tok)
+
+    commit(cur)
+    cur = out[-1]
     pos = p_len - 1  # cur continues from here; its cache slot is pos + 1
     stats = {"target_calls": 0, "drafted": 0, "accepted": 0}
     while len(out) < num_steps:
+        if eos_id is not None and bool(jnp.all(done)):
+            # every row finished: no more draft/verify calls — fill the
+            # remaining slots with one shared pad row and stop
+            pad_row = jnp.full((b,), pad_tok, jnp.int32)
+            out.extend([pad_row] * (num_steps - len(out)))
+            break
         # fixed k = draft_len whenever the allocation allows (one compiled
         # verify shape); the commit clamp below keeps outputs exact even
         # when more is drafted than remains to emit
@@ -573,7 +602,7 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
         if k == 0:
             nxt = (jax.random.categorical(_key(), warp(logits[:, 0]))
                    if sampled else jnp.argmax(logits[:, 0], axis=-1))
-            out.append(nxt.astype(jnp.int32))
+            commit(nxt.astype(jnp.int32))
             cur = out[-1]
             pos += 1
             continue
@@ -593,7 +622,7 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
             a = int(jnp.min(n_row))
             a = min(a, num_steps - len(out) - 1)
             for i in range(a):
-                out.append(drafted[:, i])     # accepted by every row
+                commit(drafted[:, i])         # accepted by every row
             if a == k:
                 # fully accepted: bonus token straight from warped p
                 tok_a = jax.random.categorical(
@@ -610,7 +639,7 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
                 # (truncation never conditions on later randomness)
                 tok_a = jnp.where(n_row > a, drafted[:, a],
                                   rej).astype(jnp.int32)
-            out.append(tok_a)
+            commit(tok_a)
         else:
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             match = drafted == greedy[:, :k]                  # (B, k)
@@ -619,8 +648,8 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
             a = int(jnp.min(jnp.sum(prefix, axis=1)))
             a = min(a, num_steps - len(out) - 1)
             for i in range(a):
-                out.append(greedy[:, i])      # == accepted draft tokens
-            out.append(greedy[:, a])          # bonus / correction token
+                commit(greedy[:, i])          # == accepted draft tokens
+            commit(greedy[:, a])              # bonus / correction token
         stats["accepted"] += a
         cur = out[-1]
         pos += a + 1

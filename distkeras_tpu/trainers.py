@@ -53,6 +53,17 @@ def _as_model(keras_model) -> Sequential:
         raise TypeError(f"Cannot interpret model {type(keras_model)}")
 
 
+def _require_masked_loss(loss):
+    """The one segment_col loss rule (SingleTrainer + DistributedTrainer):
+    packed labels carry -1 sentinels, which a plain sparse CE would clamp
+    to class 0 and silently train document boundaries wrong."""
+    if isinstance(loss, str) and "masked" not in loss:
+        raise ValueError(
+            f"segment_col needs a *_masked loss (packed labels mark "
+            f"cross-document/padding positions -1), got {loss!r} — use "
+            "e.g. 'sparse_categorical_crossentropy_masked_from_logits'")
+
+
 class Trainer:
     """Abstract base (reference: ``trainers.py :: Trainer``).
 
@@ -229,15 +240,8 @@ class SingleTrainer(Trainer):
 
     def train(self, dataset: Dataset, shuffle: bool = False,
               validation_data: Optional[Dataset] = None) -> FittedModel:
-        if self.segment_col is not None and isinstance(self.loss, str) \
-                and "masked" not in self.loss:
-            # packed labels carry -1 sentinels; a plain sparse CE would
-            # clamp them to class 0 and silently train boundaries wrong
-            raise ValueError(
-                f"segment_col needs a *_masked loss (packed labels mark "
-                f"cross-document/padding positions -1), got "
-                f"{self.loss!r} — use e.g. "
-                "'sparse_categorical_crossentropy_masked_from_logits'")
+        if self.segment_col is not None:
+            _require_masked_loss(self.loss)
         self.record_training_start()
         x = dataset[self.features_col]
         y = dataset[self.label_col]
@@ -395,14 +399,7 @@ class DistributedTrainer(Trainer):
                     "segment_col (packed training) runs on the SPMD "
                     "engine only — the PS workers don't thread segment "
                     "ids; use execution='spmd'")
-            if isinstance(self.loss, str) and "masked" not in self.loss:
-                # packed labels carry -1 sentinels; a plain sparse CE would
-                # clamp them to class 0 and silently train boundaries wrong
-                raise ValueError(
-                    f"segment_col needs a *_masked loss (packed labels "
-                    f"mark cross-document/padding positions -1), got "
-                    f"{self.loss!r} — use e.g. "
-                    "'sparse_categorical_crossentropy_masked_from_logits'")
+            _require_masked_loss(self.loss)
         if self.execution == "host_ps":
             from .parameter_servers import run_host_ps_training
             return run_host_ps_training(self, dataset, shuffle, resume=resume)

@@ -70,6 +70,13 @@ def main():
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)
         f.write("\n")
+    if ok:
+        # a pass supersedes any earlier failure record — don't leave
+        # contradictory artifacts side by side
+        try:
+            os.remove(os.path.join(_REPO, "SMOKE_TPU_FAILED.json"))
+        except FileNotFoundError:
+            pass
     print(json.dumps(artifact))
     if not ok:
         print(run.stdout[-3000:], file=sys.stderr)

@@ -195,3 +195,63 @@ def test_sampled_speculative_deterministic_and_validated():
         speculative_generate(model, params, draft, dparams, PROMPT, 4,
                              temperature=0.5, top_p=1.5,
                              rng=jax.random.PRNGKey(0))
+
+
+def test_eos_stopping_matches_generate_and_saves_calls():
+    """eos_id/pad_id on speculative_generate: bit-identical to generate's
+    stopping semantics, and a fully-finished batch stops issuing verify
+    calls (the early-exit path)."""
+    model, params = make_lm(seed=0)
+    draft, dparams = make_lm(layers=1, seed=99)
+    prompt = PROMPT[:1]  # single row: batch finishes when it does
+    base = np.asarray(generate(model, params, prompt, 12))
+    eos = int(base[0, 3 + 2])  # a token the greedy path actually emits
+    want = np.asarray(generate(model, params, prompt, 12,
+                               eos_id=eos, pad_id=1))
+    got, stats = speculative_generate(model, params, draft, dparams,
+                                      prompt, 12, draft_len=3,
+                                      eos_id=eos, pad_id=1,
+                                      return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert (np.asarray(got)[0] == 1).any()  # padding actually happened
+    _, stats_free = speculative_generate(model, params, draft, dparams,
+                                         prompt, 12, draft_len=3,
+                                         return_stats=True)
+    assert stats["target_calls"] <= stats_free["target_calls"]
+
+    # batched: per-row stopping with static output shape
+    want2 = np.asarray(generate(model, params, PROMPT, 12, eos_id=eos))
+    got2 = np.asarray(speculative_generate(model, params, draft, dparams,
+                                           PROMPT, 12, draft_len=3,
+                                           eos_id=eos))
+    np.testing.assert_array_equal(got2, want2)
+
+    with pytest.raises(ValueError, match="pad_id"):
+        speculative_generate(model, params, draft, dparams, PROMPT, 4,
+                             pad_id=1)
+    with pytest.raises(ValueError, match="eos_id"):
+        speculative_generate(model, params, draft, dparams, PROMPT, 4,
+                             eos_id=99)
+
+
+def test_eos_composes_with_sampling():
+    """eos stopping + rejection sampling: deterministic per key, static
+    shape, pad after the first eos in every row."""
+    model, params = make_lm(seed=8)
+    draft, dparams = make_lm(layers=1, seed=9)
+    key = jax.random.PRNGKey(5)
+    a = np.asarray(speculative_generate(model, params, draft, dparams,
+                                        PROMPT, 10, temperature=1.0,
+                                        top_k=8, rng=key, eos_id=3,
+                                        pad_id=0))
+    b = np.asarray(speculative_generate(model, params, draft, dparams,
+                                        PROMPT, 10, temperature=1.0,
+                                        top_k=8, rng=key, eos_id=3,
+                                        pad_id=0))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (2, 13)
+    for row in a:
+        gen = row[3:]
+        hits = np.where(gen == 3)[0]
+        if len(hits):  # everything after the first eos is pad
+            assert (gen[hits[0] + 1:] == 0).all()
