@@ -59,10 +59,13 @@ def round_block(n_rows: int, num_workers: int, window: int, batch_size: int,
 
 def round_stream(x: np.ndarray, y: np.ndarray, num_workers: int,
                  window: int, batch_size: int,
-                 shuffle_seed: Optional[int] = None
-                 ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+                 shuffle_seed: Optional[int] = None,
+                 seg: Optional[np.ndarray] = None
+                 ) -> Iterator[Tuple[np.ndarray, ...]]:
     """Yield per-round (x, y, mask) triples shaped (window, workers, batch,
-    ...).
+    ...) — or (x, y, seg, mask) quadruples when ``seg`` (sequence-packing
+    segment ids, same row order) is given, matching the packed engine's
+    data ordering.
 
     Row layout comes from ``round_block`` — identical to
     ``shape_epoch_data``, so a streamed epoch visits exactly the same
@@ -78,7 +81,10 @@ def round_stream(x: np.ndarray, y: np.ndarray, num_workers: int,
         sel, mask = round_block(len(x), n, w, b, r)
         if perm is not None:
             sel = perm[sel]
-        yield x[sel], y[sel], mask
+        if seg is not None:
+            yield x[sel], y[sel], seg[sel], mask
+        else:
+            yield x[sel], y[sel], mask
 
 
 def prefetch_to_device(iterator: Iterator, shardings, buffer_size: int = 2):
@@ -98,6 +104,12 @@ def prefetch_to_device(iterator: Iterator, shardings, buffer_size: int = 2):
                 item = next(iterator)
             except StopIteration:
                 return
+            if len(item) != len(shardings):
+                # zip would silently truncate (dropping e.g. the mask of a
+                # packed quadruple fed with 3 shardings) — refuse instead
+                raise ValueError(
+                    f"streamed item has {len(item)} arrays but "
+                    f"{len(shardings)} shardings were given")
             queue.append(tuple(
                 jax.device_put(a, s) for a, s in zip(item, shardings)))
 

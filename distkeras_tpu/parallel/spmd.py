@@ -346,22 +346,38 @@ class SPMDEngine:
 
     def run_epoch_streaming(self, state: DistState, round_iter, rngs
                             ) -> Tuple[DistState, np.ndarray]:
-        """Run an epoch from a generator of per-round host array triples
-        (x, y, mask) shaped (window, workers, batch, ...) (see
-        ``data.pipeline.round_stream``), double-buffered onto the mesh.  Same
-        math as ``run_epoch`` — one jit call per round instead of one per
-        epoch — for datasets that cannot live in HBM whole.
+        """Run an epoch from a generator of per-round host array tuples —
+        (x, y, mask) triples, or (x, y, seg, mask) quadruples on a packed
+        engine — shaped (window, workers, batch, ...) (see
+        ``data.pipeline.round_stream``; pass ``seg=`` there iff the engine
+        is packed), double-buffered onto the mesh.  Same math as
+        ``run_epoch`` — one jit call per round instead of one per epoch —
+        for datasets that cannot live in HBM whole.
         """
         from ..data.pipeline import prefetch_to_device
-        if self.packed:
-            raise ValueError("streaming epochs are not wired for packed "
-                             "engines yet — use run_epoch")
         if self._round_step is None:
             self._round_step = self._build_round_step()
         sh = NamedSharding(self.mesh, P(None, WORKER_AXIS))
+        # packed engines stream (x, y, seg, mask) quadruples
+        # (round_stream(seg=…)); unpacked stream the classic triples.
+        # Arity is checked on the RAW iterator, before prefetch's zip could
+        # truncate a too-long item (prefetch_to_device also refuses
+        # length mismatches as a second line of defense).
+        arity = 4 if self.packed else 3
+
+        def checked(it):
+            for item in it:
+                if len(item) != arity:
+                    raise ValueError(
+                        f"streamed round has {len(item)} arrays, the "
+                        f"{'packed' if self.packed else 'unpacked'} "
+                        f"engine expects {arity} — pass seg=… to "
+                        "round_stream iff the engine is packed")
+                yield item
+
         losses = []
-        for xb, yb, mb in prefetch_to_device(round_iter, (sh, sh, sh)):
-            state, loss = self._round_step(state, xb, yb, mb, rngs)
+        for item in prefetch_to_device(checked(round_iter), (sh,) * arity):
+            state, loss = self._round_step(state, *item, rngs)
             losses.append(loss)
         # one device→host transfer for the whole epoch, f32 like run_epoch
         return state, np.asarray(jax.device_get(jnp.stack(losses)),

@@ -217,3 +217,61 @@ def test_fully_padded_batch_is_true_noop():
     for a, b in zip(jax.tree_util.tree_leaves(s1),
                     jax.tree_util.tree_leaves(s2)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_streamed_packed_epoch_matches_all_at_once(eight_devices):
+    """Packed streaming: run_epoch_streaming on (x, y, seg, mask)
+    quadruples == packed run_epoch, bit for bit; arity misuse refused."""
+    import pytest
+    from distkeras_tpu.data.packing import pack_documents, packed_lm_labels
+    from distkeras_tpu.models.zoo import transformer_lm
+
+    rng = np.random.default_rng(11)
+    docs = [[int(v) for v in rng.integers(1, 32, int(rng.integers(4, 10)))]
+            for _ in range(128)]
+    tok, seg = pack_documents(docs, 16)
+    lab = packed_lm_labels(tok, seg)
+    model = transformer_lm(vocab_size=32, seq_len=16, d_model=32,
+                           num_heads=4, num_layers=2, mlp_dim=64,
+                           compute_dtype="float32", positional="rope")
+    n, w, b = 8, 2, 2
+
+    def fresh():
+        eng = SPMDEngine(
+            model, "sparse_categorical_crossentropy_masked_from_logits",
+            "adam", get_mesh(8), "adag", communication_window=w,
+            learning_rate=1e-3, packed=True)
+        st = eng.init_state(jax.random.PRNGKey(0), (16,))
+        return eng, st, eng.worker_rngs(3)
+
+    eng1, st1, rngs1 = fresh()
+    xb, yb, sb, mb, _ = shape_epoch_data(tok, lab, n, w, b,
+                                         columns_seg=seg)
+    st1, losses1 = eng1.run_epoch(st1, xb, yb, mb, rngs1, sb=sb)
+
+    eng2, st2, rngs2 = fresh()
+    st2, losses2 = eng2.run_epoch_streaming(
+        st2, round_stream(tok, lab, n, w, b, seg=seg), rngs2)
+
+    np.testing.assert_array_equal(np.asarray(losses1), losses2)
+    for a, b_ in zip(jax.tree_util.tree_leaves(jax.device_get(st1.center)),
+                     jax.tree_util.tree_leaves(jax.device_get(st2.center))):
+        np.testing.assert_array_equal(a, b_)
+
+    # triples into a packed engine refuse loudly
+    eng3, st3, rngs3 = fresh()
+    with pytest.raises(ValueError, match="expects 4"):
+        eng3.run_epoch_streaming(st3, round_stream(tok, lab, n, w, b),
+                                 rngs3)
+    # ...and quadruples into an UNPACKED engine too (regression: zip in
+    # prefetch_to_device used to silently truncate, dropping the mask and
+    # training with seg in its place)
+    eng4 = SPMDEngine(
+        model, "sparse_categorical_crossentropy_masked_from_logits",
+        "adam", get_mesh(8), "adag", communication_window=w,
+        learning_rate=1e-3)
+    st4 = eng4.init_state(jax.random.PRNGKey(0), (16,))
+    with pytest.raises(ValueError, match="expects 3"):
+        eng4.run_epoch_streaming(
+            st4, round_stream(tok, lab, n, w, b, seg=seg),
+            eng4.worker_rngs(3))
