@@ -60,6 +60,19 @@ def lm(seq_len):
                           compute_dtype="float32", positional="rope")
 
 
+def counting_docs(seed, count):
+    """The shared x+1-rule corpus (token ids 1..31, wrap): variable-length
+    counting runs, used by every packed-trainer test so the learned rule
+    stays comparable across them."""
+    rng = np.random.default_rng(seed)
+    docs = []
+    for _ in range(count):
+        n = int(rng.integers(4, 10))
+        start = int(rng.integers(1, 31))
+        docs.append([(start + i) % 31 + 1 for i in range(n)])
+    return docs
+
+
 def test_packed_forward_equals_unpacked_per_document():
     """The killer property: each packed document's logits equal its
     unpacked forward (RoPE + segment mask)."""
@@ -192,12 +205,7 @@ def test_single_trainer_packed_path():
     from distkeras_tpu.data.dataset import Dataset
     from distkeras_tpu.trainers import SingleTrainer
 
-    rng = np.random.default_rng(5)
-    docs = []
-    for _ in range(192):
-        n = int(rng.integers(4, 10))
-        start = int(rng.integers(1, 31))
-        docs.append([(start + i) % 31 + 1 for i in range(n)])
+    docs = counting_docs(5, 192)
     tokens, segs = pack_documents(docs, seq_len=16)
     labels = packed_lm_labels(tokens, segs)
 
@@ -296,12 +304,7 @@ def test_distributed_packed_path():
     from distkeras_tpu.data.dataset import Dataset
     from distkeras_tpu.trainers import ADAG
 
-    rng = np.random.default_rng(9)
-    docs = []
-    for _ in range(384):
-        n = int(rng.integers(4, 10))
-        start = int(rng.integers(1, 31))
-        docs.append([(start + i) % 31 + 1 for i in range(n)])
+    docs = counting_docs(9, 384)
     tokens, segs = pack_documents(docs, seq_len=16)
     labels = packed_lm_labels(tokens, segs)
     ds = Dataset({"features": tokens, "label": labels,
@@ -329,3 +332,36 @@ def test_distributed_packed_path():
         ADAG(model, num_workers=8, segment_col="segment_ids",
              loss="sparse_categorical_crossentropy_masked",
              execution="host_ps").train(ds)
+
+
+def test_local_family_trainers_accept_packing():
+    """AveragingTrainer/EnsembleTrainer inherit the packed path through
+    DistributedTrainer ('local' algorithm, no exchange): packed corpora
+    train per-worker with segment isolation; members genuinely differ."""
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.trainers import AveragingTrainer, EnsembleTrainer
+
+    docs = counting_docs(12, 256)
+    tokens, segs = pack_documents(docs, seq_len=16)
+    labels = packed_lm_labels(tokens, segs)
+    ds = Dataset({"features": tokens, "label": labels,
+                  "segment_ids": segs})
+
+    t = AveragingTrainer(
+        lm(seq_len=16), num_workers=8, batch_size=4, num_epoch=4,
+        loss="sparse_categorical_crossentropy_masked_from_logits",
+        worker_optimizer="adam", learning_rate=3e-3,
+        segment_col="segment_ids")
+    t.train(ds, shuffle=True)
+    assert t.history[-1] < t.history[0]
+
+    e = EnsembleTrainer(
+        lm(seq_len=16), num_models=8, batch_size=4, num_epoch=2,
+        loss="sparse_categorical_crossentropy_masked_from_logits",
+        worker_optimizer="adam", learning_rate=3e-3,
+        segment_col="segment_ids")
+    members = e.train(ds, shuffle=True)
+    assert len(members) == 8
+    w0 = jax.tree_util.tree_leaves(members[0].params)[0]
+    w1 = jax.tree_util.tree_leaves(members[1].params)[0]
+    assert np.abs(np.asarray(w0) - np.asarray(w1)).max() > 1e-6
