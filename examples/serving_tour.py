@@ -94,6 +94,21 @@ def main():
     print(f"speculative sampling (T=0.5, top-4): "
           f"{np.asarray(sspec)[0, 4:].tolist()}, draft accept {srate:.0%}")
 
+    # eos stopping composes with speculation: same semantics as generate,
+    # and a fully-finished batch stops issuing verify calls early
+    eos = int(greedy[0, 4 + args.steps // 2])  # a token greedy will emit
+    espec, estats = target.speculative_generate(
+        draft, prompt, args.steps, draft_len=4, eos_id=eos, pad_id=0,
+        return_stats=True)
+    want_eos = np.asarray(target.generate(prompt, args.steps, eos_id=eos,
+                                          pad_id=0))
+    assert (np.asarray(espec) == want_eos).all(), "spec eos != generate eos"
+    assert estats["target_calls"] < stats["target_calls"], \
+        "eos stopping did not save verify calls"
+    print(f"speculative + eos_id={eos}: "
+          f"{np.asarray(espec)[0, 4:].tolist()} "
+          f"({estats['target_calls']} verify calls, stopped early)")
+
     q = target.quantize()
     q_greedy = np.asarray(q.generate(prompt, args.steps))
     assert (q_greedy == greedy).all(), "int8 changed greedy decode"

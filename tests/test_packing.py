@@ -127,12 +127,7 @@ def test_packed_training_learns_the_rule():
 
     model = lm(seq_len=16)
     params = model.init(jax.random.PRNGKey(3))
-    rng = np.random.default_rng(4)
-    docs = []
-    for _ in range(192):
-        n = int(rng.integers(4, 10))
-        start = int(rng.integers(1, 32 - 1))
-        docs.append([(start + i) % 31 + 1 for i in range(n)])  # ids 1..31
+    docs = counting_docs(4, 192)
     tokens, segs = pack_documents(docs, seq_len=16)
     labels = packed_lm_labels(tokens, segs)
     loss_fn = get_loss("sparse_categorical_crossentropy_masked_from_logits")
