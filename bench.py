@@ -115,6 +115,57 @@ def last_tpu_summary():
              "batch", "window", "captured_unix")}
 
 
+def host_ps_microbench(budget_s: float = 90.0):
+    """PS-path microbenchmark: a small ADAG run over the live socket PS on
+    loopback, measuring the transport pipelining win as data, not assertion.
+
+    Returns ``{"host_ps_examples_per_sec": float,
+    "host_ps_rtts_per_window": float}`` — RTTs/window is transport messages
+    initiated per communication window, excluding each worker's initial
+    pull: 2.0 on the serial 'c'+'p' path, 1.0 with ``comm_overlap`` (the
+    combined 'u' opcode, reply hidden behind the next window's compute).
+    Returns None values if the run exceeds sanity bounds or fails — the
+    north-star artifact must exist either way.
+    """
+    import numpy as np
+
+    from distkeras_tpu import ADAG, Dataset
+    from distkeras_tpu.core.layers import Dense
+    from distkeras_tpu.core.model import Sequential
+
+    rng = np.random.default_rng(0)
+    n, d, classes = 4096, 16, 4
+    protos = rng.uniform(-1, 1, (classes, d))
+    labels = rng.integers(0, classes, n)
+    x = (protos[labels] + 0.3 * rng.standard_normal((n, d))).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[labels]
+    ds = Dataset({"features": x, "label": y})
+    model = Sequential([Dense(32, activation="relu"),
+                        Dense(classes, activation="softmax")],
+                       input_shape=(d,), compute_dtype="float32")
+    # num_workers=1 + parallelism_factor=2 → two true-async worker threads
+    # against the PS without needing a multi-device mesh (the bench process
+    # may see a single CPU device)
+    t = ADAG(model, num_workers=1, parallelism_factor=2, batch_size=32,
+             num_epoch=2, communication_window=4, learning_rate=0.05,
+             execution="host_ps")
+    t0 = time.perf_counter()
+    t.train(ds)
+    dt = time.perf_counter() - t0
+    if dt > budget_s:
+        return {"host_ps_examples_per_sec": None,
+                "host_ps_rtts_per_window": None}
+    workers = getattr(t, "_ps_workers", [])
+    windows = sum(w._commits for w in workers)
+    ops = sum(w.transport_ops for w in workers)
+    rtts_per_window = ((ops - len(workers)) / windows) if windows else None
+    return {
+        "host_ps_examples_per_sec": round(n * t.num_epoch / dt, 1),
+        "host_ps_rtts_per_window": (round(rtts_per_window, 3)
+                                    if rtts_per_window is not None else None),
+    }
+
+
 def main():
     t_start = time.perf_counter()
     debug = os.environ.get("DISTKERAS_BENCH_DEBUG", "") == "1"
@@ -285,6 +336,19 @@ def main():
         "rows": len(x),
         "flops_per_example": flops_ex,
     }
+    # PS-path microbenchmark (the observable for the overlapped 'u'
+    # transport — docs/host_ps.md): recorded when budget remains, null
+    # otherwise; never fatal to the north-star artifact.
+    stage("host_ps microbench")
+    ps_fields = {"host_ps_examples_per_sec": None,
+                 "host_ps_rtts_per_window": None}
+    ps_remaining = budget - (time.perf_counter() - t_start)
+    if ps_remaining > 60:
+        try:
+            ps_fields = host_ps_microbench(budget_s=ps_remaining)
+        except Exception as e:
+            print(f"[bench] host_ps microbench failed: {e}", file=sys.stderr)
+    result.update(ps_fields)
     if real_platform == "cpu":
         # CPU fallback: carry the hardware signal instead of erasing it
         result["probe_history"] = probe_history

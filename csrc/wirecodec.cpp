@@ -14,6 +14,12 @@
  *   decode_frames(data: bytes) -> (header: bytes, buffers: list[memoryview])
  *       Zero-copy: the returned memoryviews alias `data`.
  *
+ *   decode_payload(data) -> list[memoryview]
+ *       Splits a bare run of `u64 len | raw bytes` frames (no magic/header)
+ *       into zero-copy memoryviews over `data` — the pooled receive path,
+ *       where the tensor payload lands in a reusable per-connection buffer
+ *       (networking.BufferPool) and must decode without fresh allocations.
+ *
  * Built by setup.py as distkeras_tpu._wirecodec (optional; networking.py
  * falls back to the Python codec when absent).  CPython C API only — no
  * pybind11 dependency.
@@ -99,6 +105,54 @@ static PyObject *encode_frames(PyObject *, PyObject *args) {
   return out;
 }
 
+/* Append data_obj[lo:hi] to `buffers` as a zero-copy memoryview slice.
+ * Returns 0 on success, -1 with a Python error set otherwise. */
+static int append_view(PyObject *data_obj, PyObject *buffers, uint64_t lo,
+                       uint64_t hi) {
+  PyObject *mv = PyMemoryView_FromObject(data_obj);
+  PyObject *sliced = nullptr;
+  if (mv) {
+    PyObject *plo = PyLong_FromUnsignedLongLong(lo);
+    PyObject *phi = PyLong_FromUnsignedLongLong(hi);
+    PyObject *slice = (plo && phi) ? PySlice_New(plo, phi, nullptr) : nullptr;
+    Py_XDECREF(plo);
+    Py_XDECREF(phi);
+    if (slice) {
+      sliced = PyObject_GetItem(mv, slice);
+      Py_DECREF(slice);
+    }
+    Py_DECREF(mv);
+  }
+  if (!sliced) return -1;
+  int rc = PyList_Append(buffers, sliced);
+  Py_DECREF(sliced);
+  return rc;
+}
+
+/* Parse `u64 len | raw bytes` frames out of data[off:] into `buffers`.
+ * Shared by decode_frames (off = past the header) and decode_payload
+ * (off = 0).  Returns 0 on success, -1 with a Python error set. */
+static int parse_frames(PyObject *data_obj, const uint8_t *p, Py_ssize_t n,
+                        uint64_t off, PyObject *buffers) {
+  /* All bounds checks are written subtraction-style (x > n - off) so a
+   * hostile 64-bit length cannot wrap the addition and slip past. */
+  while (off < (uint64_t)n) {
+    if ((uint64_t)n - off < 8) {
+      PyErr_SetString(PyExc_ValueError, "Truncated buffer length");
+      return -1;
+    }
+    uint64_t blen = get_u64(p + off);
+    off += 8;
+    if (blen > (uint64_t)n - off) {
+      PyErr_SetString(PyExc_ValueError, "Truncated buffer payload");
+      return -1;
+    }
+    if (append_view(data_obj, buffers, off, off + blen) != 0) return -1;
+    off += blen;
+  }
+  return 0;
+}
+
 static PyObject *decode_frames(PyObject *, PyObject *args) {
   PyObject *data_obj;
   if (!PyArg_ParseTuple(args, "O", &data_obj)) return nullptr;
@@ -113,8 +167,6 @@ static PyObject *decode_frames(PyObject *, PyObject *args) {
     PyErr_SetString(PyExc_ValueError, "Bad magic on wire message");
     return nullptr;
   }
-  /* All bounds checks are written subtraction-style (x > n - off) so a
-   * hostile 64-bit length cannot wrap the addition and slip past. */
   uint64_t hlen = get_u32(p + 4);
   if (hlen > (uint64_t)n - 8) {
     PyBuffer_Release(&data);
@@ -124,55 +176,12 @@ static PyObject *decode_frames(PyObject *, PyObject *args) {
   PyObject *header =
       PyBytes_FromStringAndSize((const char *)p + 8, (Py_ssize_t)hlen);
   PyObject *buffers = PyList_New(0);
-  if (!header || !buffers) {
+  if (!header || !buffers ||
+      parse_frames(data_obj, p, n, 8 + hlen, buffers) != 0) {
     Py_XDECREF(header);
     Py_XDECREF(buffers);
     PyBuffer_Release(&data);
     return nullptr;
-  }
-
-  uint64_t off = 8 + hlen;
-  while (off < (uint64_t)n) {
-    if ((uint64_t)n - off < 8) {
-      Py_DECREF(header);
-      Py_DECREF(buffers);
-      PyBuffer_Release(&data);
-      PyErr_SetString(PyExc_ValueError, "Truncated buffer length");
-      return nullptr;
-    }
-    uint64_t blen = get_u64(p + off);
-    off += 8;
-    if (blen > (uint64_t)n - off) {
-      Py_DECREF(header);
-      Py_DECREF(buffers);
-      PyBuffer_Release(&data);
-      PyErr_SetString(PyExc_ValueError, "Truncated buffer payload");
-      return nullptr;
-    }
-    /* zero-copy: a memoryview over the input's bytes */
-    PyObject *mv = PyMemoryView_FromObject(data_obj);
-    PyObject *sliced = nullptr;
-    if (mv) {
-      PyObject *lo = PyLong_FromUnsignedLongLong(off);
-      PyObject *hi = PyLong_FromUnsignedLongLong(off + blen);
-      PyObject *slice = (lo && hi) ? PySlice_New(lo, hi, nullptr) : nullptr;
-      Py_XDECREF(lo);
-      Py_XDECREF(hi);
-      if (slice) {
-        sliced = PyObject_GetItem(mv, slice);
-        Py_DECREF(slice);
-      }
-      Py_DECREF(mv);
-    }
-    if (!sliced || PyList_Append(buffers, sliced) != 0) {
-      Py_XDECREF(sliced);
-      Py_DECREF(header);
-      Py_DECREF(buffers);
-      PyBuffer_Release(&data);
-      return nullptr;
-    }
-    Py_DECREF(sliced);
-    off += blen;
   }
   PyBuffer_Release(&data);
   PyObject *result = PyTuple_Pack(2, header, buffers);
@@ -181,11 +190,30 @@ static PyObject *decode_frames(PyObject *, PyObject *args) {
   return result;
 }
 
+static PyObject *decode_payload(PyObject *, PyObject *args) {
+  PyObject *data_obj;
+  if (!PyArg_ParseTuple(args, "O", &data_obj)) return nullptr;
+  Py_buffer data;
+  if (PyObject_GetBuffer(data_obj, &data, PyBUF_C_CONTIGUOUS) != 0)
+    return nullptr;
+  PyObject *buffers = PyList_New(0);
+  if (!buffers || parse_frames(data_obj, (const uint8_t *)data.buf, data.len,
+                               0, buffers) != 0) {
+    Py_XDECREF(buffers);
+    PyBuffer_Release(&data);
+    return nullptr;
+  }
+  PyBuffer_Release(&data);
+  return buffers;
+}
+
 static PyMethodDef methods[] = {
     {"encode_frames", encode_frames, METH_VARARGS,
      "encode_frames(header: bytes, buffers) -> bytes"},
     {"decode_frames", decode_frames, METH_VARARGS,
      "decode_frames(data) -> (header, [memoryview, ...])"},
+    {"decode_payload", decode_payload, METH_VARARGS,
+     "decode_payload(data) -> [memoryview, ...] (bare u64-len frames)"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_wirecodec",

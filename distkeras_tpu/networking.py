@@ -28,7 +28,7 @@ from __future__ import annotations
 import json
 import socket
 import struct
-from typing import Any, List
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -89,20 +89,24 @@ def _encode_node(obj: Any, buffers: List[np.ndarray]):
     raise TypeError(f"Cannot encode {type(obj)} on the wire")
 
 
-def _decode_node(node: Any, buffers: List[bytes]):
+def _decode_node(node: Any, buffers: List[bytes], copy: bool = True):
+    """``copy=False`` returns ndarray *views* over ``buffers`` (the pooled
+    receive path) — valid only until the backing buffer is reused."""
     if isinstance(node, dict):
         if "__nd__" in node:
             arr = np.frombuffer(buffers[node["__nd__"]],
                                 dtype=_dtype_of(node["dtype"]))
-            return arr.reshape(node["shape"]).copy()
+            arr = arr.reshape(node["shape"])
+            return arr.copy() if copy else arr
         if "__dict__" in node:
-            return {k: _decode_node(v, buffers)
+            return {k: _decode_node(v, buffers, copy)
                     for k, v in node["__dict__"].items()}
         if "__tuple__" in node:
-            return tuple(_decode_node(v, buffers) for v in node["__tuple__"])
+            return tuple(_decode_node(v, buffers, copy)
+                         for v in node["__tuple__"])
         raise ValueError(f"Malformed wire node: {node!r}")
     if isinstance(node, list):
-        return [_decode_node(v, buffers) for v in node]
+        return [_decode_node(v, buffers, copy) for v in node]
     return node
 
 
@@ -178,6 +182,63 @@ def decode_message(data: bytes) -> Any:
     return _decode_node(header["tree"], buffers)
 
 
+def _decode_payload_py(data) -> List[memoryview]:
+    """Pure-Python twin of the native ``decode_payload``: split a run of
+    ``u64 len | raw bytes`` frames into zero-copy memoryviews over ``data``.
+    Used by the pooled receive path, where the payload (everything after the
+    header) was read into a reusable buffer in one recv pass."""
+    view = memoryview(data)
+    n = len(view)
+    out: List[memoryview] = []
+    off = 0
+    while off < n:
+        if n - off < 8:
+            raise ValueError("Truncated buffer length")
+        (blen,) = _U64.unpack_from(view, off)
+        off += 8
+        if blen > n - off:
+            raise ValueError("Truncated buffer payload")
+        out.append(view[off:off + blen])
+        off += blen
+    return out
+
+
+def decode_payload(data) -> List[memoryview]:
+    """Split length-prefixed tensor frames (native codec when built)."""
+    if _native is not None and hasattr(_native, "decode_payload"):
+        return _native.decode_payload(data)
+    return _decode_payload_py(data)
+
+
+class BufferPool:
+    """Reusable receive buffers for one connection's request/reply stream.
+
+    The PS protocol is strictly request/reply per connection — at most one
+    frame is in flight — so one buffer per payload size is enough: repeated
+    same-shape weight pulls land in the same preallocated memory instead of
+    allocating fresh weight-sized buffers every round trip.  Arrays decoded
+    through a pool are **views** into it, valid only until the next
+    ``recv_data(..., pool=...)`` call on the same pool; callers that keep
+    weights across a receive must copy (the workers move them to device
+    immediately, which copies).
+    """
+
+    def __init__(self):
+        self._bufs: Dict[int, bytearray] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, size: int) -> bytearray:
+        buf = self._bufs.get(size)
+        if buf is None:
+            buf = bytearray(size)
+            self._bufs[size] = buf
+            self.misses += 1
+        else:
+            self.hits += 1
+        return buf
+
+
 # ---------------------------------------------------------------------------
 # socket API (reference-parity surface: networking.py module functions)
 # ---------------------------------------------------------------------------
@@ -219,14 +280,30 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Receive exactly len(view) bytes directly into preallocated memory."""
+    while view:
+        n = sock.recv_into(view, min(len(view), 1 << 20))
+        if not n:
+            raise ConnectionError("socket closed mid-frame")
+        view = view[n:]
+
+
 def send_data(sock: socket.socket, obj: Any) -> None:
     """Frame and send one message (reference: ``networking.send_data``)."""
     sock.sendall(encode_message(obj))
 
 
-def recv_data(sock: socket.socket) -> Any:
+def recv_data(sock: socket.socket, pool: Optional[BufferPool] = None) -> Any:
     """Receive one full message (reference: ``networking.recv_data`` — loop
-    until the declared byte count arrives)."""
+    until the declared byte count arrives).
+
+    With ``pool``, the tensor payload is received into a reusable
+    per-connection buffer and decoded **zero-copy** (ndarray views over the
+    pooled memory) — the steady-state weight-pull path allocates nothing.
+    The returned arrays are only valid until the next pooled receive; see
+    ``BufferPool``.
+    """
     head = _recv_exact(sock, 8)
     if head[:4] != MAGIC:
         raise ValueError("Bad magic on wire message")
@@ -238,8 +315,32 @@ def recv_data(sock: socket.socket) -> Any:
     # corrupt/malicious frame cannot drive unbounded allocation
     expected: dict = {}
     _expected_buffer_sizes(header["tree"], expected)
+    nbuf = header["nbuf"]
+    if pool is not None:
+        # one recv pass into preallocated memory; the per-buffer u64 length
+        # prefixes are validated after the read (a lie means the stream is
+        # already desynchronized — callers drop the connection on ValueError,
+        # exactly as on any other corrupt frame)
+        payload_len = 0
+        for i in range(nbuf):
+            if i not in expected:
+                raise ValueError(f"header declares {nbuf} buffers but "
+                                 f"describes no buffer {i}")
+            payload_len += 8 + expected[i]
+        buf = pool.get(payload_len)
+        _recv_exact_into(sock, memoryview(buf))
+        views = decode_payload(buf)
+        if len(views) != nbuf:
+            raise ValueError(f"{len(views)} buffers on wire, header "
+                             f"declares {nbuf}")
+        for i, v in enumerate(views):
+            if v.nbytes != expected[i]:
+                raise ValueError(
+                    f"buffer {i} carries {v.nbytes} bytes, header expects "
+                    f"{expected[i]}")
+        return _decode_node(header["tree"], views, copy=False)
     buffers: List[bytes] = []
-    for i in range(header["nbuf"]):
+    for i in range(nbuf):
         (blen,) = _U64.unpack(_recv_exact(sock, 8))
         if blen != expected.get(i, -1):
             raise ValueError(
@@ -251,7 +352,8 @@ def recv_data(sock: socket.socket) -> Any:
 
 def send_opcode(sock: socket.socket, op: bytes) -> None:
     """Send a 1-byte action opcode (reference protocol: ``'p'`` pull /
-    ``'c'`` commit; we add ``'q'`` quit)."""
+    ``'c'`` commit; we add ``'u'`` update = commit+pull in one round trip,
+    and ``'q'`` quit)."""
     assert len(op) == 1
     sock.sendall(op)
 

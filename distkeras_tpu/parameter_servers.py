@@ -50,6 +50,9 @@ class ParameterServer:
             np.array(w, dtype=np.float32, copy=True)
             for w in model_blob["weights"]]
         self.num_updates = 0
+        # the APPLY lock: guards center + clock only.  Connection
+        # bookkeeping lives behind SocketParameterServer's own lock, so N
+        # workers' commits never serialize behind accept/teardown state.
         self._lock = threading.Lock()
 
     def initialize(self):
@@ -64,9 +67,24 @@ class ParameterServer:
             {"model": self.model_blob["model"], "weights": self.center})
         return FittedModel(model, params)
 
-    # -- the per-algorithm apply rule (subclasses override) ------------------
-    def handle_commit(self, msg: Dict[str, Any]):
+    # -- the per-algorithm apply rule (subclasses override _apply) -----------
+    def _apply(self, msg: Dict[str, Any]):
+        """Apply one commit to the center.  Called with ``_lock`` HELD."""
         raise NotImplementedError
+
+    def handle_commit(self, msg: Dict[str, Any]):
+        with self._lock:
+            self._apply(msg)
+
+    def handle_update(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """``'u'`` = commit+pull: apply the delta and snapshot center+clock
+        under ONE lock acquisition, so the reply is exactly the center this
+        commit produced (plus any commits that landed before it) — the
+        atomic combined round trip the overlapped workers ride."""
+        with self._lock:
+            self._apply(msg)
+            return {"weights": [w.copy() for w in self.center],
+                    "clock": self.num_updates}
 
     def handle_pull(self) -> Dict[str, Any]:
         with self._lock:
@@ -79,12 +97,11 @@ class DeltaParameterServer(ParameterServer):
     the elastic family's PS; for EASGD the committed 'delta' is the elastic
     term, so the same rule applies)."""
 
-    def handle_commit(self, msg):
+    def _apply(self, msg):
         delta = _as_f32(msg["delta"])
-        with self._lock:
-            for c, d in zip(self.center, delta):
-                c += d
-            self.next_update()
+        for c, d in zip(self.center, delta):
+            c += d
+        self.next_update()
 
 
 class ADAGParameterServer(ParameterServer):
@@ -97,13 +114,12 @@ class ADAGParameterServer(ParameterServer):
         super().__init__(model_blob)
         self.num_workers = max(int(num_workers), 1)
 
-    def handle_commit(self, msg):
+    def _apply(self, msg):
         delta = _as_f32(msg["delta"])
         scale = 1.0 / self.num_workers
-        with self._lock:
-            for c, d in zip(self.center, delta):
-                c += scale * d
-            self.next_update()
+        for c, d in zip(self.center, delta):
+            c += scale * d
+        self.next_update()
 
 
 class DynSGDParameterServer(ParameterServer):
@@ -112,14 +128,13 @@ class DynSGDParameterServer(ParameterServer):
     since this worker's last pull (the commit's ``clock`` field) — exactly
     ``rules.dynsgd_commit``."""
 
-    def handle_commit(self, msg):
+    def _apply(self, msg):
         delta = _as_f32(msg["delta"])
-        with self._lock:
-            staleness = max(self.num_updates - int(msg.get("clock", 0)), 0)
-            scale = 1.0 / (staleness + 1.0)
-            for c, d in zip(self.center, delta):
-                c += scale * d
-            self.next_update()
+        staleness = max(self.num_updates - int(msg.get("clock", 0)), 0)
+        scale = 1.0 / (staleness + 1.0)
+        for c, d in zip(self.center, delta):
+            c += scale * d
+        self.next_update()
 
 
 class SocketParameterServer:
@@ -139,7 +154,7 @@ class SocketParameterServer:
         self._accept_thread: Optional[threading.Thread] = None
         self._conn_threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
-        self._state_lock = threading.Lock()  # guards _conns/_conn_threads/_running
+        self._conn_lock = threading.Lock()  # guards _conns/_conn_threads/_running
         self._running = False
 
     # -- lifecycle (reference: initialize/start/stop) ------------------------
@@ -164,7 +179,7 @@ class SocketParameterServer:
         connection to kick handler threads out of ``recv`` before joining
         them.
         """
-        with self._state_lock:
+        with self._conn_lock:
             was_running = self._running
             self._running = False
         if was_running and self._server is not None:
@@ -181,7 +196,7 @@ class SocketParameterServer:
                 self._server.close()
             except OSError:
                 pass
-        with self._state_lock:
+        with self._conn_lock:
             conns, threads = list(self._conns), list(self._conn_threads)
             self._conns.clear()
         for c in conns:
@@ -206,7 +221,7 @@ class SocketParameterServer:
                 conn, _ = self._server.accept()
             except OSError:
                 return  # socket closed by stop()
-            with self._state_lock:
+            with self._conn_lock:
                 if not self._running:  # stop()'s wake connection, or late join
                     try:
                         conn.close()
@@ -223,7 +238,7 @@ class SocketParameterServer:
 
     def _handle_connection(self, conn: socket.socket):
         """Reference: ``handle_connection`` — loop on 1-byte actions until
-        EOF/quit ('p' pull, 'c' commit, 'q' quit)."""
+        EOF/quit ('p' pull, 'c' commit, 'u' commit+pull, 'q' quit)."""
         try:
             while True:
                 op = networking.recv_opcode(conn)
@@ -231,7 +246,7 @@ class SocketParameterServer:
                     return
                 if op == b"p":
                     networking.send_data(conn, self.ps.handle_pull())
-                elif op == b"c":
+                elif op in (b"c", b"u"):
                     try:
                         msg = networking.recv_data(conn)
                     except ValueError:
@@ -246,7 +261,13 @@ class SocketParameterServer:
                             for q, s in zip(msg["delta"], msg.pop("scales"))]
                     # apply-rule errors deliberately propagate (visible
                     # thread traceback) — only transport faults are silent
-                    self.ps.handle_commit(msg)
+                    if op == b"c":
+                        self.ps.handle_commit(msg)
+                    else:
+                        # 'u': apply + snapshot atomically, reply in the
+                        # same round trip (one DCN RTT per window instead
+                        # of a commit send followed by a pull round trip)
+                        networking.send_data(conn, self.ps.handle_update(msg))
                 else:
                     return  # protocol violation: drop the connection
         except (ConnectionError, OSError):
@@ -259,7 +280,7 @@ class SocketParameterServer:
             except OSError:
                 pass
             me = threading.current_thread()
-            with self._state_lock:
+            with self._conn_lock:
                 if conn in self._conns:
                     self._conns.remove(conn)
                 if me in self._conn_threads:
@@ -351,6 +372,7 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
 
     workers = [worker_cls(blob, **kw) for _ in range(n)]
     share_compiled_state(workers)  # compile the window program once, not N×
+    trainer._ps_workers = workers  # observability: transport counters (bench)
 
     ckpt = None
     start_epoch = 0
@@ -504,6 +526,7 @@ def _worker_kwargs(trainer, n: int, rows: int) -> dict:
         gradient_accumulation=accum,
         gradient_clip_norm=getattr(trainer, "gradient_clip_norm", None),
         wire_dtype=getattr(trainer, "wire_dtype", None),
+        comm_overlap=getattr(trainer, "comm_overlap", False),
         fault_injection=getattr(trainer, "fault_injection", None))
     if trainer.ALGORITHM in ("aeasgd", "eamsgd"):
         kw["rho"] = getattr(trainer, "rho", 5.0)

@@ -582,9 +582,25 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     threads share the PS).  The SPMD engine is bulk-synchronous with exactly
     one worker per chip, so a factor > 1 is rejected there rather than
     silently ignored.
+
+    ``comm_overlap`` (PS engines only): pipeline the worker↔PS transport —
+    every communication window becomes ONE combined 'u' (commit+pull) round
+    trip whose reply is received while the next window's jitted compute
+    runs, so the DCN latency hides behind the device instead of idling it.
+    The center each window trains against is one window stale.  ``None``
+    (default) resolves per algorithm: ON for the delta family
+    (DOWNPOUR/ADAG/DynSGD — staleness-tolerant by construction, Dean et
+    al. 2012), OFF for the elastic family (its force term prefers a fresh
+    center; pass ``comm_overlap=True`` to trade one window of center
+    staleness for the hidden round trip).  The SPMD engine has no wire to
+    overlap, so an explicit setting there is rejected.
     """
 
-    def __init__(self, keras_model, *, parallelism_factor: int = 1, **kw):
+    #: algorithms whose per-algorithm comm_overlap default is ON
+    _OVERLAP_DEFAULT_ON = ("downpour", "adag", "dynsgd")
+
+    def __init__(self, keras_model, *, parallelism_factor: int = 1,
+                 comm_overlap: Optional[bool] = None, **kw):
         super().__init__(keras_model, **kw)
         self.parallelism_factor = int(parallelism_factor)
         if self.parallelism_factor < 1:
@@ -593,6 +609,19 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             raise ValueError(
                 "parallelism_factor > 1 requires execution='host_ps' (the "
                 "SPMD engine runs exactly one worker per chip)")
+        if comm_overlap is not None and self.execution not in (
+                "host_ps", "process_ps"):
+            raise ValueError(
+                "comm_overlap applies to the PS transports (execution="
+                "'host_ps'/'process_ps'); the SPMD program exchanges deltas "
+                "over ICI inside XLA — there is no wire to overlap")
+        self._comm_overlap = comm_overlap
+
+    @property
+    def comm_overlap(self) -> bool:
+        if self._comm_overlap is not None:
+            return bool(self._comm_overlap)
+        return self.ALGORITHM in self._OVERLAP_DEFAULT_ON
 
 
 class SynchronousDistributedTrainer(DistributedTrainer):

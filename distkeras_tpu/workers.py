@@ -15,11 +15,18 @@ the commit.  The update-rule math mirrors the SPMD engine's pure functions in
 ``parallel/rules.py`` (equivalence is asserted by tests/test_host_ps.py);
 only the execution differs (true asynchronous hogwild commits against a live
 PS, vs. deterministic bulk-synchronous rounds).
+
+With ``comm_overlap`` the transport is additionally *pipelined*: each window
+becomes one combined ``'u'`` (commit+pull) round trip whose reply is
+received while the next window's jitted compute runs, so the DCN latency
+hides behind the device (see ``PSWorker._train_epoch_overlapped`` and
+docs/host_ps.md for the per-algorithm staleness contract).
 """
 
 from __future__ import annotations
 
 import socket
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -100,7 +107,14 @@ class Worker:
             return (params, opt_state,
                     jnp.sum(losses * wsums) / jnp.maximum(jnp.sum(wsums), 1.0))
 
-        self._window_fn = jax.jit(window)
+        # donate params/opt_state: the window updates them in place instead
+        # of holding input and output copies live at once — same contract as
+        # the SPMD engine's epoch/round programs (parallel/spmd.py donates
+        # its carry), halving peak device memory per worker thread.  Callers
+        # never reuse the passed-in state (they rebind to the outputs); the
+        # shared ``_params0`` template and driver-held wave states are
+        # defensively copied before entering the loop.
+        self._window_fn = jax.jit(window, donate_argnums=(0, 1))
         return self._window_fn
 
     def _weights_to_params(self, weights: List[np.ndarray]):
@@ -139,7 +153,9 @@ class SequentialWorker(Worker):
     def train(self, index: int, shard: Dict[str, np.ndarray]) -> dict:
         model = self._ensure_model()
         window_fn = self._build_window_fn()
-        params = self._params0
+        # the window fn donates params/opt_state; _params0 is the shared
+        # template (share_compiled_state) and must survive — train on a copy
+        params = jax.tree_util.tree_map(jnp.array, self._params0)
         opt_state = self._tx.init(params)
         rng = jax.random.PRNGKey(self.seed + index)
         for epoch in range(self.num_epoch):
@@ -168,11 +184,20 @@ class PSWorker(Worker):
     def __init__(self, model_blob, worker_optimizer, loss, ps_host: str,
                  ps_port: int, communication_window: int = 5,
                  wire_dtype: Optional[str] = None,
+                 comm_overlap: bool = False,
                  fault_injection: Optional[dict] = None, **kw):
         super().__init__(model_blob, worker_optimizer, loss, **kw)
         self.ps_host = ps_host
         self.ps_port = ps_port
         self.window = int(communication_window)
+        # comm_overlap: pipeline the transport — one combined 'u'
+        # (commit+pull) round trip per window, received while the NEXT
+        # window's jitted compute runs, so the DCN latency hides behind the
+        # device (see _train_epoch_overlapped for the staleness contract)
+        self.comm_overlap = bool(comm_overlap)
+        #: messages initiated toward the PS (each 'p'/'c'/'u' counts 1) —
+        #: the transport-cost observable bench.py and tests read
+        self.transport_ops = 0
         # fault injection (SURVEY §5: the reference had none): worker id ->
         # commit budget; the worker raises at its budget+1-th commit.  Keys
         # arrive as strings after a JSON round-trip (process engine).
@@ -189,11 +214,30 @@ class PSWorker(Worker):
                            else None)
         self._residual: Optional[List[np.ndarray]] = None
         self._sock: Optional[socket.socket] = None
+        self._pool: Optional[networking.BufferPool] = None
         self._last_clock = 0
 
     # -- wire ---------------------------------------------------------------
-    def connect(self):
-        self._sock = networking.connect(self.ps_host, self.ps_port)
+    def connect(self, attempts: int = 10, backoff: float = 0.05):
+        """Dial the PS with bounded retry-with-backoff: a worker that starts
+        before the PS accept loop is up — or reconnects across a PS restart
+        — retries ``ConnectionRefusedError`` with exponential backoff (~9 s
+        worst case at the defaults) instead of dying on the first refusal.
+        Every fresh connection gets a fresh receive-buffer pool: center
+        pulls decode into reusable preallocated memory."""
+        attempts = max(int(attempts), 1)
+        last: Optional[Exception] = None
+        for i in range(attempts):
+            try:
+                self._sock = networking.connect(self.ps_host, self.ps_port)
+                self._pool = networking.BufferPool()
+                return
+            except ConnectionRefusedError as e:
+                last = e
+                time.sleep(min(backoff * (2 ** i), 2.0))
+        raise ConnectionError(
+            f"PS at {self.ps_host}:{self.ps_port} refused {attempts} "
+            "connection attempts") from last
 
     def disconnect(self):
         if self._sock is not None:
@@ -205,31 +249,23 @@ class PSWorker(Worker):
             self._sock = None
 
     def pull(self) -> List[np.ndarray]:
-        """'p': fetch center weights + PS clock (reference: Worker.pull)."""
+        """'p': fetch center weights + PS clock (reference: Worker.pull).
+
+        The reply decodes through the connection's buffer pool: the returned
+        weights are zero-copy VIEWS into reusable memory, valid until the
+        next receive on this connection — callers move them to device (or
+        consume them arithmetically) before their next transport call.
+        """
         networking.send_opcode(self._sock, b"p")
-        msg = networking.recv_data(self._sock)
+        msg = networking.recv_data(self._sock, pool=self._pool)
         self._last_clock = int(msg["clock"])
+        self.transport_ops += 1
         return msg["weights"]
 
-    def commit(self, delta: List[np.ndarray], worker_id: int):
-        """'c': push a weight-shaped delta (reference: Worker.commit).
-
-        Returns the delta the PS will actually APPLY (after any wire
-        compression) so callers whose local state must stay coupled to the
-        center — the elastic family subtracts what it committed — can use
-        the as-applied value instead of the pre-compression one.
-
-        ``wire_dtype="bfloat16"``: the delta is rounded to bf16 on the wire
-        (half the DCN bytes; the PS upcasts before applying).
-
-        ``wire_dtype="int8"``: per-tensor affine quantization — each tensor
-        ships as int8 codes + one f32 scale (max|d|/127), a 4x byte cut —
-        with ERROR FEEDBACK: the quantization error of every window is
-        carried into the next window's delta, so compression noise
-        telescopes instead of accumulating in the center (the 1-bit-SGD /
-        EF-SGD recipe).  Lossy compression the reference's pickle transport
-        had no counterpart for.
-        """
+    def _prepare_commit(self, delta: List[np.ndarray], worker_id: int):
+        """Fault-injection gate + wire compression shared by 'c' and 'u'.
+        Returns ``(msg, applied)``: the wire message and the delta the PS
+        will actually apply after decompression (see ``commit``)."""
         self._commits += 1
         budget = self.fault_injection.get(worker_id)
         if budget is not None and self._commits > budget:
@@ -256,23 +292,66 @@ class PSWorker(Worker):
             applied = [c.astype(np.float32) * s
                        for c, s in zip(codes, scales)]
             self._residual = [e - a for e, a in zip(eff, applied)]
-            networking.send_opcode(self._sock, b"c")
-            networking.send_data(self._sock, {
-                "delta": codes,
-                "scales": scales,
-                "worker_id": worker_id,
-                "clock": self._last_clock,
-            })
-            return applied
+            return ({"delta": codes, "scales": scales,
+                     "worker_id": worker_id, "clock": self._last_clock},
+                    applied)
         if self.wire_dtype is not None:
             delta = [d.astype(self.wire_dtype) for d in delta]
+        return ({"delta": delta, "worker_id": worker_id,
+                 "clock": self._last_clock},
+                [np.asarray(d, dtype=np.float32) for d in delta])
+
+    def commit(self, delta: List[np.ndarray], worker_id: int):
+        """'c': push a weight-shaped delta (reference: Worker.commit).
+
+        Returns the delta the PS will actually APPLY (after any wire
+        compression) so callers whose local state must stay coupled to the
+        center — the elastic family subtracts what it committed — can use
+        the as-applied value instead of the pre-compression one.
+
+        ``wire_dtype="bfloat16"``: the delta is rounded to bf16 on the wire
+        (half the DCN bytes; the PS upcasts before applying).
+
+        ``wire_dtype="int8"``: per-tensor affine quantization — each tensor
+        ships as int8 codes + one f32 scale (max|d|/127), a 4x byte cut —
+        with ERROR FEEDBACK: the quantization error of every window is
+        carried into the next window's delta, so compression noise
+        telescopes instead of accumulating in the center (the 1-bit-SGD /
+        EF-SGD recipe).  Lossy compression the reference's pickle transport
+        had no counterpart for.
+        """
+        msg, applied = self._prepare_commit(delta, worker_id)
         networking.send_opcode(self._sock, b"c")
-        networking.send_data(self._sock, {
-            "delta": delta,
-            "worker_id": worker_id,
-            "clock": self._last_clock,
-        })
-        return [np.asarray(d, dtype=np.float32) for d in delta]
+        networking.send_data(self._sock, msg)
+        self.transport_ops += 1
+        return applied
+
+    def update_begin(self, delta: List[np.ndarray], worker_id: int):
+        """'u' part 1: ship the delta (same fault-injection + compression
+        contract as ``commit``; returns the as-applied delta).  The PS's
+        combined reply — the center *after this commit* + clock, snapshotted
+        atomically — is collected by ``update_finish``; overlapped callers
+        run device compute between the two halves so the round trip costs
+        no device idle time."""
+        msg, applied = self._prepare_commit(delta, worker_id)
+        networking.send_opcode(self._sock, b"u")
+        networking.send_data(self._sock, msg)
+        self.transport_ops += 1
+        return applied
+
+    def update_finish(self) -> List[np.ndarray]:
+        """'u' part 2: receive the center+clock reply for the
+        ``update_begin`` in flight (pool-decoded views, as ``pull``)."""
+        msg = networking.recv_data(self._sock, pool=self._pool)
+        self._last_clock = int(msg["clock"])
+        return msg["weights"]
+
+    def update(self, delta: List[np.ndarray], worker_id: int):
+        """Blocking combined commit+pull: ONE round trip where the serial
+        'c'+'p' pair pays a send plus a full round trip.  Returns
+        ``(applied_delta, center_weights)``."""
+        applied = self.update_begin(delta, worker_id)
+        return applied, self.update_finish()
 
     # -- the training loop ---------------------------------------------------
     def train(self, index: int, shard: Dict[str, np.ndarray],
@@ -291,11 +370,20 @@ class PSWorker(Worker):
         self.connect()
         try:
             if initial_state is None:
-                params = self._weights_to_params(self.pull())
+                center = self.pull()
+                params = self._weights_to_params(center)
                 opt_state = self._tx.init(params)
             else:
                 params, opt_state = initial_state
-                self.pull()  # sync the PS clock (DynSGD staleness baseline)
+                # the window fn DONATES its params/opt_state arguments; the
+                # driver keeps this state object across waves (fault
+                # tolerance falls back to it if this worker dies) — train
+                # on a device copy so the original stays materializable
+                params = jax.tree_util.tree_map(jnp.array, params)
+                opt_state = jax.tree_util.tree_map(jnp.array, opt_state)
+                # sync the PS clock (DynSGD staleness baseline); the weights
+                # double as the overlap loop's initial center snapshot
+                center = self.pull()
             start, stop = (epoch_range if epoch_range is not None
                            else (0, self.num_epoch))
             for epoch in range(start, stop):
@@ -303,12 +391,17 @@ class PSWorker(Worker):
                     shard, self.window, self.seed + 1000 * epoch + index)
                 rng = jax.random.fold_in(
                     jax.random.PRNGKey(self.seed + 100 + index), epoch)
-                for i in range(len(xw)):
-                    rng, sub = jax.random.split(rng)
-                    params, opt_state, loss = self._window_step(
-                        window_fn, params, opt_state, xw[i], yw[i], mw[i],
-                        sub, index)
-                    self.history.append(float(loss))
+                if self.comm_overlap:
+                    params, opt_state, center = self._train_epoch_overlapped(
+                        window_fn, params, opt_state, xw, yw, mw, rng,
+                        index, center)
+                else:
+                    for i in range(len(xw)):
+                        rng, sub = jax.random.split(rng)
+                        params, opt_state, loss = self._window_step(
+                            window_fn, params, opt_state, xw[i], yw[i],
+                            mw[i], sub, index)
+                        self.history.append(float(loss))
         finally:
             self.disconnect()
         return {"history": self.history, "state": (params, opt_state)}
@@ -316,6 +409,73 @@ class PSWorker(Worker):
     def _window_step(self, window_fn, params, opt_state, xw, yw, mw, rng,
                      index: int):
         raise NotImplementedError
+
+    # -- overlapped (pipelined) window loop -----------------------------------
+    def _train_epoch_overlapped(self, window_fn, params, opt_state, xw, yw,
+                                mw, rng, index: int, center):
+        """Double-buffered window loop: ONE combined 'u' round trip per
+        window, received while the NEXT window's jitted compute runs.
+
+        Per window the loop (1) async-dispatches the jitted window program
+        (JAX queues the host→device transfers and the XLA computation and
+        returns immediately), (2) blocks on the *previous* window's 'u'
+        reply — the DCN round trip rides the wire while the device works,
+        (3) materializes this window's weights, ships the delta with
+        ``update_begin``, and rebases the next window's input via the
+        per-algorithm ``_overlap_next`` hook.
+
+        Staleness contract: each window trains against a center that is one
+        window stale — exactly the tolerance the DOWNPOUR family is built
+        on (Dean et al., NIPS 2012: workers tolerate stale centers), and
+        DynSGD's clock field keeps pricing that staleness into the PS-side
+        scale.  The elastic family couples through the as-applied delta
+        (``applied``), so x and x̃ still move by the same elastic term.
+        """
+        base = self._params_to_weights(params)
+        pending = False
+        for i in range(len(xw)):
+            rng, sub = jax.random.split(rng)
+            # async dispatch: the window program starts on the device now
+            params, opt_state, loss = window_fn(
+                params, opt_state, jnp.asarray(xw[i]), jnp.asarray(yw[i]),
+                jnp.asarray(mw[i]), sub)
+            if pending:
+                # the previous window's reply arrives while this window
+                # computes — the transport hides behind the device
+                center = self.update_finish()
+                pending = False
+            after = self._params_to_weights(params)  # blocks on the device
+            delta = self._overlap_delta(base, after, center)
+            applied = self.update_begin(delta, index)
+            pending = True
+            base = self._overlap_next(base, after, applied, center)
+            params = self._weights_to_params(base)
+            self.history.append(float(loss))
+        if pending:
+            # drain the last reply so the epoch (and any checkpoint wave
+            # joined after it) observes a center that includes every commit
+            center = self.update_finish()
+            params = self._weights_to_params(self._overlap_drain(base, center))
+        return params, opt_state, center
+
+    # DOWNPOUR-family overlap hooks (ADAG/DynSGD inherit; the elastic
+    # family overrides below)
+    def _overlap_delta(self, base, after, center):
+        """Delta to ship for a window whose input weights were ``base`` and
+        output weights ``after``; ``center`` is the last-received center."""
+        return [a - b for a, b in zip(after, base)]
+
+    def _overlap_next(self, base, after, applied, center):
+        """Weights the next window trains from: the one-window-stale center
+        plus this window's as-applied delta (the run-ahead analogue of the
+        serial loop's post-commit re-pull)."""
+        return [np.asarray(c, np.float32) + a
+                for c, a in zip(center, applied)]
+
+    def _overlap_drain(self, base, center):
+        """Weights to finish the epoch on once the last reply landed (the
+        serial loop ends every window on a fresh pull)."""
+        return center
 
 
 class DOWNPOURWorker(PSWorker):
@@ -378,6 +538,20 @@ class AEASGDWorker(PSWorker):
         applied = self.commit(elastic, index)
         local = [l - e for l, e in zip(local, applied)]
         return self._weights_to_params(local), opt_state, loss
+
+    # overlap hooks: the elastic force is computed against the last-received
+    # center (one window stale under comm_overlap — EASGD's coupling is
+    # explicitly tolerant of the communication period); x keeps moving by
+    # exactly the as-applied e, so x and x̃ stay coupled under lossy wire
+    # dtypes, same as the serial path
+    def _overlap_delta(self, base, after, center):
+        return [self.alpha * (a - c) for a, c in zip(after, center)]
+
+    def _overlap_next(self, base, after, applied, center):
+        return [a - e for a, e in zip(after, applied)]
+
+    def _overlap_drain(self, base, center):
+        return base  # the elastic worker keeps its persistent local model
 
 
 class EAMSGDWorker(AEASGDWorker):
