@@ -115,21 +115,12 @@ def last_tpu_summary():
              "batch", "window", "captured_unix")}
 
 
-def host_ps_microbench(budget_s: float = 90.0):
-    """PS-path microbenchmark: a small ADAG run over the live socket PS on
-    loopback, measuring the transport pipelining win as data, not assertion.
-
-    Returns ``{"host_ps_examples_per_sec": float,
-    "host_ps_rtts_per_window": float}`` — RTTs/window is transport messages
-    initiated per communication window, excluding each worker's initial
-    pull: 2.0 on the serial 'c'+'p' path, 1.0 with ``comm_overlap`` (the
-    combined 'u' opcode, reply hidden behind the next window's compute).
-    Returns None values if the run exceeds sanity bounds or fails — the
-    north-star artifact must exist either way.
-    """
+def _host_ps_fixture():
+    """Shared small workload for the PS-path microbenchmarks: a 4-class
+    blob dataset and a 2-layer MLP (same shapes as tests/test_host_ps.py)."""
     import numpy as np
 
-    from distkeras_tpu import ADAG, Dataset
+    from distkeras_tpu import Dataset
     from distkeras_tpu.core.layers import Dense
     from distkeras_tpu.core.model import Sequential
 
@@ -143,6 +134,24 @@ def host_ps_microbench(budget_s: float = 90.0):
     model = Sequential([Dense(32, activation="relu"),
                         Dense(classes, activation="softmax")],
                        input_shape=(d,), compute_dtype="float32")
+    return ds, model, n
+
+
+def host_ps_microbench(budget_s: float = 90.0):
+    """PS-path microbenchmark: a small ADAG run over the live socket PS on
+    loopback, measuring the transport pipelining win as data, not assertion.
+
+    Returns ``{"host_ps_examples_per_sec": float,
+    "host_ps_rtts_per_window": float}`` — RTTs/window is transport messages
+    initiated per communication window, excluding each worker's initial
+    pull: 2.0 on the serial 'c'+'p' path, 1.0 with ``comm_overlap`` (the
+    combined 'u' opcode, reply hidden behind the next window's compute).
+    Returns None values if the run exceeds sanity bounds or fails — the
+    north-star artifact must exist either way.
+    """
+    from distkeras_tpu import ADAG
+
+    ds, model, n = _host_ps_fixture()
     # num_workers=1 + parallelism_factor=2 → two true-async worker threads
     # against the PS without needing a multi-device mesh (the bench process
     # may see a single CPU device)
@@ -164,6 +173,41 @@ def host_ps_microbench(budget_s: float = 90.0):
         "host_ps_rtts_per_window": (round(rtts_per_window, 3)
                                     if rtts_per_window is not None else None),
     }
+
+
+def host_ps_shard_bench(budget_s: float = 120.0):
+    """Shard-scaling observable: the same small ADAG host-PS run at
+    ``ps_shards=1`` vs ``ps_shards=4`` (docs/host_ps.md).  At this
+    loopback/toy scale the numbers mostly prove the sharded path carries
+    full training throughput — the PS-CPU/NIC relief shows up at DCN scale;
+    per-shard RTT accounting is asserted by tests/test_ps_sharding.py.
+
+    Returns ``{"host_ps_shard_scaling": {"shards1_examples_per_sec": ...,
+    "shards4_examples_per_sec": ...}}`` (Nones on overrun/failure — never
+    fatal to the north-star artifact).
+    """
+    from distkeras_tpu import ADAG
+
+    ds, model, n = _host_ps_fixture()
+    out = {}
+    t_start = time.perf_counter()
+    # warmup: compile the shared window program once so neither measured run
+    # pays the jit cost (the N=1 run would otherwise eat it and inflate the
+    # apparent shard speedup)
+    ADAG(model, num_workers=1, parallelism_factor=2, batch_size=32,
+         num_epoch=1, communication_window=4, learning_rate=0.05,
+         execution="host_ps").train(ds)
+    for shards in (1, 4):
+        t = ADAG(model, num_workers=1, parallelism_factor=2, batch_size=32,
+                 num_epoch=2, communication_window=4, learning_rate=0.05,
+                 execution="host_ps", ps_shards=shards)
+        t0 = time.perf_counter()
+        t.train(ds)
+        dt = time.perf_counter() - t0
+        over = time.perf_counter() - t_start > budget_s
+        out[f"shards{shards}_examples_per_sec"] = (
+            None if over else round(n * t.num_epoch / dt, 1))
+    return {"host_ps_shard_scaling": out}
 
 
 def main():
@@ -349,6 +393,17 @@ def main():
         except Exception as e:
             print(f"[bench] host_ps microbench failed: {e}", file=sys.stderr)
     result.update(ps_fields)
+    # PS shard-scaling (ps_sharding.py): examples/sec at ps_shards=1 vs 4
+    stage("host_ps shard scaling")
+    shard_fields = {"host_ps_shard_scaling": None}
+    shard_remaining = budget - (time.perf_counter() - t_start)
+    if shard_remaining > 60:
+        try:
+            shard_fields = host_ps_shard_bench(budget_s=shard_remaining)
+        except Exception as e:
+            print(f"[bench] host_ps shard bench failed: {e}",
+                  file=sys.stderr)
+    result.update(shard_fields)
     if real_platform == "cpu":
         # CPU fallback: carry the hardware signal instead of erasing it
         result["probe_history"] = probe_history

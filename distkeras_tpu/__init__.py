@@ -26,7 +26,9 @@ from .evaluators import (Evaluator, AccuracyEvaluator, AUCEvaluator,
 from . import utils
 from . import networking
 from . import workers
+from . import ps_sharding
 from . import parameter_servers
+from .ps_sharding import PSShardDown
 from . import job_deployment
 from . import checkpoint
 from . import metrics

@@ -221,14 +221,25 @@ class BufferPool:
     ``recv_data(..., pool=...)`` call on the same pool; callers that keep
     weights across a receive must copy (the workers move them to device
     immediately, which copies).
+
+    Growth is capped: a buffer that goes ``max_idle`` consecutive
+    acquisitions without being the requested size is evicted, so a client
+    holding one pool per PS shard doesn't pin N full weight-sized buffers
+    forever after a pull-size change (e.g. a resumed run with a different
+    wire layout).  ``max_idle=None`` disables eviction.
     """
 
-    def __init__(self):
+    def __init__(self, max_idle: Optional[int] = 32):
         self._bufs: Dict[int, bytearray] = {}
+        self._last_used: Dict[int, int] = {}
+        self._acquisitions = 0
+        self.max_idle = max_idle
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     def get(self, size: int) -> bytearray:
+        self._acquisitions += 1
         buf = self._bufs.get(size)
         if buf is None:
             buf = bytearray(size)
@@ -236,6 +247,14 @@ class BufferPool:
             self.misses += 1
         else:
             self.hits += 1
+        self._last_used[size] = self._acquisitions
+        if self.max_idle is not None:
+            stale = [s for s, last in self._last_used.items()
+                     if self._acquisitions - last >= self.max_idle]
+            for s in stale:
+                del self._bufs[s]
+                del self._last_used[s]
+                self.evictions += 1
         return buf
 
 

@@ -31,6 +31,7 @@ import numpy as np
 
 from . import networking
 from .core.model import FittedModel, deserialize_model, serialize_model
+from .ps_sharding import PSShardDown, ShardedServerGroup
 from .workers import WORKER_CLASSES, share_compiled_state
 
 
@@ -351,9 +352,20 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     # reference parity (SURVEY §2.1 row 6): async trainers may run
     # parallelism_factor x num_workers concurrent tasks against the PS
     n = trainer.num_workers * getattr(trainer, "parallelism_factor", 1)
-    ps = allocate_parameter_server(algorithm, blob, n)
-    server = SocketParameterServer(ps)
-    server.start()
+    ps_shards = int(getattr(trainer, "ps_shards", 1) or 1)
+    sharded = ps_shards > 1
+    if sharded:
+        # PS sharding (ps_sharding.py): partition the center weight vector
+        # over N shard servers — each wraps the UNCHANGED per-algorithm
+        # apply rule on its slice, with its own apply lock and update clock,
+        # so staleness semantics are per-shard identical to the single-PS
+        # path and PS CPU/NIC bandwidth scales with the shard count
+        server = ShardedServerGroup(algorithm, blob, n, ps_shards)
+        server.start()
+    else:
+        ps = allocate_parameter_server(algorithm, blob, n)
+        server = SocketParameterServer(ps)
+        server.start()
 
     # deal rows round-robin per worker (Spark round-robin repartition
     # analogue): every row lands on exactly one worker, nothing dropped;
@@ -368,7 +380,12 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     worker_cls = WORKER_CLASSES[algorithm]
     kw = _worker_kwargs(trainer, n, len(x))
     kw.update(worker_optimizer=trainer.worker_optimizer,
-              ps_host="127.0.0.1", ps_port=server.port)
+              ps_host="127.0.0.1",
+              ps_port=(server.ports[0] if sharded else server.port))
+    if sharded:
+        # workers scatter-commit / gather-pull through a ShardedPSClient
+        # (one socket + one receive-buffer pool per shard)
+        kw.update(shard_plan=server.plan, shard_addrs=server.addrs)
 
     workers = [worker_cls(blob, **kw) for _ in range(n)]
     share_compiled_state(workers)  # compile the window program once, not N×
@@ -379,11 +396,18 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     states: List[Any] = [None] * n
 
     def full_state():
-        """The complete async-training state as one host pytree."""
-        with ps._lock:
-            center = [w.copy() for w in ps.center]
-            clock = ps.num_updates
-        return {"center": center, "clock": np.int64(clock),
+        """The complete async-training state as one host pytree.  Sharded
+        runs store the GATHERED center plus the per-shard clock vector, so
+        the checkpoint layout is shard-count-explicit (resume validates it
+        against this run's ps_shards via the meta)."""
+        if sharded:
+            center, clocks = server.snapshot()
+            clock = np.asarray(clocks, np.int64)
+        else:
+            with ps._lock:
+                center = [w.copy() for w in ps.center]
+                clock = np.int64(ps.num_updates)
+        return {"center": center, "clock": clock,
                 "workers": [jax.tree_util.tree_map(np.asarray, s)
                             for s in states]}
 
@@ -412,15 +436,26 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
                         f"by engine={meta.get('engine', 'spmd')!r}; this "
                         "trainer is host_ps — resume with the same "
                         "configuration")
+                if int(meta.get("ps_shards", 1)) != ps_shards:
+                    raise ValueError(
+                        f"checkpoint was saved with ps_shards="
+                        f"{meta.get('ps_shards', 1)}; this trainer has "
+                        f"ps_shards={ps_shards} — resume with the same "
+                        "configuration")
                 # template with the right pytree structure, then refill
                 head = workers[0]
-                p0 = head._weights_to_params(ps.center)
+                p0 = head._weights_to_params(
+                    server.snapshot()[0] if sharded else ps.center)
                 states = [(p0, head._tx.init(p0)) for _ in range(n)]
                 restored = ckpt.restore(full_state(), latest)
-                with ps._lock:
-                    ps.center = [np.asarray(w, np.float32)
-                                 for w in restored["center"]]
-                    ps.num_updates = int(restored["clock"])
+                if sharded:
+                    server.restore_state(restored["center"],
+                                         restored["clock"])
+                else:
+                    with ps._lock:
+                        ps.center = [np.asarray(w, np.float32)
+                                     for w in restored["center"]]
+                        ps.num_updates = int(restored["clock"])
                 states = [tuple(s) for s in restored["workers"]]
                 start_epoch = latest
 
@@ -459,6 +494,14 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
             for t in threads:
                 t.join()
             if errors:
+                # a dead SHARD is not a dead worker: it holds a partition of
+                # the center that no survivor can reconstruct, so degraded
+                # completion is impossible — surface it clearly regardless
+                # of fault_tolerance
+                shard_err = next((e for _, e in errors
+                                  if isinstance(e, PSShardDown)), None)
+                if shard_err is not None:
+                    raise shard_err
                 if not getattr(trainer, "fault_tolerance", False):
                     raise errors[0][1]
                 # degraded completion (SURVEY §5 fault table: reference
@@ -486,7 +529,8 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
             if ckpt is not None and (
                     epoch_range[1] % trainer.checkpoint_every == 0):
                 ckpt.save(epoch_range[1], full_state(),
-                          meta={"engine": "host_ps", "unit": "epoch"})
+                          meta={"engine": "host_ps", "unit": "epoch",
+                                "ps_shards": ps_shards})
     finally:
         server.stop()
         if ckpt is not None:

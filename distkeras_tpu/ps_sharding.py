@@ -1,0 +1,449 @@
+"""PS sharding — scatter/gather weight partitions across N parameter-server
+shards.
+
+The single-``ParameterServer`` path (``parameter_servers.py``) funnels every
+commit and pull through one TCP server, so PS-side CPU and NIC bandwidth cap
+asynchronous throughput no matter how many workers join — PR 1's pipelining
+hid the round-trip *latency* but not the serialization at the server.  This
+module is the standard next step in the parameter-server lineage (Li et al.,
+*Scaling Distributed Machine Learning with the Parameter Server*, OSDI 2014;
+Dean et al., DistBelief): partition the flat weight list across
+``ps_shards=N`` independent servers and talk to all of them concurrently.
+
+Three pieces:
+
+ - ``make_shard_plan`` / ``ShardPlan`` — the static partitioning: greedy
+   bin-packing of tensors by byte size, with row-wise splitting of any tensor
+   larger than ``total_bytes / N`` so one embedding matrix can't unbalance
+   the ring.  The plan is deterministic in (shapes, dtypes, N) — every worker
+   and the driver derive the identical layout with no negotiation.
+ - ``ShardedPSClient`` — the worker-side transport: one socket + one
+   receive-``BufferPool`` per shard; commits scatter (each shard gets only
+   its slices), pulls gather.  Requests go out on every shard before any
+   reply is read, so the N round trips ride the wire concurrently, and the
+   combined ``'u'`` commit+pull opcode pipelines per shard exactly as on the
+   single-PS path — the 1-RTT-per-window overlap property is preserved
+   end to end, per shard.
+ - ``ShardedServerGroup`` — the driver-side lifecycle: N
+   ``SocketParameterServer`` instances, each wrapping the *unchanged*
+   per-algorithm apply rule (Delta/ADAG/DynSGD) on its slice of the center.
+
+Semantics: every shard runs the full opcode protocol with its own apply lock
+and its own update clock; a worker's commit carries the per-shard last-seen
+clock, so DynSGD's staleness pricing is per-shard identical to the single-PS
+path.  All apply rules are elementwise over the weight vector, so for a
+single worker (no hogwild interleaving) an ``N``-shard run is bit-identical
+to the single-PS run — and ``N=1`` degenerates to one server holding the
+whole (unsplit, original-order) weight list.
+
+A dead shard is not a dead worker: it holds a slice of the center that no
+survivor can reconstruct, so shard-transport failures surface as
+``PSShardDown(shard_id)`` (a ``ConnectionError`` subclass) and the driver
+raises it even under ``fault_tolerance=True``.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import networking
+
+
+class PSShardDown(ConnectionError):
+    """A parameter-server *shard* is unreachable.
+
+    Distinct from a worker death (which the PS engines can tolerate): a
+    shard holds a partition of the center weights, so losing one loses part
+    of the model — ``run_host_ps_training`` re-raises this even under
+    ``fault_tolerance=True`` instead of degrading to survivors.
+    """
+
+    def __init__(self, shard_id: int, addr: Optional[Tuple[str, int]] = None,
+                 detail: Optional[str] = None):
+        self.shard_id = int(shard_id)
+        self.addr = addr
+        msg = f"PS shard {self.shard_id}"
+        if addr is not None:
+            msg += f" at {addr[0]}:{addr[1]}"
+        msg += " is down"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class ShardSlice(NamedTuple):
+    """One contiguous leading-axis row range of one tensor, assigned to a
+    shard.  ``(0, rows)`` means the whole tensor; 0-d tensors use rows=1."""
+
+    tensor: int
+    start: int
+    stop: int
+
+
+def _rows(shape: Tuple[int, ...]) -> int:
+    return shape[0] if shape else 1
+
+
+class ShardPlan:
+    """Deterministic partition of a flat tensor list over ``num_shards``.
+
+    ``assignments[j]`` is shard j's ordered slice list; the wire layout of a
+    shard (slice order, shapes) is a pure function of the plan, so both ends
+    of every connection agree without negotiation.
+    """
+
+    def __init__(self, shapes: Sequence[Tuple[int, ...]], dtypes: Sequence,
+                 num_shards: int, assignments: List[List[ShardSlice]]):
+        self.shapes = [tuple(int(d) for d in s) for s in shapes]
+        self.dtypes = [np.dtype(d) for d in dtypes]
+        self.num_shards = int(num_shards)
+        self.assignments = assignments
+
+    def slice_bytes(self, s: ShardSlice) -> int:
+        shape = self.shapes[s.tensor]
+        per_row = (self.dtypes[s.tensor].itemsize
+                   * int(np.prod(shape[1:], dtype=np.int64)))
+        return (s.stop - s.start) * per_row
+
+    def shard_bytes(self) -> List[int]:
+        return [sum(self.slice_bytes(s) for s in a) for a in self.assignments]
+
+    @staticmethod
+    def take(arr: np.ndarray, s: ShardSlice) -> np.ndarray:
+        """The slice of ``arr`` a ``ShardSlice`` names (view, no copy)."""
+        arr = np.asarray(arr)
+        return arr if arr.ndim == 0 else arr[s.start:s.stop]
+
+    def scatter(self, tensors: Sequence[np.ndarray]
+                ) -> List[List[np.ndarray]]:
+        """Full tensor list → per-shard slice lists (views, zero-copy)."""
+        return [[self.take(tensors[s.tensor], s) for s in a]
+                for a in self.assignments]
+
+    def gather(self, shard_tensors: Sequence[Sequence[np.ndarray]]
+               ) -> List[np.ndarray]:
+        """Per-shard slice lists → full tensor list (freshly allocated, so
+        pooled receive views are safe to hand the result off)."""
+        out = [np.empty(s, d) for s, d in zip(self.shapes, self.dtypes)]
+        for pieces, arrs in zip(self.assignments, shard_tensors):
+            if len(pieces) != len(arrs):
+                raise ValueError(
+                    f"shard carries {len(arrs)} tensors, plan expects "
+                    f"{len(pieces)}")
+            for s, a in zip(pieces, arrs):
+                t = out[s.tensor]
+                if t.ndim == 0:
+                    t[...] = np.asarray(a)
+                else:
+                    t[s.start:s.stop] = np.asarray(a)
+        return out
+
+
+def make_shard_plan(shapes: Sequence[Tuple[int, ...]], dtypes: Sequence,
+                    num_shards: int) -> ShardPlan:
+    """Partition tensors over shards: greedy bin-packing by byte size,
+    splitting any tensor larger than ``total_bytes / num_shards`` row-wise
+    (leading axis) into near-equal pieces first, so one oversized embedding
+    cannot unbalance the ring.  ``num_shards=1`` is the identity plan: one
+    shard, whole tensors, original order.
+    """
+    num_shards = int(num_shards)
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    shapes = [tuple(int(d) for d in s) for s in shapes]
+    dtypes = [np.dtype(d) for d in dtypes]
+    if len(shapes) != len(dtypes):
+        raise ValueError("shapes and dtypes must align")
+    sizes = [dt.itemsize * int(np.prod(s, dtype=np.int64))
+             for s, dt in zip(shapes, dtypes)]
+    if num_shards == 1:
+        whole = [ShardSlice(t, 0, _rows(s)) for t, s in enumerate(shapes)]
+        return ShardPlan(shapes, dtypes, 1, [whole])
+
+    total = sum(sizes)
+    threshold = max(-(-total // num_shards), 1)
+    pieces: List[ShardSlice] = []
+    for t, (shape, nb) in enumerate(zip(shapes, sizes)):
+        rows = _rows(shape)
+        if nb > threshold and rows > 1:
+            k = min(rows, -(-nb // threshold))
+            bounds = [(i * rows) // k for i in range(k + 1)]
+            pieces.extend(ShardSlice(t, bounds[i], bounds[i + 1])
+                          for i in range(k) if bounds[i + 1] > bounds[i])
+        else:
+            pieces.append(ShardSlice(t, 0, rows))
+
+    plan = ShardPlan(shapes, dtypes, num_shards,
+                     [[] for _ in range(num_shards)])
+    # largest piece first onto the lightest shard (ties: lowest shard id) —
+    # the classic LPT greedy, deterministic in the input ordering
+    order = sorted(range(len(pieces)),
+                   key=lambda i: (-plan.slice_bytes(pieces[i]), i))
+    loads = [0] * num_shards
+    for i in order:
+        j = min(range(num_shards), key=lambda j: (loads[j], j))
+        plan.assignments[j].append(pieces[i])
+        loads[j] += plan.slice_bytes(pieces[i])
+    for a in plan.assignments:
+        a.sort()
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# worker side
+# ---------------------------------------------------------------------------
+
+class ShardedPSClient:
+    """Worker-side transport to N PS shards: one socket + one receive-buffer
+    pool per shard, per-shard update clocks, scatter on send / gather on
+    receive.
+
+    Every logical operation fans out over all shards with the *send phase
+    first on every shard, then the receive phase* — all N requests are in
+    flight before any reply is read, so the shard round trips overlap on the
+    wire instead of serializing.  The split-phase ``send_update`` /
+    ``recv_update`` pair mirrors ``PSWorker.update_begin/update_finish``:
+    overlapped workers run device compute between the two halves, keeping
+    the 1-RTT-per-window pipeline *per shard*.
+
+    Any transport fault on shard j (send or receive) raises
+    ``PSShardDown(j)`` instead of a bare ``ConnectionError`` from deep in
+    ``recv_data``.
+    """
+
+    def __init__(self, plan: ShardPlan, addrs: Sequence[Tuple[str, int]]):
+        if len(addrs) != plan.num_shards:
+            raise ValueError(
+                f"{len(addrs)} shard addresses for a {plan.num_shards}-shard "
+                "plan")
+        self.plan = plan
+        self.addrs = [(str(h), int(p)) for h, p in addrs]
+        self._socks: List[Optional[socket.socket]] = [None] * plan.num_shards
+        self._pools: List[Optional[networking.BufferPool]] = (
+            [None] * plan.num_shards)
+        self._clocks = [0] * plan.num_shards
+
+    @property
+    def num_shards(self) -> int:
+        return self.plan.num_shards
+
+    @property
+    def max_clock(self) -> int:
+        return max(self._clocks) if self._clocks else 0
+
+    @property
+    def pools(self) -> List[Optional[networking.BufferPool]]:
+        return self._pools
+
+    # -- lifecycle -----------------------------------------------------------
+    def connect(self, attempts: int = 10, backoff: float = 0.05):
+        """Dial every shard with the same bounded retry-with-backoff as
+        ``PSWorker.connect`` — a shard that is mid-``start()`` can refuse,
+        accept-then-reset, or time out, so all three retry."""
+        attempts = max(int(attempts), 1)
+        for j, (host, port) in enumerate(self.addrs):
+            last: Optional[Exception] = None
+            for i in range(attempts):
+                try:
+                    self._socks[j] = networking.connect(host, port)
+                    self._pools[j] = networking.BufferPool()
+                    break
+                except (ConnectionRefusedError, ConnectionResetError,
+                        socket.timeout) as e:
+                    last = e
+                    time.sleep(min(backoff * (2 ** i), 2.0))
+            else:
+                self.abort()
+                raise PSShardDown(
+                    j, (host, port),
+                    f"refused {attempts} connection attempts") from last
+
+    def disconnect(self):
+        """Graceful 'q' + close on every shard (best effort)."""
+        for j, sock in enumerate(self._socks):
+            if sock is not None:
+                try:
+                    networking.send_opcode(sock, b"q")
+                    sock.close()
+                except OSError:
+                    pass
+                self._socks[j] = None
+
+    def abort(self):
+        """Hard-close every shard socket without the graceful 'q' — each
+        shard sees a plain EOF, the signature of a worker host dying (the
+        fault-injection path)."""
+        for j, sock in enumerate(self._socks):
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._socks[j] = None
+
+    # -- transport with shard-fault attribution ------------------------------
+    def _send(self, j: int, op: bytes, payload: Optional[dict] = None):
+        try:
+            networking.send_opcode(self._socks[j], op)
+            if payload is not None:
+                networking.send_data(self._socks[j], payload)
+        except (ConnectionError, OSError) as e:
+            raise PSShardDown(j, self.addrs[j]) from e
+
+    def _recv(self, j: int) -> Dict[str, Any]:
+        try:
+            return networking.recv_data(self._socks[j], pool=self._pools[j])
+        except (ConnectionError, OSError) as e:
+            raise PSShardDown(j, self.addrs[j]) from e
+
+    def _split_commit(self, msg: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Scatter a full commit message into per-shard messages: each shard
+        gets only its delta slices (and, for int8, the parent tensor's scale
+        per slice — quantization happened on the *full* tensor, so the
+        as-applied delta is independent of the sharding), stamped with that
+        shard's own last-seen clock."""
+        deltas = msg["delta"]
+        scales = msg.get("scales")
+        out = []
+        for j, pieces in enumerate(self.plan.assignments):
+            m: Dict[str, Any] = {
+                "delta": [self.plan.take(deltas[s.tensor], s)
+                          for s in pieces],
+                "worker_id": msg.get("worker_id"),
+                "clock": self._clocks[j]}
+            if scales is not None:
+                m["scales"] = [scales[s.tensor] for s in pieces]
+            out.append(m)
+        return out
+
+    # -- operations ----------------------------------------------------------
+    def pull(self) -> List[np.ndarray]:
+        """'p' on every shard, then gather the replies into the full weight
+        list (freshly allocated — safe across later receives)."""
+        for j in range(self.num_shards):
+            self._send(j, b"p")
+        return self._gather_replies()
+
+    def send_commit(self, msg: Dict[str, Any]):
+        """Scatter one 'c' commit across the shards (fire-and-forget)."""
+        for j, m in enumerate(self._split_commit(msg)):
+            self._send(j, b"c", m)
+
+    def send_update(self, msg: Dict[str, Any]):
+        """Scatter one 'u' commit+pull across the shards; every shard's
+        combined reply stays in flight until ``recv_update`` — the overlap
+        window the pipelined workers ride, per shard."""
+        for j, m in enumerate(self._split_commit(msg)):
+            self._send(j, b"u", m)
+
+    def recv_update(self) -> List[np.ndarray]:
+        """Drain the 'u' replies from every shard and gather the center."""
+        return self._gather_replies()
+
+    def update(self, msg: Dict[str, Any]) -> List[np.ndarray]:
+        """Blocking combined commit+pull across all shards (serial-path
+        form of send_update + recv_update)."""
+        self.send_update(msg)
+        return self.recv_update()
+
+    def _gather_replies(self) -> List[np.ndarray]:
+        slices = []
+        for j in range(self.num_shards):
+            reply = self._recv(j)
+            self._clocks[j] = int(reply["clock"])
+            slices.append(reply["weights"])
+        # per-shard pools: shard j's views stay valid while shard j+1
+        # receives into its own pool, so one gather after the loop is safe
+        return self.plan.gather(slices)
+
+
+# ---------------------------------------------------------------------------
+# driver side
+# ---------------------------------------------------------------------------
+
+class ShardedServerGroup:
+    """N ``SocketParameterServer`` instances, each wrapping the unchanged
+    per-algorithm apply rule on its slice of the center.
+
+    Presents the slice-of-lifecycle surface ``run_host_ps_training`` needs:
+    start/stop, per-shard ports, a consistent (gathered center, per-shard
+    clocks) snapshot for checkpointing, and ``get_model()``.
+    """
+
+    def __init__(self, algorithm: str, model_blob: dict, num_workers: int,
+                 num_shards: int, host: str = "127.0.0.1"):
+        from .parameter_servers import (SocketParameterServer,
+                                        allocate_parameter_server)
+        weights = [np.asarray(w) for w in model_blob["weights"]]
+        self.model_blob = model_blob
+        self.plan = make_shard_plan([w.shape for w in weights],
+                                    [w.dtype for w in weights], num_shards)
+        self.servers = []
+        for shard_w in self.plan.scatter(weights):
+            ps = allocate_parameter_server(
+                algorithm,
+                {"model": model_blob["model"], "weights": shard_w},
+                num_workers)
+            self.servers.append(SocketParameterServer(ps, host=host))
+
+    @property
+    def num_shards(self) -> int:
+        return self.plan.num_shards
+
+    @property
+    def ports(self) -> List[int]:
+        return [s.port for s in self.servers]
+
+    @property
+    def addrs(self) -> List[Tuple[str, int]]:
+        return [(s.host, s.port) for s in self.servers]
+
+    def start(self):
+        try:
+            for s in self.servers:
+                s.start()
+        except Exception:
+            self.stop()
+            raise
+
+    def stop(self):
+        for s in self.servers:
+            s.stop()
+
+    def snapshot(self) -> Tuple[List[np.ndarray], List[int]]:
+        """(gathered full center, per-shard clocks).  Each shard snapshots
+        under its own apply lock; the composite is only epoch-wave
+        consistent, exactly like the single-PS checkpoint (commits within an
+        epoch stay hogwild by design)."""
+        slices, clocks = [], []
+        for s in self.servers:
+            with s.ps._lock:
+                slices.append([w.copy() for w in s.ps.center])
+                clocks.append(s.ps.num_updates)
+        return self.plan.gather(slices), clocks
+
+    def restore_state(self, center: Sequence[np.ndarray], clocks):
+        clocks = [int(c) for c in np.asarray(clocks).reshape(-1)]
+        if len(clocks) != self.num_shards:
+            raise ValueError(
+                f"checkpoint carries {len(clocks)} shard clocks; this run "
+                f"has ps_shards={self.num_shards} — resume with the same "
+                "configuration")
+        slices = self.plan.scatter(
+            [np.asarray(w, np.float32) for w in center])
+        for s, sw, c in zip(self.servers, slices, clocks):
+            with s.ps._lock:
+                s.ps.center = [np.array(w, dtype=np.float32, copy=True)
+                               for w in sw]
+                s.ps.num_updates = c
+
+    def get_model(self):
+        from .core.model import FittedModel, deserialize_model
+        center, _ = self.snapshot()
+        model, params = deserialize_model(
+            {"model": self.model_blob["model"], "weights": center})
+        return FittedModel(model, params)

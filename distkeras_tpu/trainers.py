@@ -594,13 +594,24 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     center; pass ``comm_overlap=True`` to trade one window of center
     staleness for the hidden round trip).  The SPMD engine has no wire to
     overlap, so an explicit setting there is rejected.
+
+    ``ps_shards`` (``execution='host_ps'`` only): partition the center
+    weight vector across N parameter-server shard processes
+    (``ps_sharding.py`` — greedy bin-packing by byte size, oversized
+    tensors split row-wise), so PS-side CPU and NIC bandwidth scale with
+    the shard count instead of capping async throughput at one server.
+    Each shard wraps the unchanged per-algorithm apply rule on its slice
+    with its own clock, so staleness semantics are per-shard identical to
+    the single-PS path, and ``ps_shards=1`` (default) is today's
+    single-server behavior bit for bit.  See docs/host_ps.md.
     """
 
     #: algorithms whose per-algorithm comm_overlap default is ON
     _OVERLAP_DEFAULT_ON = ("downpour", "adag", "dynsgd")
 
     def __init__(self, keras_model, *, parallelism_factor: int = 1,
-                 comm_overlap: Optional[bool] = None, **kw):
+                 comm_overlap: Optional[bool] = None, ps_shards: int = 1,
+                 **kw):
         super().__init__(keras_model, **kw)
         self.parallelism_factor = int(parallelism_factor)
         if self.parallelism_factor < 1:
@@ -616,6 +627,15 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                 "'host_ps'/'process_ps'); the SPMD program exchanges deltas "
                 "over ICI inside XLA — there is no wire to overlap")
         self._comm_overlap = comm_overlap
+        self.ps_shards = int(ps_shards)
+        if self.ps_shards < 1:
+            raise ValueError("ps_shards must be >= 1")
+        if self.ps_shards > 1 and self.execution != "host_ps":
+            raise ValueError(
+                "ps_shards > 1 requires execution='host_ps' (the SPMD "
+                "engine exchanges deltas over ICI — no PS to shard; the "
+                "process_ps engine ships config as JSON and keeps the "
+                "single-server topology)")
 
     @property
     def comm_overlap(self) -> bool:

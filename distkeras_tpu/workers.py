@@ -38,6 +38,7 @@ from .core import optimizers as opt_lib
 from .core.model import Sequential, deserialize_model
 from .core.train import batch_epoch_data, make_masked_step
 from . import networking
+from .ps_sharding import ShardedPSClient
 
 
 class Worker:
@@ -185,10 +186,19 @@ class PSWorker(Worker):
                  ps_port: int, communication_window: int = 5,
                  wire_dtype: Optional[str] = None,
                  comm_overlap: bool = False,
-                 fault_injection: Optional[dict] = None, **kw):
+                 fault_injection: Optional[dict] = None,
+                 shard_plan=None, shard_addrs=None, **kw):
         super().__init__(model_blob, worker_optimizer, loss, **kw)
         self.ps_host = ps_host
         self.ps_port = ps_port
+        # PS sharding (ps_sharding.py): when the driver partitioned the
+        # center over N shard servers, the worker talks to all of them
+        # through one ShardedPSClient (scatter commits / gather pulls) —
+        # built fresh per connect(); None keeps the single-socket path
+        # below untouched
+        self.shard_plan = shard_plan
+        self.shard_addrs = shard_addrs
+        self._shard_client: Optional[ShardedPSClient] = None
         self.window = int(communication_window)
         # comm_overlap: pipeline the transport — one combined 'u'
         # (commit+pull) round trip per window, received while the NEXT
@@ -221,10 +231,23 @@ class PSWorker(Worker):
     def connect(self, attempts: int = 10, backoff: float = 0.05):
         """Dial the PS with bounded retry-with-backoff: a worker that starts
         before the PS accept loop is up — or reconnects across a PS restart
-        — retries ``ConnectionRefusedError`` with exponential backoff (~9 s
-        worst case at the defaults) instead of dying on the first refusal.
-        Every fresh connection gets a fresh receive-buffer pool: center
-        pulls decode into reusable preallocated memory."""
+        — retries with exponential backoff (~9 s worst case at the defaults)
+        instead of dying on the first handshake fault.  Retried faults:
+        ``ConnectionRefusedError`` (nothing listening yet), plus
+        ``ConnectionResetError`` and ``socket.timeout`` — a PS mid-start()
+        can accept the TCP handshake and then reset or stall before its
+        handler thread exists.  Every fresh connection gets a fresh
+        receive-buffer pool: center pulls decode into reusable preallocated
+        memory.
+
+        With ``shard_addrs`` set the worker instead dials every PS shard
+        through a ``ShardedPSClient`` (same retry policy per shard; one
+        socket + one buffer pool per shard)."""
+        if self.shard_addrs is not None:
+            self._shard_client = ShardedPSClient(self.shard_plan,
+                                                 self.shard_addrs)
+            self._shard_client.connect(attempts=attempts, backoff=backoff)
+            return
         attempts = max(int(attempts), 1)
         last: Optional[Exception] = None
         for i in range(attempts):
@@ -232,7 +255,8 @@ class PSWorker(Worker):
                 self._sock = networking.connect(self.ps_host, self.ps_port)
                 self._pool = networking.BufferPool()
                 return
-            except ConnectionRefusedError as e:
+            except (ConnectionRefusedError, ConnectionResetError,
+                    socket.timeout) as e:
                 last = e
                 time.sleep(min(backoff * (2 ** i), 2.0))
         raise ConnectionError(
@@ -240,6 +264,9 @@ class PSWorker(Worker):
             "connection attempts") from last
 
     def disconnect(self):
+        if self._shard_client is not None:
+            self._shard_client.disconnect()
+            return
         if self._sock is not None:
             try:
                 networking.send_opcode(self._sock, b"q")
@@ -255,7 +282,15 @@ class PSWorker(Worker):
         weights are zero-copy VIEWS into reusable memory, valid until the
         next receive on this connection — callers move them to device (or
         consume them arithmetically) before their next transport call.
+
+        Sharded: one 'p' per shard (every request in flight before any reply
+        is read), replies gathered into the full weight list.
         """
+        if self._shard_client is not None:
+            weights = self._shard_client.pull()
+            self._last_clock = self._shard_client.max_clock
+            self.transport_ops += self._shard_client.num_shards
+            return weights
         networking.send_opcode(self._sock, b"p")
         msg = networking.recv_data(self._sock, pool=self._pool)
         self._last_clock = int(msg["clock"])
@@ -269,9 +304,11 @@ class PSWorker(Worker):
         self._commits += 1
         budget = self.fault_injection.get(worker_id)
         if budget is not None and self._commits > budget:
-            # hard-close the socket FIRST so the unwind path's disconnect()
-            # is a no-op (no graceful b'q'): the PS sees a plain EOF,
-            # exactly the signature of a worker host falling over
+            # hard-close the socket(s) FIRST so the unwind path's
+            # disconnect() is a no-op (no graceful b'q'): the PS sees a
+            # plain EOF, exactly the signature of a worker host falling over
+            if self._shard_client is not None:
+                self._shard_client.abort()
             try:
                 self._sock.close()
             except (OSError, AttributeError):
@@ -321,6 +358,10 @@ class PSWorker(Worker):
         had no counterpart for.
         """
         msg, applied = self._prepare_commit(delta, worker_id)
+        if self._shard_client is not None:
+            self._shard_client.send_commit(msg)
+            self.transport_ops += self._shard_client.num_shards
+            return applied
         networking.send_opcode(self._sock, b"c")
         networking.send_data(self._sock, msg)
         self.transport_ops += 1
@@ -332,8 +373,14 @@ class PSWorker(Worker):
         combined reply — the center *after this commit* + clock, snapshotted
         atomically — is collected by ``update_finish``; overlapped callers
         run device compute between the two halves so the round trip costs
-        no device idle time."""
+        no device idle time.  Sharded: one 'u' per shard, every shard's
+        reply left in flight — the per-shard pipelines advance in
+        lockstep with the window loop."""
         msg, applied = self._prepare_commit(delta, worker_id)
+        if self._shard_client is not None:
+            self._shard_client.send_update(msg)
+            self.transport_ops += self._shard_client.num_shards
+            return applied
         networking.send_opcode(self._sock, b"u")
         networking.send_data(self._sock, msg)
         self.transport_ops += 1
@@ -341,7 +388,12 @@ class PSWorker(Worker):
 
     def update_finish(self) -> List[np.ndarray]:
         """'u' part 2: receive the center+clock reply for the
-        ``update_begin`` in flight (pool-decoded views, as ``pull``)."""
+        ``update_begin`` in flight (pool-decoded views, as ``pull``;
+        sharded: drain every shard's reply and gather)."""
+        if self._shard_client is not None:
+            weights = self._shard_client.recv_update()
+            self._last_clock = self._shard_client.max_clock
+            return weights
         msg = networking.recv_data(self._sock, pool=self._pool)
         self._last_clock = int(msg["clock"])
         return msg["weights"]

@@ -3,10 +3,18 @@
 The two implementations must be byte-identical on the wire (either end of a
 host-PS connection may run either one).  Builds the extension in place if it
 isn't already built; skips gracefully where no toolchain exists.
+
+The ``codec`` fixture parametrizes the shared contract tests over BOTH
+implementations — forcing ``networking._native = None`` routes every encode,
+decode, and pooled-payload split through the pure-Python fallback
+(``_decode_payload_py`` included), so the fallback can't rot unexercised on
+machines where the native extension is always importable.
 """
 
+import socket
 import subprocess
 import sys
+import threading
 
 import numpy as np
 import pytest
@@ -32,6 +40,19 @@ def _ensure_native():
 def native():
     old = networking._native
     yield _ensure_native()
+    networking._native = old
+
+
+@pytest.fixture(params=["python", "native"])
+def codec(request):
+    """Force one codec implementation for the duration of a test: 'python'
+    nulls the native module (every path falls back to the pure-Python twin,
+    ``_decode_payload_py`` included); 'native' requires/builds the
+    extension."""
+    old = networking._native
+    networking._native = None if request.param == "python" \
+        else _ensure_native()
+    yield request.param
     networking._native = old
 
 
@@ -94,6 +115,59 @@ def test_roundtrip_large_delta(native):
         networking.encode_message({"delta": delta, "worker": 3}))
     for a, b in zip(out["delta"], delta):
         np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# contract tests, parametrized over BOTH codec implementations
+# ---------------------------------------------------------------------------
+
+def test_roundtrip_either_codec(codec):
+    out = networking.decode_message(networking.encode_message(MESSAGE))
+    np.testing.assert_array_equal(out["weights"][0], MESSAGE["weights"][0])
+    np.testing.assert_array_equal(out["weights"][1], MESSAGE["weights"][1])
+    assert out["clock"] == 7 and out["nested"]["t"] == (1, 2.5, None)
+
+
+def test_payload_decode_either_codec(codec):
+    """decode_payload (the pooled-receive frame splitter) splits and
+    truncation-checks identically on both implementations."""
+    payload = b"".join(len(x).to_bytes(8, "little") + x
+                       for x in (b"abc", b"", b"0123456789"))
+    assert [bytes(v) for v in networking.decode_payload(payload)] == \
+        [b"abc", b"", b"0123456789"]
+    with pytest.raises(ValueError, match="Truncated"):
+        networking.decode_payload(payload[:-3])
+
+
+def test_pooled_recv_either_codec(codec):
+    """The zero-copy pooled receive path (recv_data(pool=...) →
+    decode_payload) works — and reuses its buffer — on both codecs."""
+    pool = networking.BufferPool()
+    a, b = socket.socketpair()
+    msg = {"weights": [np.arange(24, dtype=np.float32).reshape(4, 6)],
+           "clock": 5}
+    try:
+        for _ in range(2):
+            t = threading.Thread(target=networking.send_data, args=(a, msg))
+            t.start()
+            out = networking.recv_data(b, pool=pool)
+            t.join()
+            np.testing.assert_array_equal(out["weights"][0],
+                                          msg["weights"][0])
+            assert out["clock"] == 5
+        assert pool.misses == 1 and pool.hits == 1
+        assert not out["weights"][0].flags["OWNDATA"]  # view into the pool
+    finally:
+        a.close()
+        b.close()
+
+
+def test_rejects_corrupt_frames_either_codec(codec):
+    blob = networking.encode_message(MESSAGE)
+    with pytest.raises(ValueError, match="magic"):
+        networking.decode_message(b"XXXX" + blob[4:])
+    with pytest.raises(ValueError):
+        networking.decode_message(blob[:len(blob) - 3])  # truncated
 
 
 def test_native_rejects_u64_overflow_lengths(native):
