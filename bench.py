@@ -210,6 +210,51 @@ def host_ps_shard_bench(budget_s: float = 120.0):
     return {"host_ps_shard_scaling": out}
 
 
+def host_ps_recovery_bench(budget_s: float = 60.0):
+    """Client-observed shard recovery latency: a 2-shard group under a
+    ``ShardSupervisor``; one shard is crash-killed and the measured number
+    is kill → the next successful client pull through reconnect-resume
+    (supervisor detection + respawn-from-snapshot + worker re-dial).
+    Returns ``{"host_ps_recovery_ms": float|None}`` — None on
+    overrun/failure, never fatal to the north-star artifact.
+    """
+    import numpy as np
+
+    from distkeras_tpu.ps_sharding import ShardedPSClient, ShardedServerGroup
+    from distkeras_tpu.resilience import RetryPolicy, ShardSupervisor
+
+    blob = {"model": "{}",
+            "weights": [np.zeros((4096,), np.float32),
+                        np.zeros((512,), np.float32)]}
+    group = ShardedServerGroup("downpour", blob, num_workers=1, num_shards=2)
+    group.start()
+    sup = ShardSupervisor(group, "downpour", 1, heartbeat_interval=0.05,
+                          liveness_deadline=0.25, snapshot_interval=0.05)
+    sup.start()
+    client = ShardedPSClient(
+        group.plan, group.addrs, recovery=True,
+        policy=RetryPolicy(attempts=None, backoff=0.01, max_backoff=0.1,
+                           deadline=min(budget_s, 20.0), seed=0))
+    t0 = time.perf_counter()
+    try:
+        client.connect()
+        client.update({"delta": [np.ones_like(w) for w in blob["weights"]],
+                       "worker_id": 0, "clock": 0})
+        time.sleep(0.2)  # let a post-commit snapshot land
+        t0 = time.perf_counter()
+        sup.kill_shard(0)
+        client.pull()  # blocks through detection + respawn + re-dial
+        ms = round((time.perf_counter() - t0) * 1e3, 1)
+    except Exception as e:
+        print(f"[bench] host_ps recovery bench failed: {e}", file=sys.stderr)
+        ms = None
+    finally:
+        client.abort()
+        sup.stop()
+        group.stop()
+    return {"host_ps_recovery_ms": ms}
+
+
 def main():
     t_start = time.perf_counter()
     debug = os.environ.get("DISTKERAS_BENCH_DEBUG", "") == "1"
@@ -404,6 +449,19 @@ def main():
             print(f"[bench] host_ps shard bench failed: {e}",
                   file=sys.stderr)
     result.update(shard_fields)
+    # PS recovery latency (resilience.py): kill one shard under the
+    # supervisor, measure client-observed time back to a successful pull
+    stage("host_ps recovery")
+    recovery_fields = {"host_ps_recovery_ms": None}
+    recovery_remaining = budget - (time.perf_counter() - t_start)
+    if recovery_remaining > 30:
+        try:
+            recovery_fields = host_ps_recovery_bench(
+                budget_s=recovery_remaining)
+        except Exception as e:
+            print(f"[bench] host_ps recovery bench failed: {e}",
+                  file=sys.stderr)
+    result.update(recovery_fields)
     if real_platform == "cpu":
         # CPU fallback: carry the hardware signal instead of erasing it
         result["probe_history"] = probe_history

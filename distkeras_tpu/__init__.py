@@ -28,7 +28,10 @@ from . import networking
 from . import workers
 from . import ps_sharding
 from . import parameter_servers
+from . import resilience
 from .ps_sharding import PSShardDown
+from .resilience import RetryPolicy, ShardSupervisor
+from .networking import ChaosFault, ChaosProxy
 from . import job_deployment
 from . import checkpoint
 from . import metrics

@@ -26,9 +26,12 @@ hosts exactly where the reference rode the Spark driver network.
 from __future__ import annotations
 
 import json
+import random
 import socket
 import struct
-from typing import Any, Dict, List, Optional
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -369,10 +372,35 @@ def recv_data(sock: socket.socket, pool: Optional[BufferPool] = None) -> Any:
     return _decode_node(header["tree"], buffers)
 
 
+def read_frame(sock: socket.socket) -> bytes:
+    """Read one complete wire frame and return its raw bytes, undecoded.
+
+    Used by ``ChaosProxy`` to relay whole messages so faults land on exact
+    message boundaries (deterministic injection points) instead of arbitrary
+    byte offsets.  Trusts the stream's own length prefixes — this is a relay
+    for traffic the endpoints already validate, not a decoder.
+    """
+    head = _recv_exact(sock, 8)
+    if head[:4] != MAGIC:
+        raise ValueError("Bad magic on wire message")
+    (hlen,) = _U32.unpack(head[4:])
+    if hlen > MAX_HEADER_BYTES:
+        raise ValueError(f"Header too large: {hlen}")
+    raw_header = _recv_exact(sock, hlen)
+    header = json.loads(raw_header.decode())
+    parts = [head, raw_header]
+    for _ in range(int(header["nbuf"])):
+        lenb = _recv_exact(sock, 8)
+        (blen,) = _U64.unpack(lenb)
+        parts.append(lenb)
+        parts.append(_recv_exact(sock, blen))
+    return b"".join(parts)
+
+
 def send_opcode(sock: socket.socket, op: bytes) -> None:
     """Send a 1-byte action opcode (reference protocol: ``'p'`` pull /
     ``'c'`` commit; we add ``'u'`` update = commit+pull in one round trip,
-    and ``'q'`` quit)."""
+    ``'h'`` heartbeat, and ``'q'`` quit)."""
     assert len(op) == 1
     sock.sendall(op)
 
@@ -384,3 +412,188 @@ def recv_opcode(sock: socket.socket) -> bytes:
     except (ConnectionError, OSError):
         return b""
     return op
+
+
+# ---------------------------------------------------------------------------
+# deterministic network fault injection
+# ---------------------------------------------------------------------------
+
+def _hard_close(sock: Optional[socket.socket]) -> None:
+    """Close with SO_LINGER=0 so the peer sees an RST (connection reset),
+    not a graceful FIN — the signature of a host falling over."""
+    if sock is None:
+        return
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class ChaosFault(NamedTuple):
+    """One scripted fault: on connection ``conn`` (accept order on the
+    proxy; -1 = every connection), at the ``op_index``-th opcode the worker
+    sends on that connection, perform ``action``:
+
+    - ``"reset"``  — drop the request on the floor and RST both sides;
+    - ``"tear"``   — forward the opcode plus roughly half of its payload
+      frame, then RST (a torn frame at the server, a reset at the worker);
+    - ``"delay"``  — sleep ``arg`` seconds before forwarding (stall);
+    - ``"dup_reply"`` — relay the request and its reply, then send the
+      reply a second time (a duplicated in-flight reply);
+    - ``"call"``   — invoke ``arg()`` before forwarding (the deterministic
+      trigger for out-of-band chaos, e.g. ``ShardSupervisor.kill_shard``).
+    """
+
+    conn: int
+    op_index: int
+    action: str
+    arg: Any = None
+
+
+class ChaosProxy:
+    """Deterministic TCP fault-injection proxy for the PS opcode protocol.
+
+    Sits between workers and one PS (or one PS shard) and relays the real
+    byte stream **message by message** (opcode + frame via ``read_frame``),
+    so chaos tests drive the actual socket stack — connects, torn frames,
+    resets, stalls — instead of monkeypatching transport functions.  Faults
+    are scripted per (connection, opcode index) with ``ChaosFault`` entries
+    (exact, reproducible injection points), optionally combined with a
+    seeded random mode: ``auto={"reset": p, "delay": (p, seconds),
+    "dup_reply": p}`` draws per-opcode from a ``random.Random`` stream
+    seeded by ``(seed, connection index)``, so a given connection's fault
+    sequence is a pure function of the seed and its opcode count.
+
+    ``injected`` records every fault as ``(conn, op_index, action)``.
+    Usable as a context manager; ``stop()`` hard-closes everything.
+    """
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 host: str = "127.0.0.1", seed: int = 0,
+                 faults: Sequence[ChaosFault] = (),
+                 auto: Optional[Dict[str, Any]] = None):
+        self.upstream = (upstream_host, int(upstream_port))
+        self.seed = int(seed)
+        self.faults = [ChaosFault(*f) for f in faults]
+        self.auto = dict(auto or {})
+        self.injected: List[tuple] = []
+        self.connections = 0
+        self._lock = threading.Lock()
+        self._running = True
+        self._pairs: List[tuple] = []  # live (client, upstream) socket pairs
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, 0))
+        self._server.listen(64)
+        self.host, self.port = self._server.getsockname()[:2]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="dkt-chaos-accept")
+        self._accept_thread.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    def __enter__(self) -> "ChaosProxy":
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    @property
+    def addr(self):
+        return (self.host, self.port)
+
+    def stop(self):
+        self._running = False
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._lock:
+            pairs = list(self._pairs)
+            self._pairs.clear()
+        for a, b in pairs:
+            _hard_close(a)
+            _hard_close(b)
+        self._accept_thread.join(timeout=5.0)
+
+    # -- relay ---------------------------------------------------------------
+    def _accept_loop(self):
+        while True:
+            try:
+                client, _ = self._server.accept()
+            except OSError:
+                return
+            if not self._running:
+                _hard_close(client)
+                return
+            with self._lock:
+                idx = self.connections
+                self.connections += 1
+            threading.Thread(target=self._serve, args=(idx, client),
+                             daemon=True, name=f"dkt-chaos-conn-{idx}").start()
+
+    def _fault_for(self, conn: int, op_index: int,
+                   rng: random.Random) -> Optional[ChaosFault]:
+        for f in self.faults:
+            if f.conn in (-1, conn) and f.op_index == op_index:
+                return f
+        for action, spec in self.auto.items():
+            p, arg = (spec if isinstance(spec, (tuple, list))
+                      else (spec, None))
+            if rng.random() < float(p):
+                return ChaosFault(conn, op_index, action, arg)
+        return None
+
+    def _serve(self, idx: int, client: socket.socket):
+        try:
+            upstream = socket.create_connection(self.upstream, timeout=10.0)
+        except OSError:
+            _hard_close(client)
+            return
+        client.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        upstream.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._lock:
+            self._pairs.append((client, upstream))
+        rng = random.Random((self.seed << 20) ^ idx)
+        op_index = 0
+        try:
+            while True:
+                op = client.recv(1)
+                if not op:
+                    return
+                frame = (read_frame(client) if op in (b"c", b"u") else None)
+                fault = self._fault_for(idx, op_index, rng)
+                op_index += 1
+                if fault is not None:
+                    self.injected.append((idx, op_index - 1, fault.action))
+                    if fault.action == "delay":
+                        time.sleep(float(fault.arg or 0.05))
+                    elif fault.action == "call":
+                        fault.arg()
+                    elif fault.action == "reset":
+                        return  # finally RSTs both sides
+                    elif fault.action == "tear":
+                        upstream.sendall(op)
+                        if frame is not None:
+                            upstream.sendall(frame[:max(9, len(frame) // 2)])
+                        return
+                upstream.sendall(op)
+                if frame is not None:
+                    upstream.sendall(frame)
+                if op in (b"p", b"u", b"h"):
+                    reply = read_frame(upstream)
+                    client.sendall(reply)
+                    if fault is not None and fault.action == "dup_reply":
+                        client.sendall(reply)
+        except (ConnectionError, OSError, ValueError):
+            return
+        finally:
+            with self._lock:
+                if (client, upstream) in self._pairs:
+                    self._pairs.remove((client, upstream))
+            _hard_close(client)
+            _hard_close(upstream)

@@ -21,6 +21,7 @@ tests/test_host_ps.py asserts the two implementations agree.
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 import threading
@@ -33,6 +34,8 @@ from . import networking
 from .core.model import FittedModel, deserialize_model, serialize_model
 from .ps_sharding import PSShardDown, ShardedServerGroup
 from .workers import WORKER_CLASSES, share_compiled_state
+
+logger = logging.getLogger("distkeras_tpu.parameter_servers")
 
 
 def _as_f32(delta):
@@ -92,6 +95,14 @@ class ParameterServer:
             return {"weights": [w.copy() for w in self.center],
                     "clock": self.num_updates}
 
+    def handle_heartbeat(self) -> Dict[str, Any]:
+        """``'h'``: cheap liveness probe — clock only, no weights.  Goes
+        through the apply lock *deliberately*: a shard wedged inside an
+        apply must fail the heartbeat deadline, not answer "alive" while
+        every commit stalls (resilience.ShardSupervisor)."""
+        with self._lock:
+            return {"clock": self.num_updates}
+
 
 class DeltaParameterServer(ParameterServer):
     """center += delta (reference: ``DeltaParameterServer`` — DOWNPOUR's and
@@ -147,14 +158,20 @@ class SocketParameterServer:
     """
 
     def __init__(self, ps: ParameterServer, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, generation: int = 0):
         self.ps = ps
         self.host = host
         self.port = port  # 0 → ephemeral; real port set by start()
+        # recovery epoch (resilience.ShardSupervisor): bumped on every
+        # respawn of this address.  Replies carry it; commits stamped with
+        # an older generation are rejected (they were computed against a
+        # center this restart rolled back) — the epoch/generation handshake.
+        self.generation = int(generation)
         self._server: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._conn_threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
+        self._conn_of: Dict[threading.Thread, socket.socket] = {}
         self._conn_lock = threading.Lock()  # guards _conns/_conn_threads/_running
         self._running = False
 
@@ -171,14 +188,18 @@ class SocketParameterServer:
             target=self._accept_loop, daemon=True, name="dkt-ps-accept")
         self._accept_thread.start()
 
-    def stop(self):
+    def stop(self, join_timeout: float = 5.0):
         """Idempotent shutdown that actually unblocks every thread.
 
         Closing an fd from another thread does not reliably interrupt a
         blocked ``accept()`` on Linux, so we wake the accept loop with a
         self-connection, join it, then ``shutdown(SHUT_RDWR)`` every accepted
         connection to kick handler threads out of ``recv`` before joining
-        them.
+        them.  A handler that outlives its ``join_timeout`` (wedged inside
+        an apply, not a recv) is no longer leaked silently: the leak is
+        logged and its connection socket force-closed again, so a thread
+        stuck in socket I/O unblocks and one stuck in compute at least
+        fails fast on its next send instead of writing to a live peer.
         """
         with self._conn_lock:
             was_running = self._running
@@ -210,7 +231,50 @@ class SocketParameterServer:
             except OSError:
                 pass
         for t in threads:
-            t.join(timeout=5.0)
+            t.join(timeout=join_timeout)
+            if t.is_alive():
+                logger.warning(
+                    "PS handler thread %s still alive after stop(join_"
+                    "timeout=%.1fs) — likely wedged in an apply; force-"
+                    "closing its connection and leaving it to die detached",
+                    t.name, join_timeout)
+                with self._conn_lock:
+                    conn = self._conn_of.get(t)
+                if conn is not None:
+                    try:
+                        conn.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+
+    def crash(self):
+        """Abrupt-death simulation (chaos/bench hook): close the listener
+        and every connection with no graceful shutdown, no joins, no final
+        state flush — the in-process analogue of a SIGKILLed shard.  The
+        in-memory center is deliberately abandoned; recovery must come from
+        the last journal snapshot (resilience.ShardSupervisor), which is
+        exactly the bounded-loss contract under test."""
+        with self._conn_lock:
+            self._running = False
+            conns = list(self._conns)
+        if self._server is not None:
+            # shutdown() interrupts a blocked accept() (close() alone does
+            # not on Linux — the accept syscall pins the open file
+            # description, which would keep the PORT bound and block a
+            # same-address respawn with EADDRINUSE)
+            try:
+                self._server.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        for c in conns:
+            networking._hard_close(c)
 
     def get_model(self) -> FittedModel:
         return self.ps.get_model()
@@ -235,18 +299,29 @@ class SocketParameterServer:
                     daemon=True, name="dkt-ps-conn")
                 self._conns.append(conn)
                 self._conn_threads.append(t)
+                self._conn_of[t] = conn
             t.start()
 
     def _handle_connection(self, conn: socket.socket):
         """Reference: ``handle_connection`` — loop on 1-byte actions until
-        EOF/quit ('p' pull, 'c' commit, 'u' commit+pull, 'q' quit)."""
+        EOF/quit ('p' pull, 'c' commit, 'u' commit+pull, 'h' heartbeat,
+        'q' quit).  Every reply carries this server's ``generation``."""
         try:
             while True:
                 op = networking.recv_opcode(conn)
                 if op in (b"", b"q"):
                     return
                 if op == b"p":
-                    networking.send_data(conn, self.ps.handle_pull())
+                    reply = self.ps.handle_pull()
+                    reply["gen"] = self.generation
+                    networking.send_data(conn, reply)
+                elif op == b"h":
+                    # liveness probe (resilience.ShardSupervisor): clock +
+                    # generation, no weights — and it takes the apply lock,
+                    # so a wedged apply fails the probe deadline
+                    reply = self.ps.handle_heartbeat()
+                    reply["gen"] = self.generation
+                    networking.send_data(conn, reply)
                 elif op in (b"c", b"u"):
                     try:
                         msg = networking.recv_data(conn)
@@ -260,15 +335,31 @@ class SocketParameterServer:
                         msg["delta"] = [
                             np.asarray(q, np.float32) * s
                             for q, s in zip(msg["delta"], msg.pop("scales"))]
+                    # generation handshake: a commit stamped with an older
+                    # generation was computed against a center a restart
+                    # rolled back — drop it (bounded loss, same class as
+                    # worker staleness) instead of applying it to the
+                    # restored center.  'u' still replies with the current
+                    # state + generation so the worker re-syncs in the same
+                    # round trip.
+                    gen = msg.get("gen") if isinstance(msg, dict) else None
+                    stale = gen is not None and int(gen) != self.generation
                     # apply-rule errors deliberately propagate (visible
                     # thread traceback) — only transport faults are silent
                     if op == b"c":
-                        self.ps.handle_commit(msg)
+                        if not stale:
+                            self.ps.handle_commit(msg)
                     else:
                         # 'u': apply + snapshot atomically, reply in the
                         # same round trip (one DCN RTT per window instead
                         # of a commit send followed by a pull round trip)
-                        networking.send_data(conn, self.ps.handle_update(msg))
+                        if stale:
+                            reply = self.ps.handle_pull()
+                            reply["stale"] = True
+                        else:
+                            reply = self.ps.handle_update(msg)
+                        reply["gen"] = self.generation
+                        networking.send_data(conn, reply)
                 else:
                     return  # protocol violation: drop the connection
         except (ConnectionError, OSError):
@@ -286,6 +377,7 @@ class SocketParameterServer:
                     self._conns.remove(conn)
                 if me in self._conn_threads:
                     self._conn_threads.remove(me)
+                self._conn_of.pop(me, None)
 
 
 PS_CLASSES = {
@@ -353,7 +445,13 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     # parallelism_factor x num_workers concurrent tasks against the PS
     n = trainer.num_workers * getattr(trainer, "parallelism_factor", 1)
     ps_shards = int(getattr(trainer, "ps_shards", 1) or 1)
-    sharded = ps_shards > 1
+    recovery = bool(getattr(trainer, "recovery", False))
+    # recovery routes through the ShardedServerGroup for ANY shard count
+    # (the N=1 plan is the identity partition, bit-identical per
+    # tests/test_ps_sharding.py) so there is exactly one supervised
+    # lifecycle: servers held in a mutable list the supervisor can respawn
+    # into.  recovery=False keeps the PR 2 paths untouched.
+    sharded = ps_shards > 1 or recovery
     if sharded:
         # PS sharding (ps_sharding.py): partition the center weight vector
         # over N shard servers — each wraps the UNCHANGED per-algorithm
@@ -366,6 +464,17 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
         ps = allocate_parameter_server(algorithm, blob, n)
         server = SocketParameterServer(ps)
         server.start()
+    supervisor = None
+    if recovery:
+        # PS resilience (resilience.py): periodic per-shard snapshots +
+        # heartbeat-driven respawn-from-snapshot on the same address.  The
+        # workers below reconnect-resume under a RetryPolicy; windows
+        # committed after a shard's last snapshot are dropped (bounded
+        # loss, same class as worker staleness).
+        from .resilience import ShardSupervisor
+        supervisor = ShardSupervisor(server, algorithm, n)
+        supervisor.start()
+    trainer._ps_supervisor = supervisor  # observability (tests/bench)
 
     # deal rows round-robin per worker (Spark round-robin repartition
     # analogue): every row lands on exactly one worker, nothing dropped;
@@ -384,8 +493,18 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
               ps_port=(server.ports[0] if sharded else server.port))
     if sharded:
         # workers scatter-commit / gather-pull through a ShardedPSClient
-        # (one socket + one receive-buffer pool per shard)
-        kw.update(shard_plan=server.plan, shard_addrs=server.addrs)
+        # (one socket + one receive-buffer pool per shard).  _shard_addr_hook
+        # lets chaos tests interpose a networking.ChaosProxy per shard — the
+        # workers then drive the real socket stack through the proxy while
+        # the supervisor heartbeats the shards directly.
+        addrs = server.addrs
+        hook = getattr(trainer, "_shard_addr_hook", None)
+        if hook is not None:
+            addrs = [(str(h), int(p)) for h, p in hook(list(addrs))]
+        kw.update(shard_plan=server.plan, shard_addrs=addrs)
+    if recovery:
+        kw.update(recovery=True,
+                  retry_policy=getattr(trainer, "recovery_policy", None))
 
     workers = [worker_cls(blob, **kw) for _ in range(n)]
     share_compiled_state(workers)  # compile the window program once, not N×
@@ -532,6 +651,10 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
                           meta={"engine": "host_ps", "unit": "epoch",
                                 "ps_shards": ps_shards})
     finally:
+        if supervisor is not None:
+            # stop the supervisor FIRST: the group teardown below must not
+            # read as N shard deaths and trigger a respawn storm
+            supervisor.stop()
         server.stop()
         if ckpt is not None:
             # durable async (orbax) saves + release the manager's

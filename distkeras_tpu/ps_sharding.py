@@ -51,6 +51,8 @@ from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 import numpy as np
 
 from . import networking
+from .resilience import (DEFAULT_CONNECT_POLICY, DEFAULT_RECOVERY_POLICY,
+                         RETRYABLE_CONNECT, RetryPolicy, dial)
 
 
 class PSShardDown(ConnectionError):
@@ -212,20 +214,46 @@ class ShardedPSClient:
 
     Any transport fault on shard j (send or receive) raises
     ``PSShardDown(j)`` instead of a bare ``ConnectionError`` from deep in
-    ``recv_data``.
+    ``recv_data`` — unless ``recovery=True``, in which case the client first
+    **reconnect-resumes**: it re-dials shard j under ``policy`` (attempts /
+    backoff / jitter / deadline — resilience.RetryPolicy), re-syncs with a
+    pull on the fresh connection, and only raises ``PSShardDown(j)`` once
+    the policy's recovery deadline is exhausted.  Shard generations (bumped
+    by a supervisor respawn) are tracked per shard from every reply; commits
+    are stamped with the last-seen generation so a restarted shard can
+    reject the in-flight windows a restart rolled back, and the per-shard
+    clocks stay **monotonic** across a restart (a restored — older — shard
+    clock never rolls the client's view backwards).
     """
 
-    def __init__(self, plan: ShardPlan, addrs: Sequence[Tuple[str, int]]):
+    def __init__(self, plan: ShardPlan, addrs: Sequence[Tuple[str, int]],
+                 recovery: bool = False,
+                 policy: Optional[RetryPolicy] = None):
         if len(addrs) != plan.num_shards:
             raise ValueError(
                 f"{len(addrs)} shard addresses for a {plan.num_shards}-shard "
                 "plan")
         self.plan = plan
         self.addrs = [(str(h), int(p)) for h, p in addrs]
+        self.recovery = bool(recovery)
+        self.policy = policy
         self._socks: List[Optional[socket.socket]] = [None] * plan.num_shards
         self._pools: List[Optional[networking.BufferPool]] = (
             [None] * plan.num_shards)
         self._clocks = [0] * plan.num_shards
+        #: last reply clock seen on the CURRENT connection to each shard
+        #: (None until the first reply; reset on reconnect).  This — not
+        #: the monotonic ``_clocks`` view — is the duplicate-reply
+        #: baseline: a restarted shard's clock legitimately restarts below
+        #: the monotonic view, but within one connection genuine replies
+        #: never run backwards.
+        self._conn_clocks: List[Optional[int]] = [None] * plan.num_shards
+        #: last-seen server generation per shard (None until first reply)
+        self._gens: List[Optional[int]] = [None] * plan.num_shards
+        #: observability counters (tests + bench)
+        self.resumes = 0          # successful mid-run reconnect-resumes
+        self.stale_replies = 0    # duplicated/stale 'u' replies discarded
+        self.clock_regressions = 0  # replies whose clock ran backwards
 
     @property
     def num_shards(self) -> int:
@@ -239,28 +267,82 @@ class ShardedPSClient:
     def pools(self) -> List[Optional[networking.BufferPool]]:
         return self._pools
 
+    def _connect_policy(self, attempts: Optional[int] = None,
+                        backoff: Optional[float] = None,
+                        policy: Optional[RetryPolicy] = None) -> RetryPolicy:
+        """Resolve the dial policy: explicit ``policy`` wins, then legacy
+        ``attempts``/``backoff`` overrides, then the instance policy, then
+        the shared default (which carries jitter — N workers x N shards
+        re-dialing a restarted shard must not arrive in lockstep)."""
+        if policy is None:
+            policy = self.policy or DEFAULT_CONNECT_POLICY
+        kw = {}
+        if attempts is not None:
+            kw["attempts"] = max(int(attempts), 1)
+        if backoff is not None:
+            kw["backoff"] = float(backoff)
+        return policy.replace(**kw) if kw else policy
+
     # -- lifecycle -----------------------------------------------------------
-    def connect(self, attempts: int = 10, backoff: float = 0.05):
-        """Dial every shard with the same bounded retry-with-backoff as
-        ``PSWorker.connect`` — a shard that is mid-``start()`` can refuse,
-        accept-then-reset, or time out, so all three retry."""
-        attempts = max(int(attempts), 1)
+    def connect(self, attempts: Optional[int] = None,
+                backoff: Optional[float] = None,
+                policy: Optional[RetryPolicy] = None):
+        """Dial every shard with the same bounded jittered
+        retry-with-backoff as ``PSWorker.connect`` — a shard that is
+        mid-``start()`` can refuse, accept-then-reset, or time out, so all
+        three retry (resilience.RETRYABLE_CONNECT)."""
+        policy = self._connect_policy(attempts, backoff, policy)
         for j, (host, port) in enumerate(self.addrs):
-            last: Optional[Exception] = None
-            for i in range(attempts):
-                try:
-                    self._socks[j] = networking.connect(host, port)
-                    self._pools[j] = networking.BufferPool()
-                    break
-                except (ConnectionRefusedError, ConnectionResetError,
-                        socket.timeout) as e:
-                    last = e
-                    time.sleep(min(backoff * (2 ** i), 2.0))
-            else:
+            try:
+                self._socks[j] = dial(host, port, policy)
+                self._pools[j] = networking.BufferPool()
+            except RETRYABLE_CONNECT as e:
                 self.abort()
                 raise PSShardDown(
                     j, (host, port),
-                    f"refused {attempts} connection attempts") from last
+                    f"refused {policy.describe()} connection attempts"
+                ) from e
+
+    def _redial_once(self, j: int):
+        """Drop shard ``j``'s socket and dial it exactly once (no retry —
+        ``_with_resume`` owns the retry loop, because a dial can succeed
+        against a dead listener's kernel backlog and only fail on first
+        use, so dial and first use must retry as one unit)."""
+        if self._socks[j] is not None:
+            try:
+                self._socks[j].close()
+            except OSError:
+                pass
+            self._socks[j] = None
+        self._socks[j] = networking.connect(*self.addrs[j])
+        self._pools[j] = networking.BufferPool()
+        self._conn_clocks[j] = None
+
+    def _with_resume(self, j: int, fn, fault: BaseException):
+        """Mid-run reconnect-resume for shard ``j``: repeatedly (re-dial +
+        ``fn()``) under the recovery policy — the deadline budgets the
+        supervisor's detect + respawn-from-snapshot time.  ``PSShardDown``
+        is raised only once the policy is exhausted."""
+        policy = self.policy or DEFAULT_RECOVERY_POLICY
+        t0 = time.monotonic()
+        last = fault
+        for d in policy.delays():
+            try:
+                self._redial_once(j)
+                out = fn()
+                self.resumes += 1
+                return out
+            except (ConnectionError, OSError, ValueError,
+                    socket.timeout) as e:
+                last = e
+                if (policy.deadline is not None
+                        and time.monotonic() - t0 + d > policy.deadline):
+                    break
+                time.sleep(d)
+        raise PSShardDown(
+            j, self.addrs[j],
+            f"unrecovered after {policy.describe()} reconnect attempts"
+        ) from last
 
     def disconnect(self):
         """Graceful 'q' + close on every shard (best effort)."""
@@ -292,13 +374,41 @@ class ShardedPSClient:
             if payload is not None:
                 networking.send_data(self._socks[j], payload)
         except (ConnectionError, OSError) as e:
-            raise PSShardDown(j, self.addrs[j]) from e
+            if not self.recovery:
+                raise PSShardDown(j, self.addrs[j]) from e
 
-    def _recv(self, j: int) -> Dict[str, Any]:
+            # reconnect-resume: re-dial and re-issue this request on the
+            # fresh connection.  If the shard restarted, the re-sent commit
+            # still carries the OLD generation — the server drops it and
+            # (for 'u') replies with its current state, which re-syncs us.
+            def resend():
+                networking.send_opcode(self._socks[j], op)
+                if payload is not None:
+                    networking.send_data(self._socks[j], payload)
+
+            self._with_resume(j, resend, e)
+
+    def _recv(self, j: int) -> Tuple[Dict[str, Any], bool]:
+        """One reply from shard ``j`` as ``(reply, resumed)``.  On a
+        transport fault with recovery on, the in-flight reply died with the
+        connection (its window may or may not have applied — bounded loss);
+        re-sync with a plain pull on the fresh connection and hand that
+        back as the reply (``resumed=True``)."""
         try:
-            return networking.recv_data(self._socks[j], pool=self._pools[j])
-        except (ConnectionError, OSError) as e:
-            raise PSShardDown(j, self.addrs[j]) from e
+            return (networking.recv_data(self._socks[j],
+                                         pool=self._pools[j]), False)
+        except (ConnectionError, OSError, ValueError) as e:
+            # ValueError = corrupt/torn reply frame (chaos): the stream is
+            # desynchronized either way — same recovery as a dead socket
+            if not self.recovery:
+                raise PSShardDown(j, self.addrs[j]) from e
+
+            def resync():
+                networking.send_opcode(self._socks[j], b"p")
+                return networking.recv_data(self._socks[j],
+                                            pool=self._pools[j])
+
+            return self._with_resume(j, resync, e), True
 
     def _split_commit(self, msg: Dict[str, Any]) -> List[Dict[str, Any]]:
         """Scatter a full commit message into per-shard messages: each shard
@@ -315,6 +425,11 @@ class ShardedPSClient:
                           for s in pieces],
                 "worker_id": msg.get("worker_id"),
                 "clock": self._clocks[j]}
+            if self._gens[j] is not None:
+                # generation handshake: a shard respawned since this clock
+                # was read rejects the commit instead of applying it to a
+                # rolled-back center
+                m["gen"] = self._gens[j]
             if scales is not None:
                 m["scales"] = [scales[s.tensor] for s in pieces]
             out.append(m)
@@ -342,7 +457,7 @@ class ShardedPSClient:
 
     def recv_update(self) -> List[np.ndarray]:
         """Drain the 'u' replies from every shard and gather the center."""
-        return self._gather_replies()
+        return self._gather_replies(dedupe=True)
 
     def update(self, msg: Dict[str, Any]) -> List[np.ndarray]:
         """Blocking combined commit+pull across all shards (serial-path
@@ -350,11 +465,40 @@ class ShardedPSClient:
         self.send_update(msg)
         return self.recv_update()
 
-    def _gather_replies(self) -> List[np.ndarray]:
+    def _sync_reply(self, j: int, reply: Dict[str, Any]):
+        """Fold a reply's (gen, clock) into the per-shard view: generations
+        follow the server (a respawn bumps them); clocks stay MONOTONIC —
+        a restored shard clock behind ours (post-snapshot windows dropped)
+        must not roll the staleness baseline backwards."""
+        g = reply.get("gen")
+        if g is not None:
+            self._gens[j] = int(g)
+        c = int(reply["clock"])
+        self._conn_clocks[j] = c
+        if c < self._clocks[j]:
+            self.clock_regressions += 1
+        self._clocks[j] = max(self._clocks[j], c)
+
+    def _gather_replies(self, dedupe: bool = False) -> List[np.ndarray]:
         slices = []
         for j in range(self.num_shards):
-            reply = self._recv(j)
-            self._clocks[j] = int(reply["clock"])
+            reply, resumed = self._recv(j)
+            if dedupe and self.recovery and not resumed:
+                # a chaos proxy can replay a 'u' reply.  WITHIN one
+                # connection a genuine combined reply always advances the
+                # clock (our own commit bumped it; a gen-rejected commit is
+                # marked "stale" and exempt), so a non-advancing unmarked
+                # reply is a duplicate to discard.  The per-connection
+                # baseline matters: a restarted shard's clock legitimately
+                # restarts below the MONOTONIC view.
+                while (not reply.get("stale")
+                       and self._conn_clocks[j] is not None
+                       and int(reply["clock"]) <= self._conn_clocks[j]):
+                    self.stale_replies += 1
+                    reply, resumed = self._recv(j)
+                    if resumed:
+                        break
+            self._sync_reply(j, reply)
             slices.append(reply["weights"])
         # per-shard pools: shard j's views stay valid while shard j+1
         # receives into its own pool, so one gather after the loop is safe

@@ -1,0 +1,417 @@
+"""PS resilience — survivable parameter servers for the host-PS path.
+
+The reference dist-keras delegated *all* fault handling to Spark task retry
+(SURVEY.md §5); our PS engines tolerate worker death (``fault_tolerance=True``)
+but through PR 2 a dead PS shard aborted the whole run — ``PSShardDown`` was
+fatal by design because a lost center partition admits no degraded completion.
+This module makes the server side recoverable instead (Li et al., *Scaling
+Distributed Machine Learning with the Parameter Server*, OSDI 2014: replicated
+/ journaled server state), so production-scale serving doesn't hinge on N
+shard processes never dying.  Three pieces:
+
+ - ``RetryPolicy`` — one bounded-retry contract (attempts, exponential
+   backoff, **jitter**, wall-clock deadline) shared by every connect and
+   reconnect path.  Jitter matters: N workers × N shards re-dialing a
+   restarted shard in lockstep is a thundering herd; each policy instance
+   draws its own jitter stream.
+ - ``ShardJournal`` — periodic per-shard state snapshots (center slice +
+   update clock), written atomically through the existing ``Checkpointer``
+   machinery (tempfile + ``os.replace``), with retention.
+ - ``ShardSupervisor`` — detects a dead or *wedged* shard (heartbeat ``'h'``
+   opcode driven through the apply lock, plus accept-loop liveness), respawns
+   it on the **same address** with the last snapshot restored and the
+   server ``generation`` bumped, so reconnecting workers can tell a restarted
+   shard from the one they lost.
+
+Bounded-loss contract (Chen et al., *Revisiting Distributed Synchronous
+SGD*): windows committed after the last snapshot are **dropped** on a shard
+restart — the same class of loss as the staleness the async algorithms
+already tolerate, so recovery needs no replicated log.  Per algorithm:
+
+ - DOWNPOUR/ADAG: a dropped window is indistinguishable from a worker that
+   never committed it; the center is simply a few updates behind.
+ - DynSGD: the restored (older) clock can only *lower* computed staleness,
+   so post-restart commits are applied at >= the scale they would have had.
+ - AEASGD/EAMSGD: the elastic coupling drifts by the dropped elastic terms,
+   bounded by alpha x (windows since the snapshot); the spring re-tightens.
+
+Worker-side reconnect-resume lives in ``workers.PSWorker`` /
+``ps_sharding.ShardedPSClient`` (re-dial under a ``RetryPolicy``, re-sync
+with a pull, generation handshake); the deterministic network
+fault-injection proxy lives in ``networking.ChaosProxy``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+import shutil
+import socket
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from . import networking
+
+logger = logging.getLogger("distkeras_tpu.resilience")
+
+#: handshake faults every dial path retries: nothing listening yet
+#: (refused), accepted-then-reset, or a stalled handshake.
+RETRYABLE_CONNECT = (ConnectionRefusedError, ConnectionResetError,
+                     socket.timeout)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """One retry contract for every connect/reconnect path.
+
+    ``attempts`` tries with exponential backoff (``backoff * 2**i`` capped at
+    ``max_backoff``), each delay stretched by a uniform random factor in
+    ``[1, 1+jitter]`` so a fleet of workers re-dialing a restarted shard
+    doesn't arrive in lockstep.  ``attempts=None`` retries until ``deadline``
+    (total wall-clock seconds) expires; at least one of the two bounds must
+    be set.  ``seed`` pins the jitter stream for deterministic tests; the
+    default ``None`` gives every instance its own stream — exactly what
+    de-synchronizes the herd.
+    """
+
+    attempts: Optional[int] = 10
+    backoff: float = 0.05
+    max_backoff: float = 2.0
+    jitter: float = 0.5
+    deadline: Optional[float] = None
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.attempts is None and self.deadline is None:
+            raise ValueError(
+                "RetryPolicy needs at least one bound: attempts or deadline")
+        if self.attempts is not None and int(self.attempts) < 1:
+            raise ValueError(f"attempts must be >= 1, got {self.attempts}")
+
+    def replace(self, **kw) -> "RetryPolicy":
+        return dataclasses.replace(self, **kw)
+
+    def delays(self) -> Iterator[float]:
+        """The jittered backoff sequence (one delay per retry)."""
+        rng = random.Random(self.seed)
+        i = 0
+        while self.attempts is None or i < int(self.attempts):
+            d = min(self.backoff * (2.0 ** i), self.max_backoff)
+            if self.jitter:
+                d *= 1.0 + self.jitter * rng.random()
+            yield d
+            i += 1
+
+    def call(self, fn: Callable[[], Any], retry_on: tuple) -> Any:
+        """Run ``fn`` under this policy; re-raises the last exception once
+        both bounds (attempts and deadline) are exhausted."""
+        t0 = time.monotonic()
+        last: Optional[BaseException] = None
+        for d in self.delays():
+            try:
+                return fn()
+            except retry_on as e:
+                last = e
+                if (self.deadline is not None
+                        and time.monotonic() - t0 + d > self.deadline):
+                    break
+                time.sleep(d)
+        raise last  # type: ignore[misc]
+
+    def describe(self) -> str:
+        if self.attempts is not None:
+            return str(int(self.attempts))
+        return f"{self.deadline:g}s of"
+
+
+#: connect() default — the PR 1/2 bounds (10 tries, ~9 s worst case) plus
+#: jitter (herd-avoidance is strictly better, sleeps only get longer by
+#: <= 50%, and no caller depends on exact sleep lengths).
+DEFAULT_CONNECT_POLICY = RetryPolicy(attempts=10, backoff=0.05)
+
+#: reconnect-resume default: retry for up to the recovery deadline — a
+#: supervisor needs detection (~1 heartbeat deadline) + restore + rebind
+#: before the address answers again.  ``PSShardDown`` is raised only after
+#: this deadline.
+DEFAULT_RECOVERY_POLICY = RetryPolicy(attempts=None, backoff=0.05,
+                                      max_backoff=0.5, deadline=15.0)
+
+
+def dial(host: str, port: int, policy: RetryPolicy) -> socket.socket:
+    """Dial under ``policy``; raises the last transport fault when the
+    policy is exhausted (callers wrap it in their own error type)."""
+    return policy.call(lambda: networking.connect(host, port),
+                       RETRYABLE_CONNECT)
+
+
+# ---------------------------------------------------------------------------
+# per-shard snapshot journal
+# ---------------------------------------------------------------------------
+
+class ShardJournal:
+    """Atomic per-shard snapshots of (center slice, update clock).
+
+    One ``Checkpointer`` directory per shard (``shard_<j>/ckpt_<n>.npz`` —
+    tempfile + ``os.replace``, so a crash mid-write never corrupts the last
+    good snapshot), with retention.  The snapshot *is* the recovery contract:
+    a respawned shard resumes from exactly this state and every window
+    committed after it is dropped.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 2):
+        self.directory = directory
+        self.max_to_keep = int(max_to_keep)
+        os.makedirs(directory, exist_ok=True)
+        self._ckpts: Dict[int, Any] = {}
+
+    def _ckpt(self, shard_id: int):
+        ck = self._ckpts.get(shard_id)
+        if ck is None:
+            from .checkpoint import Checkpointer
+            ck = Checkpointer(
+                os.path.join(self.directory, f"shard_{int(shard_id):03d}"),
+                max_to_keep=self.max_to_keep)
+            self._ckpts[shard_id] = ck
+        return ck
+
+    def save(self, shard_id: int, snap_id: int,
+             center: List[np.ndarray], clock: int, generation: int) -> str:
+        center = [np.asarray(w, np.float32) for w in center]
+        state = {"center": center, "clock": np.int64(clock)}
+        meta = {"shard": int(shard_id), "generation": int(generation),
+                "clock": int(clock),
+                "shapes": [list(w.shape) for w in center]}
+        return self._ckpt(shard_id).save(int(snap_id), state, meta=meta)
+
+    def latest(self, shard_id: int) -> Optional[Dict[str, Any]]:
+        """The newest snapshot for ``shard_id`` as
+        ``{"center", "clock", "generation", "snap_id"}``, or None."""
+        ck = self._ckpt(shard_id)
+        step = ck.latest_step()
+        if step is None:
+            return None
+        meta = ck.read_meta(step)
+        target = {"center": [np.zeros(tuple(s), np.float32)
+                             for s in meta["shapes"]],
+                  "clock": np.int64(0)}
+        restored = ck.restore(target, step)
+        return {"center": [np.asarray(w, np.float32)
+                           for w in restored["center"]],
+                "clock": int(restored["clock"]),
+                "generation": int(meta.get("generation", 0)),
+                "snap_id": step}
+
+
+# ---------------------------------------------------------------------------
+# the shard supervisor
+# ---------------------------------------------------------------------------
+
+class ShardSupervisor:
+    """Detect-and-respawn loop over a ``ShardedServerGroup``.
+
+    Liveness has two layers: the accept thread must be running (a crashed
+    shard fails this instantly), and a ``'h'`` heartbeat must answer within
+    ``liveness_deadline`` — the heartbeat handler takes the shard's **apply
+    lock**, so a shard wedged inside an apply (deadlocked rule, stuck numpy
+    op) fails the probe even though its process is "alive".
+
+    On detection the shard is respawned **on the same address** with the
+    last journal snapshot restored and ``generation`` bumped; reconnecting
+    workers learn the new generation from their first reply, and the shard
+    rejects any in-flight commit still stamped with the old generation
+    (``parameter_servers.SocketParameterServer`` — the epoch/generation
+    handshake).  ``recoveries`` records one entry per respawn for
+    observability (tests + ``bench.py``'s ``host_ps_recovery_ms``).
+    """
+
+    def __init__(self, group, algorithm: str, num_workers: int,
+                 snapshot_dir: Optional[str] = None,
+                 heartbeat_interval: float = 0.2,
+                 liveness_deadline: float = 1.0,
+                 snapshot_interval: float = 0.25,
+                 max_restarts: int = 20):
+        self.group = group
+        self.algorithm = algorithm
+        self.num_workers = int(num_workers)
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.liveness_deadline = float(liveness_deadline)
+        self.snapshot_interval = float(snapshot_interval)
+        self.max_restarts = int(max_restarts)
+        self._own_dir = snapshot_dir is None
+        if snapshot_dir is None:
+            snapshot_dir = tempfile.mkdtemp(prefix="dkt_ps_journal_")
+        self.journal = ShardJournal(snapshot_dir)
+        n = group.num_shards
+        self._snap_ids = [0] * n
+        self.restarts = [0] * n
+        #: one dict per respawn: shard, generation, restored_clock,
+        #: dropped_updates (in-memory clock minus restored clock — the
+        #: bounded loss this restart cost), respawn_ms
+        self.recoveries: List[Dict[str, Any]] = []
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()  # serializes respawn vs. stop
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        """Snapshot every shard once (a kill before the first periodic tick
+        must restore *initial* state, not nothing), then start the loop."""
+        for j in range(self.group.num_shards):
+            self.snapshot_shard(j)
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dkt-ps-supervisor")
+        self._thread.start()
+
+    def stop(self):
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if self._own_dir:
+            shutil.rmtree(self.journal.directory, ignore_errors=True)
+
+    # -- snapshots -----------------------------------------------------------
+    def snapshot_shard(self, j: int,
+                       lock_timeout: Optional[float] = None) -> bool:
+        """Journal shard ``j``'s (center slice, clock) under its apply lock.
+
+        The lock is taken with a TIMEOUT (default: the liveness deadline):
+        a *wedged* shard holds its apply lock forever, and a supervisor
+        that blocked here could never reach the detection that cures the
+        wedge.  A timed-out snapshot returns False and leaves the previous
+        snapshot as the recovery point — consistent with the bounded-loss
+        contract either way."""
+        s = self.group.servers[j]
+        timeout = (self.liveness_deadline if lock_timeout is None
+                   else float(lock_timeout))
+        if not s.ps._lock.acquire(timeout=timeout):
+            return False  # wedged: heartbeat detection owns this case
+        try:
+            center = [w.copy() for w in s.ps.center]
+            clock = s.ps.num_updates
+        finally:
+            s.ps._lock.release()
+        self._snap_ids[j] += 1
+        self.journal.save(j, self._snap_ids[j], center, clock, s.generation)
+        return True
+
+    # -- liveness ------------------------------------------------------------
+    def heartbeat(self, j: int, timeout: Optional[float] = None) -> bool:
+        """One ``'h'`` probe against shard ``j``: True iff it answers with a
+        clock within ``timeout``.  Any transport fault, stall, or garbage
+        reply is a failed probe."""
+        timeout = self.liveness_deadline if timeout is None else timeout
+        s = self.group.servers[j]
+        try:
+            sock = networking.connect(s.host, s.port, timeout=timeout)
+        except (ConnectionError, OSError, socket.timeout):
+            return False
+        try:
+            sock.settimeout(timeout)
+            networking.send_opcode(sock, b"h")
+            msg = networking.recv_data(sock)
+            networking.send_opcode(sock, b"q")
+            return isinstance(msg, dict) and "clock" in msg
+        except (ConnectionError, OSError, ValueError, socket.timeout):
+            return False
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def kill_shard(self, j: int):
+        """Chaos/bench hook: crash-stop shard ``j`` (no graceful shutdown,
+        in-memory state abandoned) — the signature of a SIGKILLed shard
+        process.  The supervisor loop detects and respawns it."""
+        self.group.servers[j].crash()
+
+    # -- respawn -------------------------------------------------------------
+    def respawn_shard(self, j: int) -> Dict[str, Any]:
+        """Stop whatever is left of shard ``j``, restore its last snapshot,
+        and re-listen on the same address with ``generation + 1``."""
+        from .parameter_servers import (SocketParameterServer,
+                                        allocate_parameter_server)
+        with self._lock:
+            t0 = time.monotonic()
+            old = self.group.servers[j]
+            # in-memory clock at death (best effort) — the observable for
+            # the bounded-loss contract: dropped = died_at - restored
+            died_at = int(old.ps.num_updates)
+            old.stop(join_timeout=0.5)  # leaked wedged threads are logged
+            snap = self.journal.latest(j)
+            if snap is None:  # start() always journals one; belt-and-braces
+                raise RuntimeError(f"no snapshot for shard {j}")
+            ps = allocate_parameter_server(
+                self.algorithm,
+                {"model": self.group.model_blob["model"],
+                 "weights": snap["center"]},
+                self.num_workers)
+            ps.num_updates = int(snap["clock"])
+            new = SocketParameterServer(ps, host=old.host, port=old.port,
+                                        generation=old.generation + 1)
+            last: Optional[BaseException] = None
+            for d in (0.05, 0.1, 0.2, 0.4, 0.8):
+                try:
+                    new.start()
+                    last = None
+                    break
+                except OSError as e:  # port not released yet
+                    last = e
+                    time.sleep(d)
+            if last is not None:
+                new.start()  # final attempt: a persistent bind error is loud
+            self.group.servers[j] = new
+            rec = {"shard": j, "generation": new.generation,
+                   "restored_clock": int(snap["clock"]),
+                   "dropped_updates": max(died_at - int(snap["clock"]), 0),
+                   "respawn_ms": round((time.monotonic() - t0) * 1e3, 1)}
+            self.recoveries.append(rec)
+            logger.warning(
+                "PS shard %d respawned at %s:%d (generation %d, restored "
+                "clock %d, %d post-snapshot updates dropped)", j, new.host,
+                new.port, new.generation, rec["restored_clock"],
+                rec["dropped_updates"])
+            return rec
+
+    # -- the loop ------------------------------------------------------------
+    def _loop(self):
+        last_snap = time.monotonic()
+        while self._running:
+            time.sleep(self.heartbeat_interval)
+            if not self._running:
+                return
+            for j in range(self.group.num_shards):
+                if not self._running:
+                    return
+                s = self.group.servers[j]
+                dead = not (s._running and s._accept_thread is not None
+                            and s._accept_thread.is_alive())
+                if not dead:
+                    dead = not self.heartbeat(j)
+                if dead and self._running:
+                    if self.restarts[j] >= self.max_restarts:
+                        continue  # crash loop: leave it to PSShardDown
+                    self.restarts[j] += 1
+                    try:
+                        self.respawn_shard(j)
+                    except Exception:
+                        logger.exception("respawn of PS shard %d failed", j)
+            if (self._running
+                    and time.monotonic() - last_snap >= self.snapshot_interval):
+                last_snap = time.monotonic()
+                for j in range(self.group.num_shards):
+                    s = self.group.servers[j]
+                    if not s._running:
+                        continue  # dead shard: its journal must stay put
+                    try:
+                        self.snapshot_shard(j)
+                    except Exception:
+                        logger.exception("snapshot of PS shard %d failed", j)

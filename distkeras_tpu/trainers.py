@@ -604,6 +604,22 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     with its own clock, so staleness semantics are per-shard identical to
     the single-PS path, and ``ps_shards=1`` (default) is today's
     single-server behavior bit for bit.  See docs/host_ps.md.
+
+    ``recovery`` (``execution='host_ps'`` only): make the parameter servers
+    themselves survivable (``resilience.py``).  A ``ShardSupervisor``
+    journals periodic per-shard snapshots (center slice + clock, atomic
+    writes) and heartbeats every shard (``'h'`` opcode through the apply
+    lock, so a *wedged* apply fails the probe too); a dead shard is
+    respawned on the same address from its last snapshot with its
+    generation bumped.  Workers reconnect-resume mid-run under
+    ``recovery_policy`` (a ``resilience.RetryPolicy``: attempts, backoff,
+    jitter, deadline — default ``DEFAULT_RECOVERY_POLICY``), re-syncing
+    with a pull; a restarted shard rejects in-flight commits stamped with
+    the old generation.  Bounded-loss contract: windows committed after the
+    shard's last snapshot are dropped — the same class of loss as the
+    staleness the async algorithms already tolerate.  ``PSShardDown`` is
+    raised only after the recovery deadline.  ``recovery=False`` (default)
+    keeps the fail-fast PR 2 behavior bit for bit.
     """
 
     #: algorithms whose per-algorithm comm_overlap default is ON
@@ -611,6 +627,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
 
     def __init__(self, keras_model, *, parallelism_factor: int = 1,
                  comm_overlap: Optional[bool] = None, ps_shards: int = 1,
+                 recovery: bool = False, recovery_policy=None,
                  **kw):
         super().__init__(keras_model, **kw)
         self.parallelism_factor = int(parallelism_factor)
@@ -636,6 +653,14 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                 "engine exchanges deltas over ICI — no PS to shard; the "
                 "process_ps engine ships config as JSON and keeps the "
                 "single-server topology)")
+        self.recovery = bool(recovery)
+        self.recovery_policy = recovery_policy
+        if self.recovery and self.execution != "host_ps":
+            raise ValueError(
+                "recovery=True requires execution='host_ps' (the SPMD "
+                "engine's recovery story is checkpoint_dir + train(resume="
+                "True); process_ps worker processes are respawned by the "
+                "job layer)")
 
     @property
     def comm_overlap(self) -> bool:

@@ -39,6 +39,8 @@ from .core.model import Sequential, deserialize_model
 from .core.train import batch_epoch_data, make_masked_step
 from . import networking
 from .ps_sharding import ShardedPSClient
+from .resilience import (DEFAULT_CONNECT_POLICY, DEFAULT_RECOVERY_POLICY,
+                         RETRYABLE_CONNECT, RetryPolicy, dial)
 
 
 class Worker:
@@ -187,7 +189,9 @@ class PSWorker(Worker):
                  wire_dtype: Optional[str] = None,
                  comm_overlap: bool = False,
                  fault_injection: Optional[dict] = None,
-                 shard_plan=None, shard_addrs=None, **kw):
+                 shard_plan=None, shard_addrs=None,
+                 recovery: bool = False,
+                 retry_policy: Optional[RetryPolicy] = None, **kw):
         super().__init__(model_blob, worker_optimizer, loss, **kw)
         self.ps_host = ps_host
         self.ps_port = ps_port
@@ -226,13 +230,46 @@ class PSWorker(Worker):
         self._sock: Optional[socket.socket] = None
         self._pool: Optional[networking.BufferPool] = None
         self._last_clock = 0
+        # reconnect-resume (resilience.py): with recovery on, a mid-run
+        # transport fault re-dials the PS under retry_policy and re-syncs
+        # instead of killing the worker — PSShardDown/ConnectionError only
+        # after the recovery deadline.  The generation learned from every
+        # reply stamps commits, so a restarted PS can reject the in-flight
+        # windows its restart rolled back.
+        self.recovery = bool(recovery)
+        self.retry_policy = retry_policy
+        self._gen: Optional[int] = None
+        # duplicate-reply baseline: last reply clock on the CURRENT
+        # connection (reset on every dial) — a restarted PS's clock
+        # legitimately restarts below the monotonic _last_clock view, but
+        # within one connection genuine replies never run backwards
+        self._conn_clock: Optional[int] = None
+        self.resumes = 0
+        self.stale_replies = 0
+        self.clock_regressions = 0
 
     # -- wire ---------------------------------------------------------------
-    def connect(self, attempts: int = 10, backoff: float = 0.05):
-        """Dial the PS with bounded retry-with-backoff: a worker that starts
-        before the PS accept loop is up — or reconnects across a PS restart
-        — retries with exponential backoff (~9 s worst case at the defaults)
-        instead of dying on the first handshake fault.  Retried faults:
+    def _connect_policy(self, attempts: Optional[int] = None,
+                        backoff: Optional[float] = None,
+                        policy: Optional[RetryPolicy] = None) -> RetryPolicy:
+        if policy is None:
+            policy = self.retry_policy or DEFAULT_CONNECT_POLICY
+        kw = {}
+        if attempts is not None:
+            kw["attempts"] = max(int(attempts), 1)
+        if backoff is not None:
+            kw["backoff"] = float(backoff)
+        return policy.replace(**kw) if kw else policy
+
+    def connect(self, attempts: Optional[int] = None,
+                backoff: Optional[float] = None,
+                policy: Optional[RetryPolicy] = None):
+        """Dial the PS with bounded *jittered* retry-with-backoff
+        (resilience.RetryPolicy): a worker that starts before the PS accept
+        loop is up — or reconnects across a PS restart — retries with
+        exponential backoff (~9 s worst case at the defaults) instead of
+        dying on the first handshake fault, and the jitter keeps N workers
+        from re-dialing a restarted PS in lockstep.  Retried faults:
         ``ConnectionRefusedError`` (nothing listening yet), plus
         ``ConnectionResetError`` and ``socket.timeout`` — a PS mid-start()
         can accept the TCP handshake and then reset or stall before its
@@ -244,24 +281,68 @@ class PSWorker(Worker):
         through a ``ShardedPSClient`` (same retry policy per shard; one
         socket + one buffer pool per shard)."""
         if self.shard_addrs is not None:
-            self._shard_client = ShardedPSClient(self.shard_plan,
-                                                 self.shard_addrs)
-            self._shard_client.connect(attempts=attempts, backoff=backoff)
+            self._shard_client = ShardedPSClient(
+                self.shard_plan, self.shard_addrs,
+                recovery=self.recovery, policy=self.retry_policy)
+            self._shard_client.connect(attempts=attempts, backoff=backoff,
+                                       policy=policy)
             return
-        attempts = max(int(attempts), 1)
-        last: Optional[Exception] = None
-        for i in range(attempts):
+        pol = self._connect_policy(attempts, backoff, policy)
+        try:
+            self._sock = dial(self.ps_host, self.ps_port, pol)
+        except RETRYABLE_CONNECT as e:
+            raise ConnectionError(
+                f"PS at {self.ps_host}:{self.ps_port} refused "
+                f"{pol.describe()} connection attempts") from e
+        self._pool = networking.BufferPool()
+        self._conn_clock = None
+
+    def _with_resume(self, fn, fault: BaseException):
+        """Mid-run reconnect-resume (single-socket path): repeatedly
+        (re-dial + ``fn()``) under the recovery policy.  Dial and first use
+        retry as ONE unit — a dial can succeed against a dead listener's
+        kernel backlog and only fail on first use.  ``ConnectionError``
+        escapes only once the policy (deadline/attempts) is exhausted."""
+        pol = self.retry_policy or DEFAULT_RECOVERY_POLICY
+        t0 = time.monotonic()
+        last = fault
+        for d in pol.delays():
             try:
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
                 self._sock = networking.connect(self.ps_host, self.ps_port)
                 self._pool = networking.BufferPool()
-                return
-            except (ConnectionRefusedError, ConnectionResetError,
+                self._conn_clock = None
+                out = fn()
+                self.resumes += 1
+                return out
+            except (ConnectionError, OSError, ValueError,
                     socket.timeout) as e:
                 last = e
-                time.sleep(min(backoff * (2 ** i), 2.0))
+                if (pol.deadline is not None
+                        and time.monotonic() - t0 + d > pol.deadline):
+                    break
+                time.sleep(d)
         raise ConnectionError(
-            f"PS at {self.ps_host}:{self.ps_port} refused {attempts} "
-            "connection attempts") from last
+            f"PS at {self.ps_host}:{self.ps_port} unrecovered after "
+            f"{pol.describe()} reconnect attempts") from last
+
+    def _sync_reply(self, msg):
+        """Fold a reply's (gen, clock) into this worker's view: generation
+        follows the server; the clock stays monotonic (a restored — older —
+        PS clock must not roll the staleness baseline backwards)."""
+        g = msg.get("gen")
+        if g is not None:
+            self._gen = int(g)
+        c = int(msg["clock"])
+        self._conn_clock = c
+        if c < self._last_clock:
+            self.clock_regressions += 1
+        self._last_clock = max(self._last_clock, c)
 
     def disconnect(self):
         if self._shard_client is not None:
@@ -291,9 +372,17 @@ class PSWorker(Worker):
             self._last_clock = self._shard_client.max_clock
             self.transport_ops += self._shard_client.num_shards
             return weights
-        networking.send_opcode(self._sock, b"p")
-        msg = networking.recv_data(self._sock, pool=self._pool)
-        self._last_clock = int(msg["clock"])
+        def do_pull():
+            networking.send_opcode(self._sock, b"p")
+            return networking.recv_data(self._sock, pool=self._pool)
+
+        try:
+            msg = do_pull()
+        except (ConnectionError, OSError, ValueError) as e:
+            if not self.recovery:
+                raise
+            msg = self._with_resume(do_pull, e)
+        self._sync_reply(msg)
         self.transport_ops += 1
         return msg["weights"]
 
@@ -329,14 +418,21 @@ class PSWorker(Worker):
             applied = [c.astype(np.float32) * s
                        for c, s in zip(codes, scales)]
             self._residual = [e - a for e, a in zip(eff, applied)]
-            return ({"delta": codes, "scales": scales,
-                     "worker_id": worker_id, "clock": self._last_clock},
-                    applied)
+            msg = {"delta": codes, "scales": scales,
+                   "worker_id": worker_id, "clock": self._last_clock}
+            if self._gen is not None:
+                msg["gen"] = self._gen
+            return (msg, applied)
         if self.wire_dtype is not None:
             delta = [d.astype(self.wire_dtype) for d in delta]
-        return ({"delta": delta, "worker_id": worker_id,
-                 "clock": self._last_clock},
-                [np.asarray(d, dtype=np.float32) for d in delta])
+        msg = {"delta": delta, "worker_id": worker_id,
+               "clock": self._last_clock}
+        if self._gen is not None:
+            # generation handshake: a PS respawned since our last reply
+            # rejects this commit instead of applying it to the restored
+            # center (the rolled-back windows are the bounded loss)
+            msg["gen"] = self._gen
+        return (msg, [np.asarray(d, dtype=np.float32) for d in delta])
 
     def commit(self, delta: List[np.ndarray], worker_id: int):
         """'c': push a weight-shaped delta (reference: Worker.commit).
@@ -362,10 +458,26 @@ class PSWorker(Worker):
             self._shard_client.send_commit(msg)
             self.transport_ops += self._shard_client.num_shards
             return applied
-        networking.send_opcode(self._sock, b"c")
-        networking.send_data(self._sock, msg)
+        self._send_request(b"c", msg)
         self.transport_ops += 1
         return applied
+
+    def _send_request(self, op: bytes, msg) -> None:
+        """Opcode + frame on the single socket, with reconnect-resume: a
+        send-side fault re-dials and re-issues the same message (still
+        stamped with the old generation — a restarted PS drops it and the
+        next reply re-syncs us; bounded loss either way)."""
+
+        def send():
+            networking.send_opcode(self._sock, op)
+            networking.send_data(self._sock, msg)
+
+        try:
+            send()
+        except (ConnectionError, OSError) as e:
+            if not self.recovery:
+                raise
+            self._with_resume(send, e)
 
     def update_begin(self, delta: List[np.ndarray], worker_id: int):
         """'u' part 1: ship the delta (same fault-injection + compression
@@ -381,21 +493,52 @@ class PSWorker(Worker):
             self._shard_client.send_update(msg)
             self.transport_ops += self._shard_client.num_shards
             return applied
-        networking.send_opcode(self._sock, b"u")
-        networking.send_data(self._sock, msg)
+        self._send_request(b"u", msg)
         self.transport_ops += 1
         return applied
 
     def update_finish(self) -> List[np.ndarray]:
         """'u' part 2: receive the center+clock reply for the
         ``update_begin`` in flight (pool-decoded views, as ``pull``;
-        sharded: drain every shard's reply and gather)."""
+        sharded: drain every shard's reply and gather).
+
+        Reconnect-resume: if the reply dies with the connection, its window
+        may or may not have applied (bounded loss) — re-dial and re-sync
+        with a plain pull, whose reply stands in for the lost one.  With
+        recovery on, duplicated 'u' replies (chaos proxies replay them) are
+        discarded: a genuine combined reply always advances the clock,
+        because our own commit bumped it."""
         if self._shard_client is not None:
             weights = self._shard_client.recv_update()
-            self._last_clock = self._shard_client.max_clock
+            self._last_clock = max(self._last_clock,
+                                   self._shard_client.max_clock)
             return weights
-        msg = networking.recv_data(self._sock, pool=self._pool)
-        self._last_clock = int(msg["clock"])
+        resumed = False
+        try:
+            msg = networking.recv_data(self._sock, pool=self._pool)
+        except (ConnectionError, OSError, ValueError) as e:
+            if not self.recovery:
+                raise
+
+            # the in-flight 'u' reply died with the connection — re-sync
+            # with a plain pull on the fresh connection
+            def resync():
+                networking.send_opcode(self._sock, b"p")
+                return networking.recv_data(self._sock, pool=self._pool)
+
+            msg = self._with_resume(resync, e)
+            self.transport_ops += 1
+            resumed = True
+        if self.recovery and not resumed:
+            # duplicate-reply discard against the PER-CONNECTION clock
+            # baseline ("stale"-marked gen rejections are exempt — they
+            # legitimately leave the clock unchanged)
+            while (not msg.get("stale")
+                   and self._conn_clock is not None
+                   and int(msg["clock"]) <= self._conn_clock):
+                self.stale_replies += 1
+                msg = networking.recv_data(self._sock, pool=self._pool)
+        self._sync_reply(msg)
         return msg["weights"]
 
     def update(self, delta: List[np.ndarray], worker_id: int):

@@ -152,3 +152,50 @@ def test_stop_unblocks_idle_connected_handlers():
         server.stop()
         for c in conns:
             c.close()
+
+
+def test_stop_logs_and_force_closes_leaked_handler(caplog):
+    """A handler wedged inside an apply outlives stop()'s join budget.
+    That leak used to be silent; now stop() logs it and force-closes the
+    thread's connection, so the wedged thread fails fast on its next
+    socket op instead of writing to a live peer after teardown."""
+    import logging
+    import time
+
+    release = threading.Event()
+
+    class WedgedPS(DeltaParameterServer):
+        def _apply(self, msg):
+            release.wait(20.0)  # the wedge: the apply never returns
+            super()._apply(msg)
+
+    from distkeras_tpu.core.model import serialize_model as ser
+    model = make_model()
+    params = model.init(__import__("jax").random.PRNGKey(0), (16,))
+    server = SocketParameterServer(WedgedPS(ser(model, params)))
+    server.start()
+    sock = networking.connect("127.0.0.1", server.port)
+    try:
+        networking.send_opcode(sock, b"c")
+        networking.send_data(
+            sock, {"delta": [np.zeros_like(w) for w in server.ps.center],
+                   "clock": 0})
+        deadline = time.time() + 5.0  # wait until the handler is wedged
+        while not server.ps._lock.locked() and time.time() < deadline:
+            time.sleep(0.01)
+        assert server.ps._lock.locked()
+        threads = list(server._conn_threads)
+        with caplog.at_level(logging.WARNING,
+                             logger="distkeras_tpu.parameter_servers"):
+            t0 = time.time()
+            server.stop(join_timeout=0.2)
+        assert time.time() - t0 < 5.0  # bounded, despite the wedge
+        assert "still alive" in caplog.text  # the leak is reported
+        release.set()  # un-wedge; the thread dies on its closed socket
+        for t in threads:
+            t.join(timeout=5.0)
+            assert not t.is_alive()
+    finally:
+        release.set()
+        server.stop()
+        sock.close()
