@@ -210,6 +210,51 @@ def host_ps_shard_bench(budget_s: float = 120.0):
     return {"host_ps_shard_scaling": out}
 
 
+def host_ps_wire_bytes_bench():
+    """Encoded commit bytes per window for each wire mode — the observable
+    for the delta-compression stack (docs/host_ps.md).  A representative
+    MNIST-MLP-scale delta (784→128→10, ~101k params) is pushed through the
+    exact encoders each mode uses (dense f32, bf16 cast, int8 codes +
+    per-tensor scales, sparse top-k at the default density 0.01) and the
+    full frame length counted.  Pure CPU, deterministic, sub-second.
+
+    Returns ``{"host_ps_wire_bytes_per_window": {mode: bytes},
+    "host_ps_commit_compression_ratio": {mode: dense/mode}}``.
+    """
+    import numpy as np
+
+    import ml_dtypes
+    from distkeras_tpu import networking
+    from distkeras_tpu.workers import topk_select
+
+    rng = np.random.default_rng(0)
+    shapes = [(784, 128), (128,), (128, 10), (10,)]
+    delta = [rng.standard_normal(s).astype(np.float32) * 0.01
+             for s in shapes]
+    base = {"worker_id": 0, "clock": 0}
+
+    def nbytes(msg):
+        return len(networking.encode_message(msg))
+
+    out = {"dense": nbytes({"delta": delta, **base})}
+    out["bfloat16"] = nbytes(
+        {"delta": [d.astype(ml_dtypes.bfloat16) for d in delta], **base})
+    scales = [float(np.max(np.abs(d)) / 127.0) or 1.0 for d in delta]
+    codes = [np.clip(np.rint(d / s), -127, 127).astype(np.int8)
+             for d, s in zip(delta, scales)]
+    out["int8"] = nbytes({"delta": codes, "scales": scales, **base})
+    flat = np.concatenate([d.reshape(-1) for d in delta])
+    k = max(1, int(np.ceil(0.01 * flat.size)))
+    idx, wire, _, scale, _ = topk_select(flat, k, None)
+    out["topk"] = nbytes(
+        {"delta": networking.SparseDelta(idx, wire, flat.size, scale),
+         **base})
+    ratios = {m: round(out["dense"] / b, 2)
+              for m, b in out.items() if m != "dense"}
+    return {"host_ps_wire_bytes_per_window": out,
+            "host_ps_commit_compression_ratio": ratios}
+
+
 def host_ps_recovery_bench(budget_s: float = 60.0):
     """Client-observed shard recovery latency: a 2-shard group under a
     ``ShardSupervisor``; one shard is crash-killed and the measured number
@@ -449,6 +494,18 @@ def main():
             print(f"[bench] host_ps shard bench failed: {e}",
                   file=sys.stderr)
     result.update(shard_fields)
+    # wire-byte observable for the commit-compression stack (dense vs
+    # bf16/int8/topk): deterministic and sub-second, so no budget gate —
+    # the byte win is tracked in every BENCH_* artifact
+    stage("host_ps wire bytes")
+    wire_fields = {"host_ps_wire_bytes_per_window": None,
+                   "host_ps_commit_compression_ratio": None}
+    try:
+        wire_fields = host_ps_wire_bytes_bench()
+    except Exception as e:
+        print(f"[bench] host_ps wire bytes bench failed: {e}",
+              file=sys.stderr)
+    result.update(wire_fields)
     # PS recovery latency (resilience.py): kill one shard under the
     # supervisor, measure client-observed time back to a successful pull
     stage("host_ps recovery")

@@ -55,6 +55,62 @@ except ImportError:  # pragma: no cover - depends on build environment
 # structure encoding
 # ---------------------------------------------------------------------------
 
+class SparseDelta:
+    """A k-sparse view of a flat float32 vector of dense length ``length``.
+
+    The wire form of a top-k-compressed commit (``wire_dtype="topk"`` —
+    workers.PSWorker): ``indices`` (int32, sorted ascending, unique) name the
+    selected coordinates of the *concatenated* flat weight vector and
+    ``values`` carry their magnitudes.  ``values`` may additionally be coded
+    (``wire_topk_dtype``): bfloat16 (cast) or int8 (one affine ``scale`` for
+    the whole commit, ``value = code * scale``).  On the wire this is a
+    dedicated payload node (two tensor buffers + scalars in the header), so
+    both the native and pure-Python codecs carry it unchanged — the codecs
+    frame buffers, the tree layer interprets them.
+
+    A commit costs O(k) bytes and O(k) apply work instead of O(n); the PS
+    applies it with a scatter-add (``parameter_servers._scatter_add``).
+    """
+
+    __slots__ = ("indices", "values", "length", "scale")
+
+    def __init__(self, indices, values, length: int,
+                 scale: Optional[float] = None):
+        self.indices = np.asarray(indices)
+        self.values = np.asarray(values)
+        self.length = int(length)
+        self.scale = None if scale is None else float(scale)
+        if self.indices.ndim != 1 or self.values.ndim != 1:
+            raise ValueError("SparseDelta indices/values must be 1-D")
+        if self.indices.shape != self.values.shape:
+            raise ValueError(
+                f"SparseDelta carries {self.indices.size} indices but "
+                f"{self.values.size} values")
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.size)
+
+    def f32_values(self) -> np.ndarray:
+        """Decode the (possibly coded) values to float32."""
+        if self.scale is not None:
+            return self.values.astype(np.float32) * np.float32(self.scale)
+        return self.values.astype(np.float32, copy=False)
+
+    def decoded(self) -> "SparseDelta":
+        """A defensively-copied, f32-valued twin (safe across pooled
+        receives; int64 indices would be rejected downstream, keep int32)."""
+        return SparseDelta(np.array(self.indices, np.int32, copy=True),
+                           np.array(self.f32_values(), np.float32, copy=True),
+                           self.length)
+
+    def to_dense(self) -> np.ndarray:
+        """Materialize the dense flat f32 vector (tests / densify helpers)."""
+        out = np.zeros((self.length,), np.float32)
+        np.add.at(out, self.indices.astype(np.int64), self.f32_values())
+        return out
+
+
 def _dtype_str(dt: np.dtype) -> str:
     """Wire name for a dtype.  ml_dtypes types (bfloat16 & friends) print as
     opaque void strs ('<V2'), so ship their registered *name* instead."""
@@ -71,6 +127,13 @@ def _dtype_of(name: str) -> np.dtype:
 
 def _encode_node(obj: Any, buffers: List[np.ndarray]):
     """Recursively replace ndarray leaves with buffer descriptors."""
+    if isinstance(obj, SparseDelta):
+        node = {"i": _encode_node(np.ascontiguousarray(obj.indices), buffers),
+                "v": _encode_node(np.ascontiguousarray(obj.values), buffers),
+                "n": int(obj.length)}
+        if obj.scale is not None:
+            node["s"] = float(obj.scale)
+        return {"__sp__": node}
     if isinstance(obj, np.ndarray):
         idx = len(buffers)
         buffers.append(np.ascontiguousarray(obj))
@@ -101,6 +164,11 @@ def _decode_node(node: Any, buffers: List[bytes], copy: bool = True):
                                 dtype=_dtype_of(node["dtype"]))
             arr = arr.reshape(node["shape"])
             return arr.copy() if copy else arr
+        if "__sp__" in node:
+            sp = node["__sp__"]
+            return SparseDelta(_decode_node(sp["i"], buffers, copy),
+                               _decode_node(sp["v"], buffers, copy),
+                               int(sp["n"]), sp.get("s"))
         if "__dict__" in node:
             return {k: _decode_node(v, buffers, copy)
                     for k, v in node["__dict__"].items()}
@@ -129,6 +197,37 @@ def encode_message(obj: Any) -> bytes:
     return b"".join(parts)
 
 
+def encode_message_into(obj: Any, pool: "BufferPool") -> memoryview:
+    """``encode_message`` into a reusable pooled buffer (the send-path twin
+    of the pooled receive): steady-state commits of a fixed wire layout
+    re-serialize into the same preallocated memory instead of allocating a
+    fresh output blob per window.  The returned view is valid until the next
+    ``encode_message_into`` on the same pool — callers ``sendall`` it
+    immediately (the PS protocol is strictly request/reply, so at most one
+    encoded frame is live per connection)."""
+    buffers: List[np.ndarray] = []
+    header = json.dumps(
+        {"tree": _encode_node(obj, buffers), "nbuf": len(buffers)}
+    ).encode()
+    total = 8 + len(header) + sum(8 + b.nbytes for b in buffers)
+    buf = pool.get(total)
+    buf[0:4] = MAGIC
+    _U32.pack_into(buf, 4, len(header))
+    off = 8
+    buf[off:off + len(header)] = header
+    off += len(header)
+    out_u8 = np.frombuffer(buf, dtype=np.uint8)
+    for b in buffers:
+        _U64.pack_into(buf, off, b.nbytes)
+        off += 8
+        # byte-level copy straight into the pooled buffer — no intermediate
+        # tobytes() allocation (works for ml_dtypes too: reshape(-1) handles
+        # 0-d, view(uint8) any itemsize on contiguous data)
+        out_u8[off:off + b.nbytes] = b.reshape(-1).view(np.uint8)
+        off += b.nbytes
+    return memoryview(buf)[:total]
+
+
 def _expected_buffer_sizes(tree: Any, out: dict):
     """Collect idx → byte-size for every ndarray descriptor in a header tree,
     so buffer lengths on the wire can be validated *before* allocation."""
@@ -138,6 +237,9 @@ def _expected_buffer_sizes(tree: Any, out: dict):
             for d in tree["shape"]:
                 size *= int(d)
             out[int(tree["__nd__"])] = size
+        elif "__sp__" in tree:
+            _expected_buffer_sizes(tree["__sp__"]["i"], out)
+            _expected_buffer_sizes(tree["__sp__"]["v"], out)
         elif "__dict__" in tree:
             for v in tree["__dict__"].values():
                 _expected_buffer_sizes(v, out)
@@ -311,8 +413,17 @@ def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
         view = view[n:]
 
 
-def send_data(sock: socket.socket, obj: Any) -> None:
-    """Frame and send one message (reference: ``networking.send_data``)."""
+def send_data(sock: socket.socket, obj: Any,
+              pool: Optional[BufferPool] = None) -> None:
+    """Frame and send one message (reference: ``networking.send_data``).
+
+    With ``pool``, the frame is serialized into a reusable per-connection
+    buffer (``encode_message_into``) — the steady-state commit/reply path
+    allocates no fresh output blob.  Wire bytes are identical either way.
+    """
+    if pool is not None:
+        sock.sendall(encode_message_into(obj, pool))
+        return
     sock.sendall(encode_message(obj))
 
 

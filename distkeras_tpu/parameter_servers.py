@@ -44,6 +44,45 @@ def _as_f32(delta):
     return [np.asarray(d).astype(np.float32, copy=False) for d in delta]
 
 
+def _scatter_add(center: List[np.ndarray], sp: "networking.SparseDelta",
+                 scale: float = 1.0) -> None:
+    """Apply a k-sparse flat delta to a tensor list: O(k) scatter-add.
+
+    ``sp`` indexes the concatenation of ``center`` (C-order flat, list
+    order); indices are validated against the dense length so a hostile or
+    mis-split commit raises instead of corrupting neighbouring tensors.
+    Sorted indices are bisected once over the tensor offsets, then each
+    tensor gets one ``np.add.at`` over its contiguous index run — the
+    whole apply touches k coordinates, not the n-element center.
+    """
+    sizes = np.array([int(c.size) for c in center], np.int64)
+    offsets = np.concatenate(([0], np.cumsum(sizes)))
+    total = int(offsets[-1])
+    if sp.length != total:
+        raise ValueError(
+            f"sparse commit declares dense length {sp.length}, center "
+            f"has {total} elements")
+    idx = sp.indices.astype(np.int64, copy=False)
+    vals = sp.f32_values()
+    if idx.size == 0:
+        return
+    if np.any(np.diff(idx) < 0):  # tolerate unsorted senders
+        order = np.argsort(idx, kind="stable")
+        idx, vals = idx[order], vals[order]
+    if idx[0] < 0 or idx[-1] >= total:
+        raise ValueError(
+            f"sparse commit index out of range for dense length {total}")
+    if scale != 1.0:
+        vals = vals * np.float32(scale)
+    bounds = np.searchsorted(idx, offsets)
+    for t in range(len(center)):
+        lo, hi = int(bounds[t]), int(bounds[t + 1])
+        if lo == hi:
+            continue
+        flat = center[t].reshape(-1)  # view: center tensors are contiguous
+        np.add.at(flat, idx[lo:hi] - int(offsets[t]), vals[lo:hi])
+
+
 class ParameterServer:
     """Base PS (reference: ``parameter_servers.py :: ParameterServer``):
     holds the center weights + the update clock."""
@@ -75,6 +114,23 @@ class ParameterServer:
     def _apply(self, msg: Dict[str, Any]):
         """Apply one commit to the center.  Called with ``_lock`` HELD."""
         raise NotImplementedError
+
+    def _apply_scaled(self, msg: Dict[str, Any], scale: float):
+        """Shared commit arithmetic: ``center += scale * delta`` for a dense
+        tensor list, or an O(k) scatter-add for a k-sparse commit
+        (``networking.SparseDelta`` — the ``wire_dtype="topk"`` wire form).
+        Every rule reduces to a scalar ``scale``, so sparsity composes with
+        all of them under the same apply lock."""
+        delta = msg["delta"]
+        if isinstance(delta, networking.SparseDelta):
+            _scatter_add(self.center, delta, scale)
+        elif scale == 1.0:
+            for c, d in zip(self.center, _as_f32(delta)):
+                c += d
+        else:
+            for c, d in zip(self.center, _as_f32(delta)):
+                c += scale * d
+        self.next_update()
 
     def handle_commit(self, msg: Dict[str, Any]):
         with self._lock:
@@ -110,10 +166,7 @@ class DeltaParameterServer(ParameterServer):
     term, so the same rule applies)."""
 
     def _apply(self, msg):
-        delta = _as_f32(msg["delta"])
-        for c, d in zip(self.center, delta):
-            c += d
-        self.next_update()
+        self._apply_scaled(msg, 1.0)
 
 
 class ADAGParameterServer(ParameterServer):
@@ -127,11 +180,7 @@ class ADAGParameterServer(ParameterServer):
         self.num_workers = max(int(num_workers), 1)
 
     def _apply(self, msg):
-        delta = _as_f32(msg["delta"])
-        scale = 1.0 / self.num_workers
-        for c, d in zip(self.center, delta):
-            c += scale * d
-        self.next_update()
+        self._apply_scaled(msg, 1.0 / self.num_workers)
 
 
 class DynSGDParameterServer(ParameterServer):
@@ -141,12 +190,8 @@ class DynSGDParameterServer(ParameterServer):
     ``rules.dynsgd_commit``."""
 
     def _apply(self, msg):
-        delta = _as_f32(msg["delta"])
         staleness = max(self.num_updates - int(msg.get("clock", 0)), 0)
-        scale = 1.0 / (staleness + 1.0)
-        for c, d in zip(self.center, delta):
-            c += scale * d
-        self.next_update()
+        self._apply_scaled(msg, 1.0 / (staleness + 1.0))
 
 
 class SocketParameterServer:
@@ -306,6 +351,10 @@ class SocketParameterServer:
         """Reference: ``handle_connection`` — loop on 1-byte actions until
         EOF/quit ('p' pull, 'c' commit, 'u' commit+pull, 'h' heartbeat,
         'q' quit).  Every reply carries this server's ``generation``."""
+        # per-connection send pool: replies (full center, fixed layout)
+        # re-serialize into the same preallocated buffer every round trip
+        # instead of allocating a weight-sized output blob per reply
+        send_pool = networking.BufferPool()
         try:
             while True:
                 op = networking.recv_opcode(conn)
@@ -314,14 +363,14 @@ class SocketParameterServer:
                 if op == b"p":
                     reply = self.ps.handle_pull()
                     reply["gen"] = self.generation
-                    networking.send_data(conn, reply)
+                    networking.send_data(conn, reply, pool=send_pool)
                 elif op == b"h":
                     # liveness probe (resilience.ShardSupervisor): clock +
                     # generation, no weights — and it takes the apply lock,
                     # so a wedged apply fails the probe deadline
                     reply = self.ps.handle_heartbeat()
                     reply["gen"] = self.generation
-                    networking.send_data(conn, reply)
+                    networking.send_data(conn, reply, pool=send_pool)
                 elif op in (b"c", b"u"):
                     try:
                         msg = networking.recv_data(conn)
@@ -335,6 +384,14 @@ class SocketParameterServer:
                         msg["delta"] = [
                             np.asarray(q, np.float32) * s
                             for q, s in zip(msg["delta"], msg.pop("scales"))]
+                    elif (isinstance(msg, dict) and
+                          isinstance(msg.get("delta"),
+                                     networking.SparseDelta)):
+                        # sparse top-k commit: dequantize the (possibly
+                        # bf16/int8-coded) values to f32 at the same
+                        # transport boundary — apply rules see f32 values
+                        # and scatter-add in O(k)
+                        msg["delta"] = msg["delta"].decoded()
                     # generation handshake: a commit stamped with an older
                     # generation was computed against a center a restart
                     # rolled back — drop it (bounded loss, same class as
@@ -359,7 +416,7 @@ class SocketParameterServer:
                         else:
                             reply = self.ps.handle_update(msg)
                         reply["gen"] = self.generation
-                        networking.send_data(conn, reply)
+                        networking.send_data(conn, reply, pool=send_pool)
                 else:
                     return  # protocol violation: drop the connection
         except (ConnectionError, OSError):
@@ -693,6 +750,8 @@ def _worker_kwargs(trainer, n: int, rows: int) -> dict:
         gradient_accumulation=accum,
         gradient_clip_norm=getattr(trainer, "gradient_clip_norm", None),
         wire_dtype=getattr(trainer, "wire_dtype", None),
+        wire_topk=getattr(trainer, "wire_topk", 0.01),
+        wire_topk_dtype=getattr(trainer, "wire_topk_dtype", None),
         comm_overlap=getattr(trainer, "comm_overlap", False),
         fault_injection=getattr(trainer, "fault_injection", None))
     if trainer.ALGORITHM in ("aeasgd", "eamsgd"):

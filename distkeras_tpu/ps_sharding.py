@@ -104,6 +104,7 @@ class ShardPlan:
         self.dtypes = [np.dtype(d) for d in dtypes]
         self.num_shards = int(num_shards)
         self.assignments = assignments
+        self._flat_meta = None  # lazy: flat-interval map for sparse commits
 
     def slice_bytes(self, s: ShardSlice) -> int:
         shape = self.shapes[s.tensor]
@@ -125,6 +126,84 @@ class ShardPlan:
         """Full tensor list → per-shard slice lists (views, zero-copy)."""
         return [[self.take(tensors[s.tensor], s) for s in a]
                 for a in self.assignments]
+
+    # -- sparse (flat top-k) commits ----------------------------------------
+    def _flat_intervals(self):
+        """Lazily build the flat-interval map for sparse-commit bisection.
+
+        Every ``ShardSlice`` is one CONTIGUOUS interval of the concatenated
+        flat weight vector (row-split slices are leading-axis ranges of
+        C-contiguous tensors), and the slices tile it exactly.  Returns
+        sorted arrays ``(g_starts, shard_ids, local_starts)`` plus the
+        per-shard element counts — a flat index bisects to its interval in
+        O(log m), and its shard-LOCAL coordinate is
+        ``idx - g_start + local_start`` (shard layout = its slices
+        concatenated in assignment order, matching the shard's wire/center
+        layout).
+        """
+        if self._flat_meta is not None:
+            return self._flat_meta
+        elems = [int(np.prod(s, dtype=np.int64)) for s in self.shapes]
+        toff = np.concatenate(([0], np.cumsum(np.asarray(elems, np.int64))))
+        starts, shards, locals_ = [], [], []
+        shard_elems = [0] * self.num_shards
+        for j, pieces in enumerate(self.assignments):
+            loc = 0
+            for s in pieces:
+                shape = self.shapes[s.tensor]
+                per_row = int(np.prod(shape[1:], dtype=np.int64)) \
+                    if shape else 1
+                starts.append(int(toff[s.tensor]) + s.start * per_row)
+                shards.append(j)
+                locals_.append(loc)
+                loc += (s.stop - s.start) * per_row
+            shard_elems[j] = loc
+        order = np.argsort(np.asarray(starts, np.int64), kind="stable")
+        self._flat_meta = (np.asarray(starts, np.int64)[order],
+                           np.asarray(shards, np.int64)[order],
+                           np.asarray(locals_, np.int64)[order],
+                           shard_elems, int(toff[-1]))
+        return self._flat_meta
+
+    def flat_elements(self) -> int:
+        """Dense length of the concatenated flat weight vector."""
+        return self._flat_intervals()[4]
+
+    def shard_elements(self) -> List[int]:
+        """Per-shard dense length (sum of its slice element counts)."""
+        return list(self._flat_intervals()[3])
+
+    def shard_of_flat(self, indices: np.ndarray) -> np.ndarray:
+        """Owning shard id per global flat index (validated in range)."""
+        g_starts, shards, _, _, total = self._flat_intervals()
+        idx = np.asarray(indices, np.int64)
+        if idx.size and (idx.min() < 0 or idx.max() >= total):
+            raise ValueError(
+                f"flat index out of range for dense length {total}")
+        pos = np.searchsorted(g_starts, idx, side="right") - 1
+        return shards[pos]
+
+    def split_sparse(self, indices: np.ndarray, values: np.ndarray
+                     ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Scatter a flat sparse commit over the shards by index bisection:
+        returns per-shard ``(local_indices int32, values)`` in the shard's
+        own flat coordinates (row-split tensors re-index into slice-local
+        positions).  Sorted global indices stay sorted per shard, because a
+        shard's slices are kept in ascending global order."""
+        g_starts, shards, local_starts, _, total = self._flat_intervals()
+        idx = np.asarray(indices, np.int64)
+        values = np.asarray(values)
+        if idx.size and (idx.min() < 0 or idx.max() >= total):
+            raise ValueError(
+                f"flat index out of range for dense length {total}")
+        pos = np.searchsorted(g_starts, idx, side="right") - 1
+        local = idx - g_starts[pos] + local_starts[pos]
+        owner = shards[pos]
+        out = []
+        for j in range(self.num_shards):
+            m = owner == j
+            out.append((local[m].astype(np.int32), values[m]))
+        return out
 
     def gather(self, shard_tensors: Sequence[Sequence[np.ndarray]]
                ) -> List[np.ndarray]:
@@ -240,7 +319,16 @@ class ShardedPSClient:
         self._socks: List[Optional[socket.socket]] = [None] * plan.num_shards
         self._pools: List[Optional[networking.BufferPool]] = (
             [None] * plan.num_shards)
+        #: encode-side scratch pools (one per shard): steady-state commits
+        #: re-serialize into reusable buffers instead of allocating a fresh
+        #: output blob per window per shard
+        self._send_pools: List[Optional[networking.BufferPool]] = (
+            [None] * plan.num_shards)
         self._clocks = [0] * plan.num_shards
+        #: per-shard ``stale`` flags from the last ``recv_update`` gather —
+        #: a True entry means that shard gen-rejected the in-flight commit
+        #: (workers re-credit the dropped sparse mass into their residual)
+        self.last_stale: List[bool] = [False] * plan.num_shards
         #: last reply clock seen on the CURRENT connection to each shard
         #: (None until the first reply; reset on reconnect).  This — not
         #: the monotonic ``_clocks`` view — is the duplicate-reply
@@ -296,6 +384,7 @@ class ShardedPSClient:
             try:
                 self._socks[j] = dial(host, port, policy)
                 self._pools[j] = networking.BufferPool()
+                self._send_pools[j] = networking.BufferPool()
             except RETRYABLE_CONNECT as e:
                 self.abort()
                 raise PSShardDown(
@@ -316,6 +405,7 @@ class ShardedPSClient:
             self._socks[j] = None
         self._socks[j] = networking.connect(*self.addrs[j])
         self._pools[j] = networking.BufferPool()
+        self._send_pools[j] = networking.BufferPool()
         self._conn_clocks[j] = None
 
     def _with_resume(self, j: int, fn, fault: BaseException):
@@ -368,11 +458,18 @@ class ShardedPSClient:
                 self._socks[j] = None
 
     # -- transport with shard-fault attribution ------------------------------
+    def _send_frame(self, j: int, payload: dict):
+        pool = self._send_pools[j]
+        if pool is None:
+            networking.send_data(self._socks[j], payload)
+        else:
+            networking.send_data(self._socks[j], payload, pool=pool)
+
     def _send(self, j: int, op: bytes, payload: Optional[dict] = None):
         try:
             networking.send_opcode(self._socks[j], op)
             if payload is not None:
-                networking.send_data(self._socks[j], payload)
+                self._send_frame(j, payload)
         except (ConnectionError, OSError) as e:
             if not self.recovery:
                 raise PSShardDown(j, self.addrs[j]) from e
@@ -384,7 +481,7 @@ class ShardedPSClient:
             def resend():
                 networking.send_opcode(self._socks[j], op)
                 if payload is not None:
-                    networking.send_data(self._socks[j], payload)
+                    self._send_frame(j, payload)
 
             self._with_resume(j, resend, e)
 
@@ -415,12 +512,32 @@ class ShardedPSClient:
         gets only its delta slices (and, for int8, the parent tensor's scale
         per slice — quantization happened on the *full* tensor, so the
         as-applied delta is independent of the sharding), stamped with that
-        shard's own last-seen clock."""
+        shard's own last-seen clock.
+
+        A SPARSE commit (``networking.SparseDelta`` — the flat top-k wire
+        form) splits by index bisection instead: each global flat index maps
+        to its owning shard's interval and re-indexes into that shard's own
+        flat coordinates (``ShardPlan.split_sparse``); the per-commit value
+        scale (int8-coded values) is shared by every shard, because the
+        quantization ran on the full selected set before the scatter."""
         deltas = msg["delta"]
+        out: List[Dict[str, Any]] = []
+        if isinstance(deltas, networking.SparseDelta):
+            parts = self.plan.split_sparse(deltas.indices, deltas.values)
+            shard_elems = self.plan.shard_elements()
+            for j, (li, lv) in enumerate(parts):
+                m: Dict[str, Any] = {
+                    "delta": networking.SparseDelta(li, lv, shard_elems[j],
+                                                    deltas.scale),
+                    "worker_id": msg.get("worker_id"),
+                    "clock": self._clocks[j]}
+                if self._gens[j] is not None:
+                    m["gen"] = self._gens[j]
+                out.append(m)
+            return out
         scales = msg.get("scales")
-        out = []
         for j, pieces in enumerate(self.plan.assignments):
-            m: Dict[str, Any] = {
+            m = {
                 "delta": [self.plan.take(deltas[s.tensor], s)
                           for s in pieces],
                 "worker_id": msg.get("worker_id"),
@@ -481,6 +598,7 @@ class ShardedPSClient:
 
     def _gather_replies(self, dedupe: bool = False) -> List[np.ndarray]:
         slices = []
+        stale_flags = [False] * self.num_shards
         for j in range(self.num_shards):
             reply, resumed = self._recv(j)
             if dedupe and self.recovery and not resumed:
@@ -499,7 +617,14 @@ class ShardedPSClient:
                     if resumed:
                         break
             self._sync_reply(j, reply)
+            # a gen-rejected ('stale'-marked) combined reply means this
+            # shard DROPPED the in-flight commit — surfaced per shard so
+            # topk workers can re-credit the dropped mass into their
+            # error-feedback residual (a resumed pull re-sync stays False:
+            # its commit's fate is unknown, the bounded-loss class)
+            stale_flags[j] = bool(reply.get("stale")) and not resumed
             slices.append(reply["weights"])
+        self.last_stale = stale_flags
         # per-shard pools: shard j's views stay valid while shard j+1
         # receives into its own pool, so one gather after the loop is safe
         return self.plan.gather(slices)

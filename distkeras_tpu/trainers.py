@@ -307,6 +307,8 @@ class DistributedTrainer(Trainer):
                  checkpoint_backend: str = "npz",
                  metrics_path: Optional[str] = None,
                  wire_dtype: Optional[str] = None,
+                 wire_topk: float = 0.01,
+                 wire_topk_dtype: Optional[str] = None,
                  lr_schedule=None, gradient_accumulation: int = 1,
                  gradient_clip_norm: Optional[float] = None,
                  early_stopping_patience: Optional[int] = None,
@@ -333,10 +335,27 @@ class DistributedTrainer(Trainer):
             else self.DEFAULT_WINDOW)
         self.execution = execution
         # host_ps/process_ps wire compression for commits: "bfloat16" (2x
-        # fewer delta bytes) or "int8" (4x, per-tensor scales + error
-        # feedback — workers.PSWorker.commit); the SPMD path has no wire —
-        # deltas ride ICI inside the XLA program
+        # fewer delta bytes), "int8" (4x, per-tensor scales + error
+        # feedback), or "topk" (sparse top-k selection: only the wire_topk
+        # densest delta coordinates ship, ~1/density fewer bytes, with
+        # error feedback; values optionally bf16/int8-coded on top via
+        # wire_topk_dtype — workers.PSWorker.commit); the SPMD path has no
+        # wire — deltas ride ICI inside the XLA program
         self.wire_dtype = wire_dtype
+        self.wire_topk = float(wire_topk)
+        self.wire_topk_dtype = wire_topk_dtype
+        if wire_dtype == "topk":
+            if not 0.0 < self.wire_topk <= 1.0:
+                raise ValueError(
+                    f"wire_topk must be a density in (0, 1], got "
+                    f"{self.wire_topk}")
+            if wire_topk_dtype not in (None, "bfloat16", "int8"):
+                raise ValueError(
+                    "wire_topk_dtype must be None, 'bfloat16' or 'int8', "
+                    f"got {wire_topk_dtype!r}")
+        elif wire_topk_dtype is not None:
+            raise ValueError(
+                "wire_topk_dtype applies to wire_dtype='topk' only")
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = max(int(checkpoint_every), 1)
         if checkpoint_unit not in ("epoch", "round"):

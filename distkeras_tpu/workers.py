@@ -43,6 +43,48 @@ from .resilience import (DEFAULT_CONNECT_POLICY, DEFAULT_RECOVERY_POLICY,
                          RETRYABLE_CONNECT, RetryPolicy, dial)
 
 
+def topk_select(eff: np.ndarray, k: int, code: Optional[str] = None):
+    """Host-side top-k-by-magnitude selection with error feedback.
+
+    ``eff`` is the effective flat f32 delta (this window's delta plus the
+    carried residual).  Selects the ``k`` largest-magnitude coordinates
+    (Aji & Heafield 2017; Lin et al., Deep Gradient Compression), optionally
+    codes the values (``"bfloat16"`` cast or ``"int8"`` with one affine
+    scale per commit), and returns::
+
+        (indices int32 sorted, wire_values, applied_f32, scale, residual)
+
+    where ``eff == densify(indices, applied_f32) + residual`` exactly — the
+    unsent mass AND any value-coding error telescope into the next window
+    instead of accumulating in the center (the EF-SGD recipe).  The device
+    twin lives in ``PSWorker._build_topk_window_fn``.
+    """
+    eff = np.ascontiguousarray(eff, np.float32)
+    n = eff.size
+    k = max(1, min(int(k), n))
+    if k >= n:
+        idx = np.arange(n, dtype=np.int32)
+    else:
+        part = np.argpartition(np.abs(eff), n - k)[n - k:]
+        idx = np.sort(part).astype(np.int32)
+    vals = eff[idx]
+    scale = None
+    if code == "int8":
+        scale = float(np.max(np.abs(vals)) / 127.0) or 1.0
+        wire = np.clip(np.rint(vals / scale), -127, 127).astype(np.int8)
+        applied = wire.astype(np.float32) * np.float32(scale)
+    elif code == "bfloat16":
+        import ml_dtypes
+        wire = vals.astype(ml_dtypes.bfloat16)
+        applied = wire.astype(np.float32)
+    else:
+        wire = vals.astype(np.float32)
+        applied = wire
+    residual = eff.copy()
+    residual[idx] = vals - applied
+    return idx, wire, applied, scale, residual
+
+
 class Worker:
     """Base worker (reference: ``workers.py :: Worker``): holds the serialized
     model + training config and builds the jitted local window runner."""
@@ -87,13 +129,10 @@ class Worker:
                                         self.gradient_clip_norm)
         return self._model
 
-    def _build_window_fn(self):
-        """jitted (params, opt_state, xw, yw, mw, rng) -> (params, opt_state,
-        loss) scanning a (window, batch, ...) stack of minibatches.  ``mw``
-        is the per-example real/padding mask from ``_shard_to_windows``; the
-        returned loss is the exact mean over real examples."""
-        if self._window_fn is not None:
-            return self._window_fn
+    def _make_window_body(self):
+        """The unjitted window program: (params, opt_state, xw, yw, mw, rng)
+        -> (params, opt_state, loss).  Shared by the plain jitted window fn
+        and the top-k variant that appends device-side delta selection."""
         model = self._ensure_model()
         step = make_masked_step(model, self.loss, self._tx)
 
@@ -110,6 +149,17 @@ class Worker:
             return (params, opt_state,
                     jnp.sum(losses * wsums) / jnp.maximum(jnp.sum(wsums), 1.0))
 
+        return window
+
+    def _build_window_fn(self):
+        """jitted (params, opt_state, xw, yw, mw, rng) -> (params, opt_state,
+        loss) scanning a (window, batch, ...) stack of minibatches.  ``mw``
+        is the per-example real/padding mask from ``_shard_to_windows``; the
+        returned loss is the exact mean over real examples."""
+        if self._window_fn is not None:
+            return self._window_fn
+        window = self._make_window_body()
+
         # donate params/opt_state: the window updates them in place instead
         # of holding input and output copies live at once — same contract as
         # the SPMD engine's epoch/round programs (parallel/spmd.py donates
@@ -125,7 +175,13 @@ class Worker:
         return model.set_weights(self._params0, weights)
 
     def _params_to_weights(self, params) -> List[np.ndarray]:
-        return self._ensure_model().get_weights(params)
+        # ONE bulk device→host transfer for the whole pytree (jax batches
+        # the per-leaf copies inside a single device_get) instead of a
+        # Python loop of per-tensor np.asarray round trips — the fetch every
+        # wire mode pays once per window.  Leaf order matches
+        # ``model.get_weights`` (both walk ``tree_leaves``).
+        self._ensure_model()
+        return jax.device_get(jax.tree_util.tree_leaves(params))
 
     def _shard_to_windows(self, shard: Dict[str, np.ndarray], window: int,
                           epoch_seed: int
@@ -187,6 +243,8 @@ class PSWorker(Worker):
     def __init__(self, model_blob, worker_optimizer, loss, ps_host: str,
                  ps_port: int, communication_window: int = 5,
                  wire_dtype: Optional[str] = None,
+                 wire_topk: float = 0.01,
+                 wire_topk_dtype: Optional[str] = None,
                  comm_overlap: bool = False,
                  fault_injection: Optional[dict] = None,
                  shard_plan=None, shard_addrs=None,
@@ -220,15 +278,46 @@ class PSWorker(Worker):
         self._commits = 0
         # e.g. "bfloat16": halve commit bytes; "int8": quarter them with
         # per-tensor affine quantization + error feedback (see commit()).
-        # Resolved eagerly so a bad name fails at construction, not
-        # mid-training in a worker thread.
+        # "topk": ship only the wire_topk·n largest-magnitude coordinates of
+        # the flat delta as a sparse (indices, values) commit with error
+        # feedback — O(k) bytes and O(k) PS apply instead of O(n); values
+        # optionally bf16/int8-coded on top (wire_topk_dtype).  Resolved
+        # eagerly so a bad name fails at construction, not mid-training in
+        # a worker thread.
+        self._topk_density: Optional[float] = None
+        if wire_dtype == "topk":
+            density = float(wire_topk)
+            if not 0.0 < density <= 1.0:
+                raise ValueError(
+                    f"wire_topk must be a density in (0, 1], got {density}")
+            if wire_topk_dtype not in (None, "bfloat16", "int8"):
+                raise ValueError(
+                    "wire_topk_dtype must be None, 'bfloat16' or 'int8', "
+                    f"got {wire_topk_dtype!r}")
+            self._topk_density = density
+            wire_dtype = None
+        self.wire_topk_dtype = wire_topk_dtype
         self._quantize = wire_dtype == "int8"
         self.wire_dtype = (networking._dtype_of(wire_dtype)
                            if wire_dtype is not None and not self._quantize
                            else None)
         self._residual: Optional[List[np.ndarray]] = None
+        # top-k error-feedback state: exactly one of the two residuals is
+        # live per worker — the DEVICE flat residual (delta family: selection
+        # runs jitted on device, only k values + indices are fetched) or the
+        # HOST flat residual (elastic family / direct commit() calls).
+        self._residual_dev = None
+        self._residual_flat: Optional[np.ndarray] = None
+        self._topk_window_fn = None
+        self._wire_k: Optional[int] = None
+        self._wire_total: Optional[int] = None
+        self._wire_shapes: Optional[List[tuple]] = None
+        #: (indices, applied f32 values) of the last in-flight 'u' commit —
+        #: re-credited into the residual if a respawned PS gen-rejects it
+        self._inflight = None
         self._sock: Optional[socket.socket] = None
         self._pool: Optional[networking.BufferPool] = None
+        self._send_pool: Optional[networking.BufferPool] = None
         self._last_clock = 0
         # reconnect-resume (resilience.py): with recovery on, a mid-run
         # transport fault re-dials the PS under retry_policy and re-syncs
@@ -247,6 +336,8 @@ class PSWorker(Worker):
         self.resumes = 0
         self.stale_replies = 0
         self.clock_regressions = 0
+        #: sparse commits whose gen-rejection re-credited the EF residual
+        self.recredits = 0
 
     # -- wire ---------------------------------------------------------------
     def _connect_policy(self, attempts: Optional[int] = None,
@@ -295,6 +386,7 @@ class PSWorker(Worker):
                 f"PS at {self.ps_host}:{self.ps_port} refused "
                 f"{pol.describe()} connection attempts") from e
         self._pool = networking.BufferPool()
+        self._send_pool = networking.BufferPool()
         self._conn_clock = None
 
     def _with_resume(self, fn, fault: BaseException):
@@ -316,6 +408,7 @@ class PSWorker(Worker):
                     self._sock = None
                 self._sock = networking.connect(self.ps_host, self.ps_port)
                 self._pool = networking.BufferPool()
+                self._send_pool = networking.BufferPool()
                 self._conn_clock = None
                 out = fn()
                 self.resumes += 1
@@ -386,6 +479,178 @@ class PSWorker(Worker):
         self.transport_ops += 1
         return msg["weights"]
 
+    # -- sparse top-k compression (wire_dtype="topk") ------------------------
+    #: delta-family workers select the top-k ON DEVICE (delta = after − base
+    #: inside the jitted window program); the elastic family computes its
+    #: force term on the host and selects there
+    _DEVICE_TOPK = False
+    #: error feedback fits ACCUMULATIVE commits (window deltas: unsent mass
+    #: stays valid to add later).  The elastic family's force e = α·(x − x̃)
+    #: is recomputed from current state every window — its unsent components
+    #: are still present in the next force, so a residual would double-count
+    #: them; the elastic workers sparsify WITHOUT a residual instead (the
+    #: spring stays stretched until its components are selected).
+    _TOPK_EF = True
+
+    def _ensure_topk(self) -> int:
+        """Resolve k and the flat layout (density · total elements, at
+        least 1); indices ride as int32 on the wire.  The layout comes from
+        the model blob's weight list — the wire order every pull/commit
+        already uses — so no model deserialization is needed."""
+        if self._wire_k is None:
+            self._wire_shapes = [tuple(np.shape(w))
+                                 for w in self.model_blob["weights"]]
+            total = sum(int(np.prod(s, dtype=np.int64))
+                        for s in self._wire_shapes)
+            if total >= 2 ** 31:
+                raise ValueError(
+                    "wire_dtype='topk' indexes the flat weight vector with "
+                    f"int32; {total} elements overflow it")
+            self._wire_total = total
+            self._wire_k = max(1, min(total, int(np.ceil(
+                self._topk_density * total))))
+        return self._wire_k
+
+    def _build_topk_window_fn(self):
+        """The top-k variant of the window fn: runs the same scan, then a
+        device-side ``jax.lax.top_k``-by-magnitude pass over the flat delta
+        (after − base + residual), so only k values + k int32 indices ever
+        leave the device — the full delta is never fetched to host.  Value
+        coding (bf16 cast / int8 quantization) also runs on device, and the
+        residual keeps both the unsent mass and the coding error (EF-SGD).
+
+        jitted (params, opt_state, residual, xw, yw, mw, rng) ->
+        (params, opt_state, loss, codes, indices, scale, residual');
+        donates params/opt_state (as the plain window fn) and the residual.
+        """
+        if self._topk_window_fn is not None:
+            return self._topk_window_fn
+        k = self._ensure_topk()
+        code = self.wire_topk_dtype
+        window = self._make_window_body()
+
+        def flatten(params):
+            return jnp.concatenate(
+                [l.reshape(-1).astype(jnp.float32)
+                 for l in jax.tree_util.tree_leaves(params)])
+
+        def topk_window(params, opt_state, residual, xw, yw, mw, rng):
+            base = flatten(params)
+            params, opt_state, loss = window(params, opt_state, xw, yw, mw,
+                                             rng)
+            eff = flatten(params) - base + residual
+            _, ai = jax.lax.top_k(jnp.abs(eff), k)
+            ai = jnp.sort(ai)  # ascending: bisection + scatter friendly
+            vals = eff[ai]
+            scale = jnp.float32(1.0)
+            if code == "int8":
+                scale = jnp.max(jnp.abs(vals)) / 127.0
+                scale = jnp.where(scale <= 0, jnp.float32(1.0), scale)
+                codes = jnp.clip(jnp.round(vals / scale),
+                                 -127, 127).astype(jnp.int8)
+                applied = codes.astype(jnp.float32) * scale
+            elif code == "bfloat16":
+                codes = vals.astype(jnp.bfloat16)
+                applied = codes.astype(jnp.float32)
+            else:
+                codes = vals
+                applied = vals
+            residual = eff.at[ai].add(-applied)
+            return (params, opt_state, loss, codes,
+                    ai.astype(jnp.int32), scale, residual)
+
+        self._topk_window_fn = jax.jit(topk_window, donate_argnums=(0, 1, 2))
+        return self._topk_window_fn
+
+    def _run_topk_window(self, params, opt_state, xw, yw, mw, rng):
+        """Dispatch one top-k window on the device.  Returns the device
+        handles — callers fetch ``codes``/``idx``/``scale`` (k elements,
+        not n) when they need them on the host, which lets the overlapped
+        loop receive the previous reply first."""
+        fn = self._build_topk_window_fn()
+        if self._residual_dev is None:
+            self._residual_dev = jnp.zeros((self._wire_total,), jnp.float32)
+        (params, opt_state, loss, codes, idx, scale,
+         self._residual_dev) = fn(params, opt_state, self._residual_dev,
+                                  jnp.asarray(xw), jnp.asarray(yw),
+                                  jnp.asarray(mw), rng)
+        return params, opt_state, loss, codes, idx, scale
+
+    def _fetch_sparse(self, codes, idx, scale) -> networking.SparseDelta:
+        """Materialize a device selection as the wire node: ONE device_get
+        of (k values, k indices, scale)."""
+        codes_np, idx_np, scale_np = jax.device_get((codes, idx, scale))
+        return networking.SparseDelta(
+            idx_np, codes_np, self._wire_total,
+            float(scale_np) if self.wire_topk_dtype == "int8" else None)
+
+    def _densify(self, idx, vals) -> List[np.ndarray]:
+        """Sparse (idx, f32 values) → weight-shaped dense list (the
+        as-applied delta ``commit`` returns, keeping elastic coupling and
+        the overlap rebase exact)."""
+        flat = np.zeros((self._wire_total,), np.float32)
+        flat[np.asarray(idx, np.int64)] = vals
+        out, off = [], 0
+        for s in self._wire_shapes:
+            n = int(np.prod(s, dtype=np.int64))
+            out.append(flat[off:off + n].reshape(s))
+            off += n
+        return out
+
+    def _recredit(self, idx: np.ndarray, vals: np.ndarray):
+        """Return dropped as-applied sparse mass to the error-feedback
+        residual: a respawned PS gen-rejected the commit, so the mass never
+        reached the center and must ship again — without this, EF would
+        believe it applied and the mass would be lost for good."""
+        if not self._TOPK_EF:
+            return  # elastic family: the recomputed spring force re-applies
+        if self._residual_dev is not None:
+            self._residual_dev = self._residual_dev.at[
+                jnp.asarray(np.asarray(idx, np.int32))].add(
+                jnp.asarray(np.asarray(vals, np.float32)))
+        else:
+            if self._residual_flat is None:
+                self._residual_flat = np.zeros((self._wire_total,),
+                                               np.float32)
+            np.add.at(self._residual_flat, np.asarray(idx, np.int64),
+                      np.asarray(vals, np.float32))
+        self.recredits += 1
+
+    def _prepare_topk_commit(self, delta, worker_id: int):
+        """Top-k wire form of a commit: either a device-selected
+        ``SparseDelta`` (delta family) or a host-side ``topk_select`` over
+        the dense delta + flat residual (elastic family, direct callers)."""
+        k = self._ensure_topk()
+        if isinstance(delta, networking.SparseDelta):
+            sp = delta
+            idx = np.asarray(sp.indices)
+            applied_vals = sp.f32_values()
+        else:
+            flat = np.concatenate(
+                [np.asarray(d, np.float32).reshape(-1) for d in delta])
+            if flat.size != self._wire_total:
+                raise ValueError(
+                    f"delta carries {flat.size} elements, model has "
+                    f"{self._wire_total}")
+            if self._TOPK_EF:
+                if self._residual_flat is None:
+                    self._residual_flat = np.zeros((self._wire_total,),
+                                                   np.float32)
+                eff = flat + self._residual_flat
+                idx, wire, applied_vals, scale, self._residual_flat = \
+                    topk_select(eff, k, self.wire_topk_dtype)
+            else:
+                idx, wire, applied_vals, scale, _ = topk_select(
+                    flat, k, self.wire_topk_dtype)
+            sp = networking.SparseDelta(idx, wire, self._wire_total, scale)
+        msg = {"delta": sp, "worker_id": worker_id,
+               "clock": self._last_clock}
+        if self._gen is not None:
+            msg["gen"] = self._gen
+        self._inflight = (np.array(idx, np.int64, copy=True),
+                          np.array(applied_vals, np.float32, copy=True))
+        return msg, self._densify(idx, applied_vals)
+
     def _prepare_commit(self, delta: List[np.ndarray], worker_id: int):
         """Fault-injection gate + wire compression shared by 'c' and 'u'.
         Returns ``(msg, applied)``: the wire message and the delta the PS
@@ -406,6 +671,8 @@ class PSWorker(Worker):
             raise RuntimeError(
                 f"injected fault: worker {worker_id} dies at commit "
                 f"{self._commits}")
+        if self._topk_density is not None:
+            return self._prepare_topk_commit(delta, worker_id)
         if self._quantize:
             if self._residual is None:
                 self._residual = [np.zeros_like(d, dtype=np.float32)
@@ -452,6 +719,15 @@ class PSWorker(Worker):
         telescopes instead of accumulating in the center (the 1-bit-SGD /
         EF-SGD recipe).  Lossy compression the reference's pickle transport
         had no counterpart for.
+
+        ``wire_dtype="topk"``: sparse top-k selection — only the
+        ``wire_topk``-density largest-magnitude coordinates of the flat
+        delta ship (``networking.SparseDelta``: int32 indices + values,
+        optionally bf16/int8-coded via ``wire_topk_dtype``), an O(k)
+        commit on the wire AND at the PS apply.  Error feedback carries
+        the unsent mass (delta family; the elastic force is stateful and
+        selects without a residual).  ``delta`` may also be an
+        already-selected ``SparseDelta`` (the device-side path).
         """
         msg, applied = self._prepare_commit(delta, worker_id)
         if self._shard_client is not None:
@@ -470,7 +746,12 @@ class PSWorker(Worker):
 
         def send():
             networking.send_opcode(self._sock, op)
-            networking.send_data(self._sock, msg)
+            if self._send_pool is None:
+                networking.send_data(self._sock, msg)
+            else:
+                # encode-side scratch pool: the commit re-serializes into a
+                # reusable buffer (same wire bytes, no fresh output blob)
+                networking.send_data(self._sock, msg, pool=self._send_pool)
 
         try:
             send()
@@ -512,6 +793,20 @@ class PSWorker(Worker):
             weights = self._shard_client.recv_update()
             self._last_clock = max(self._last_clock,
                                    self._shard_client.max_clock)
+            # residual re-sync across a shard restart: shards that
+            # gen-rejected the in-flight sparse commit dropped their split
+            # of it — re-credit exactly those coordinates (owner-shard
+            # lookup by flat-index bisection) so error feedback ships the
+            # mass again instead of losing it
+            if self._inflight is not None and any(
+                    self._shard_client.last_stale):
+                idx, vals = self._inflight
+                owner = self.shard_plan.shard_of_flat(idx)
+                mask = np.asarray(self._shard_client.last_stale,
+                                  bool)[owner]
+                if mask.any():
+                    self._recredit(idx[mask], vals[mask])
+            self._inflight = None
             return weights
         resumed = False
         try:
@@ -539,6 +834,15 @@ class PSWorker(Worker):
                 self.stale_replies += 1
                 msg = networking.recv_data(self._sock, pool=self._pool)
         self._sync_reply(msg)
+        # residual re-sync across a PS restart: a 'stale'-marked reply means
+        # the restarted server gen-rejected (dropped) the in-flight sparse
+        # commit — re-credit its as-applied mass into the error-feedback
+        # residual so it ships again.  A resumed pull re-sync stays silent:
+        # that commit's fate is unknown (the bounded-loss class).
+        if (not resumed and msg.get("stale")
+                and self._inflight is not None):
+            self._recredit(*self._inflight)
+        self._inflight = None
         return msg["weights"]
 
     def update(self, delta: List[np.ndarray], worker_id: int):
@@ -626,21 +930,35 @@ class PSWorker(Worker):
         scale.  The elastic family couples through the as-applied delta
         (``applied``), so x and x̃ still move by the same elastic term.
         """
+        # wire_dtype="topk" on the delta family: selection runs ON DEVICE
+        # inside the jitted window program — only k values + indices are
+        # fetched per window, never the full delta (the elastic family
+        # computes its force term on host and selects there instead)
+        device_topk = self._topk_density is not None and self._DEVICE_TOPK
         base = self._params_to_weights(params)
         pending = False
         for i in range(len(xw)):
             rng, sub = jax.random.split(rng)
             # async dispatch: the window program starts on the device now
-            params, opt_state, loss = window_fn(
-                params, opt_state, jnp.asarray(xw[i]), jnp.asarray(yw[i]),
-                jnp.asarray(mw[i]), sub)
+            if device_topk:
+                params, opt_state, loss, codes, idxs, scale = \
+                    self._run_topk_window(params, opt_state, xw[i], yw[i],
+                                          mw[i], sub)
+            else:
+                params, opt_state, loss = window_fn(
+                    params, opt_state, jnp.asarray(xw[i]),
+                    jnp.asarray(yw[i]), jnp.asarray(mw[i]), sub)
             if pending:
                 # the previous window's reply arrives while this window
                 # computes — the transport hides behind the device
                 center = self.update_finish()
                 pending = False
-            after = self._params_to_weights(params)  # blocks on the device
-            delta = self._overlap_delta(base, after, center)
+            if device_topk:
+                after = None  # the delta-family hooks never touch it
+                delta = self._fetch_sparse(codes, idxs, scale)  # blocks; O(k)
+            else:
+                after = self._params_to_weights(params)  # blocks; O(n)
+                delta = self._overlap_delta(base, after, center)
             applied = self.update_begin(delta, index)
             pending = True
             base = self._overlap_next(base, after, applied, center)
@@ -677,9 +995,17 @@ class DOWNPOURWorker(PSWorker):
     """DistBelief async SGD (reference: ``workers.py :: DOWNPOURWorker``):
     commit the raw accumulated window delta, then re-pull the center."""
     ALGORITHM = "downpour"
+    _DEVICE_TOPK = True  # delta = after − base: selectable inside the jit
 
     def _window_step(self, window_fn, params, opt_state, xw, yw, mw, rng,
                      index):
+        if self._topk_density is not None:
+            # device-side selection: the full delta never reaches the host
+            params, opt_state, loss, codes, idxs, scale = \
+                self._run_topk_window(params, opt_state, xw, yw, mw, rng)
+            self.commit(self._fetch_sparse(codes, idxs, scale), index)
+            params = self._weights_to_params(self.pull())
+            return params, opt_state, loss
         before = self._params_to_weights(params)
         params, opt_state, loss = window_fn(
             params, opt_state, jnp.asarray(xw), jnp.asarray(yw),
@@ -712,6 +1038,7 @@ class AEASGDWorker(PSWorker):
     e = α·(x − x̃) against a freshly pulled center, subtracts it locally, and
     commits it (PS does x̃ += e). α = rho · learning_rate."""
     ALGORITHM = "aeasgd"
+    _TOPK_EF = False  # the spring force is stateful, not accumulative
 
     def __init__(self, *args, rho: float = 5.0, **kw):
         super().__init__(*args, **kw)
@@ -770,11 +1097,20 @@ def share_compiled_state(workers: List["Worker"]) -> None:
     head = workers[0]
     head._ensure_model()
     head._build_window_fn()
+    share_topk = (getattr(head, "_topk_density", None) is not None
+                  and getattr(head, "_DEVICE_TOPK", False))
+    if share_topk:
+        head._build_topk_window_fn()  # compile the top-k variant once too
     for w in workers[1:]:
         w._model = head._model
         w._params0 = head._params0
         w._tx = head._tx
         w._window_fn = head._window_fn
+        if share_topk:
+            w._topk_window_fn = head._topk_window_fn
+            w._wire_k = head._wire_k
+            w._wire_total = head._wire_total
+            w._wire_shapes = head._wire_shapes
 
 
 WORKER_CLASSES = {

@@ -32,6 +32,14 @@ def main():
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--batch-size", type=int, default=16)
     ap.add_argument("--window", type=int, default=5)
+    ap.add_argument("--execution", default="spmd",
+                    choices=["spmd", "host_ps", "process_ps"])
+    ap.add_argument("--wire", default=None,
+                    choices=["bfloat16", "int8", "topk"],
+                    help="commit compression on the PS engines "
+                         "(requires --execution host_ps/process_ps)")
+    ap.add_argument("--wire-topk", type=float, default=0.01,
+                    help="top-k density for --wire topk (docs/TUNING.md)")
     args = ap.parse_args()
 
     train, test = load_cifar10(n_train=args.rows, n_test=args.test_rows)
@@ -44,7 +52,8 @@ def main():
                        batch_size=args.batch_size, num_epoch=args.epochs,
                        communication_window=args.window,
                        label_col="label_encoded", worker_optimizer="adam",
-                       learning_rate=5e-4)
+                       learning_rate=5e-4, execution=args.execution,
+                       wire_dtype=args.wire, wire_topk=args.wire_topk)
     fitted = trainer.train(train, shuffle=True)
     print(f"time: {trainer.get_training_time():.2f}s  "
           f"final loss: {trainer.get_history()[-1]:.4f}")

@@ -48,17 +48,19 @@ def test_update_opcode_atomic_commit_plus_pull():
         server.stop()
 
 
-@pytest.mark.parametrize("wire_dtype", ["bfloat16", "int8"])
+@pytest.mark.parametrize("wire_dtype", ["bfloat16", "int8", "topk"])
 def test_update_opcode_wire_dtypes_roundtrip(wire_dtype):
-    """The compressed-commit paths (bf16 cast / int8 codes+scales) ride the
+    """The compressed-commit paths (bf16 cast / int8 codes+scales / sparse
+    top-k at density 1.0, where the selection is the whole delta) ride the
     'u' opcode: the PS decodes at the transport boundary, applies, and the
     reply center equals old center + the as-applied delta."""
     ps = DeltaParameterServer(_tiny_blob())
     server = SocketParameterServer(ps)
     server.start()
     try:
+        kw = ({"wire_topk": 1.0} if wire_dtype == "topk" else {})
         wk = DOWNPOURWorker(_tiny_blob(), "sgd", "mse", "127.0.0.1",
-                            server.port, wire_dtype=wire_dtype)
+                            server.port, wire_dtype=wire_dtype, **kw)
         wk.connect()
         center0 = [np.array(w) for w in wk.pull()]
         delta = [np.full(w.shape, 0.25, np.float32) for w in center0]
@@ -331,6 +333,25 @@ def test_host_ps_training_learns_overlap_complement(cls, overlap, kw):
     hist = t.get_history()
     assert len(hist) > 0
     assert np.mean(hist[-5:]) < np.mean(hist[:5])
+    preds = fitted.predict(ds["features"][:256])
+    acc = float(np.mean(np.argmax(preds, axis=1) == ds["label"][:256]))
+    assert acc > 0.6, acc
+
+
+def test_overlap_topk_wire_compression_learns_one_rtt():
+    """Overlap composes with sparse top-k compression: device-side selection
+    rides the same pipelined 'u' stream (exactly one round trip per window)
+    and the error-feedback rebase still learns."""
+    ds = make_dataset(n=1024)
+    t = ADAG(make_model(), num_workers=2, batch_size=32, num_epoch=2,
+             communication_window=4, label_col="label_encoded",
+             learning_rate=0.1, execution="host_ps", wire_dtype="topk",
+             wire_topk=0.1, comm_overlap=True)
+    with _OpcodeRecorder() as rec:
+        fitted = t.train(ds)
+    windows = 16  # 1024 rows / 2 workers, window*batch=128, 2 epochs
+    assert rec.count(b"u") == windows and rec.count(b"c") == 0
+    assert rec.count(b"p") == 2
     preds = fitted.predict(ds["features"][:256])
     acc = float(np.mean(np.argmax(preds, axis=1) == ds["label"][:256]))
     assert acc > 0.6, acc

@@ -212,6 +212,55 @@ def test_ps_shards_int8_wire_bit_identical():
         assert np.array_equal(a, b)
 
 
+def test_split_sparse_bisection_matches_dense_scatter():
+    """A flat sparse commit split by index bisection lands every coordinate
+    on its owning shard in slice-local coordinates: applying each shard's
+    split to its center slices and gathering equals the dense scatter —
+    across tensor boundaries, row-split tensors, and 0-d scalars."""
+    from distkeras_tpu.parameter_servers import _scatter_add
+
+    shapes = [(64, 8), (16, 32), (32,), (32, 4), (4,), ()]
+    plan = make_shard_plan(shapes, [np.float32] * len(shapes), 3)
+    total = sum(int(np.prod(s)) for s in shapes)
+    assert plan.flat_elements() == total
+    assert sum(plan.shard_elements()) == total
+    rng = np.random.default_rng(7)
+    idx = np.sort(rng.choice(total, 101, replace=False)).astype(np.int32)
+    vals = rng.standard_normal(101).astype(np.float32)
+    parts = plan.split_sparse(idx, vals)
+    owner = plan.shard_of_flat(idx)
+    assert all((owner == j).sum() == len(parts[j][0]) for j in range(3))
+    shard_centers = [[np.array(a, copy=True) for a in sl]
+                     for sl in plan.scatter([np.zeros(s, np.float32)
+                                             for s in shapes])]
+    for j, (li, lv) in enumerate(parts):
+        assert np.all(np.diff(li) > 0)  # stays sorted per shard
+        _scatter_add(shard_centers[j],
+                     networking.SparseDelta(li, lv,
+                                            plan.shard_elements()[j]), 1.0)
+    gathered = plan.gather(shard_centers)
+    dense = np.zeros(total, np.float32)
+    dense[idx] = vals
+    flat = np.concatenate([g.reshape(-1) for g in gathered])
+    np.testing.assert_array_equal(flat, dense)
+    # out-of-range indices are rejected, not mis-binned
+    with pytest.raises(ValueError, match="range"):
+        plan.split_sparse(np.array([total], np.int64),
+                          np.array([1.0], np.float32))
+
+
+def test_ps_shards_topk_wire_bit_identical():
+    """Top-k selection runs on the FULL flat delta before the scatter (one
+    selection, one value scale), so — as with int8 — a single-worker
+    sharded run is bit-identical to the single-PS run."""
+    kw = dict(wire_dtype="topk", wire_topk=0.05)
+    ref, _ = _train_weights(**kw)
+    sh, t = _train_weights(ps_shards=3, **kw)
+    for a, b in zip(ref, sh):
+        assert np.array_equal(a, b)
+    assert t._ps_workers[0]._shard_client is not None
+
+
 def test_ps_shards_4_adag_converges_one_rtt_per_window_per_shard():
     """ACCEPTANCE: a ps_shards=4 ADAG run clears the same convergence bar
     as tests/test_trainers.py, and the opcode stream shows exactly one 'u'

@@ -170,6 +170,106 @@ def test_rejects_corrupt_frames_either_codec(codec):
         networking.decode_message(blob[:len(blob) - 3])  # truncated
 
 
+SPARSE_MESSAGE = {
+    "delta": networking.SparseDelta(
+        np.array([0, 3, 7, 12], np.int32),
+        np.array([0.5, -1.25, 2.0, -3.5], np.float32), 20),
+    "coded": networking.SparseDelta(
+        np.array([1, 2], np.int32), np.array([10, -20], np.int8), 6,
+        scale=0.25),
+    "worker_id": 1,
+    "clock": 4,
+}
+
+
+def test_sparse_node_roundtrip_either_codec(codec):
+    """The sparse payload node (indices + values + dense length, optional
+    value scale) survives both codec implementations bit for bit."""
+    out = networking.decode_message(networking.encode_message(SPARSE_MESSAGE))
+    sp = out["delta"]
+    assert isinstance(sp, networking.SparseDelta)
+    np.testing.assert_array_equal(sp.indices,
+                                  SPARSE_MESSAGE["delta"].indices)
+    np.testing.assert_array_equal(sp.values, SPARSE_MESSAGE["delta"].values)
+    assert sp.length == 20 and sp.scale is None
+    coded = out["coded"]
+    assert coded.values.dtype == np.int8 and coded.scale == 0.25
+    np.testing.assert_allclose(coded.f32_values(), [2.5, -5.0])
+
+
+def test_sparse_node_pooled_recv_either_codec(codec):
+    """A sparse commit received through the zero-copy pooled path decodes to
+    views over the pool; .decoded() detaches them for use past the next
+    receive."""
+    pool = networking.BufferPool()
+    a, b = socket.socketpair()
+    try:
+        for _ in range(2):
+            t = threading.Thread(target=networking.send_data,
+                                 args=(a, SPARSE_MESSAGE))
+            t.start()
+            out = networking.recv_data(b, pool=pool)
+            t.join()
+            sp = out["delta"]
+            np.testing.assert_array_equal(
+                sp.indices, SPARSE_MESSAGE["delta"].indices)
+            assert not sp.values.flags["OWNDATA"]  # view into the pool
+            detached = sp.decoded()
+            assert detached.values.flags["OWNDATA"]
+        assert pool.misses == 1 and pool.hits == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_encode_pool_bytes_identical_either_codec(codec):
+    """The encode-side scratch pool (send-path satellite) produces byte-
+    identical frames to the plain encoder, and reuses its buffer."""
+    pool = networking.BufferPool()
+    for msg in (MESSAGE, SPARSE_MESSAGE):
+        plain = networking.encode_message(msg)
+        assert bytes(networking.encode_message_into(msg, pool)) == plain
+        assert bytes(networking.encode_message_into(msg, pool)) == plain
+    assert pool.hits == 2  # one reuse per message size
+
+
+def test_sparse_dense_equivalence_fuzz(codec):
+    """Randomized dense↔sparse equivalence (fixed seed): for random tensor
+    lists, densities, and value codings, selecting with topk_select,
+    shipping through the codec, and scatter-adding on the far side equals
+    the dense apply of the densified delta — and the EF invariant
+    eff == applied + residual holds to coding precision."""
+    from distkeras_tpu.parameter_servers import _scatter_add
+    from distkeras_tpu.workers import topk_select
+
+    rng = np.random.default_rng(1234)
+    for trial in range(10):
+        nt = rng.integers(1, 5)
+        shapes = [tuple(rng.integers(1, 9, rng.integers(0, 3)))
+                  for _ in range(nt)]
+        total = sum(int(np.prod(s)) for s in shapes)
+        eff = (rng.standard_normal(total) * 10.0 ** rng.integers(-3, 2)
+               ).astype(np.float32)
+        k = int(rng.integers(1, total + 1))
+        code = [None, "bfloat16", "int8"][trial % 3]
+        idx, wire, applied, scale, res = topk_select(eff, k, code)
+        dense = np.zeros(total, np.float32)
+        dense[idx] = applied
+        np.testing.assert_allclose(eff, dense + res, atol=1e-6)
+        sp = networking.decode_message(networking.encode_message(
+            {"d": networking.SparseDelta(idx, wire, total, scale)}))["d"]
+        center = [rng.standard_normal(s).astype(np.float32) for s in shapes]
+        expect = [c.copy() for c in center]
+        scale_f = float(rng.uniform(0.25, 2.0))
+        _scatter_add(center, sp, scale_f)
+        off = 0
+        for c in expect:
+            c += scale_f * dense[off:off + c.size].reshape(c.shape)
+            off += c.size
+        for got, want in zip(center, expect):
+            np.testing.assert_allclose(got, want, atol=1e-5)
+
+
 def test_native_rejects_u64_overflow_lengths(native):
     """Hostile u64 lengths that would wrap `off + blen` must terminate with
     'Truncated', not loop or return empty buffers."""
