@@ -300,6 +300,60 @@ def host_ps_recovery_bench(budget_s: float = 60.0):
     return {"host_ps_recovery_ms": ms}
 
 
+def host_ps_worker_recovery_bench(budget_s: float = 90.0):
+    """Elastic-worker recovery latency (resilience.WorkerSupervisor): a
+    small elastic ADAG run where one worker dies ('exit' fault) mid-epoch;
+    the measured number is the supervisor's death-detection → replacement
+    respawn latency (``respawn_records[0]["recovery_ms"]``) — the worker
+    twin of ``host_ps_recovery_ms``.  Returns
+    ``{"host_ps_worker_recovery_ms": float|None}`` — None on
+    overrun/failure, never fatal to the north-star artifact.
+    """
+    from distkeras_tpu import ADAG
+
+    ds, model, n = _host_ps_fixture()
+    t = ADAG(model, num_workers=1, parallelism_factor=2, batch_size=32,
+             num_epoch=1, communication_window=4, learning_rate=0.05,
+             execution="host_ps", elastic=True, lease_timeout=2.0,
+             fault_injection={0: ("exit", 2)})
+    t0 = time.perf_counter()
+    t.train(ds)
+    if time.perf_counter() - t0 > budget_s:
+        return {"host_ps_worker_recovery_ms": None}
+    recs = t.elastic_stats.get("respawn_records") or []
+    ms = next((r["recovery_ms"] for r in recs
+               if r.get("recovery_ms") is not None), None)
+    return {"host_ps_worker_recovery_ms": ms}
+
+
+def host_ps_straggler_bench(budget_s: float = 120.0):
+    """Straggler-mitigation overhead: the same small elastic ADAG run with
+    no faults vs with one worker wedged mid-epoch ('hang' fault — its
+    leases are stolen by the survivor).  Reported as the chaos/clean
+    wall-clock ratio: how much one hung worker costs an epoch when lease
+    stealing is doing its job (bounded by roughly one lease deadline plus
+    the stolen leases' retraining, instead of a full hang).  Returns
+    ``{"host_ps_straggler_overhead": float|None}``.
+    """
+    from distkeras_tpu import ADAG
+
+    ds, model, n = _host_ps_fixture()
+    times = {}
+    t_start = time.perf_counter()
+    for label, faults in (("clean", None), ("chaos", {0: ("hang", 2)})):
+        t = ADAG(model, num_workers=1, parallelism_factor=2, batch_size=32,
+                 num_epoch=1, communication_window=4, learning_rate=0.05,
+                 execution="host_ps", elastic=True, lease_timeout=1.0,
+                 fault_injection=faults)
+        t0 = time.perf_counter()
+        t.train(ds)
+        times[label] = time.perf_counter() - t0
+        if time.perf_counter() - t_start > budget_s:
+            return {"host_ps_straggler_overhead": None}
+    return {"host_ps_straggler_overhead":
+            round(times["chaos"] / max(times["clean"], 1e-9), 2)}
+
+
 def main():
     t_start = time.perf_counter()
     debug = os.environ.get("DISTKERAS_BENCH_DEBUG", "") == "1"
@@ -519,6 +573,22 @@ def main():
             print(f"[bench] host_ps recovery bench failed: {e}",
                   file=sys.stderr)
     result.update(recovery_fields)
+    # elastic-worker observables (resilience.py): death→respawn latency and
+    # the wall-clock cost of one hung worker under lease stealing
+    stage("host_ps worker recovery + straggler")
+    elastic_fields = {"host_ps_worker_recovery_ms": None,
+                      "host_ps_straggler_overhead": None}
+    elastic_remaining = budget - (time.perf_counter() - t_start)
+    if elastic_remaining > 60:
+        try:
+            elastic_fields.update(host_ps_worker_recovery_bench(
+                budget_s=elastic_remaining))
+            elastic_fields.update(host_ps_straggler_bench(
+                budget_s=budget - (time.perf_counter() - t_start)))
+        except Exception as e:
+            print(f"[bench] host_ps elastic bench failed: {e}",
+                  file=sys.stderr)
+    result.update(elastic_fields)
     if real_platform == "cpu":
         # CPU fallback: carry the hardware signal instead of erasing it
         result["probe_history"] = probe_history

@@ -30,7 +30,8 @@ from . import ps_sharding
 from . import parameter_servers
 from . import resilience
 from .ps_sharding import PSShardDown
-from .resilience import RetryPolicy, ShardSupervisor
+from .resilience import (LeaseLedger, RetryPolicy, ShardSupervisor,
+                         WorkerSupervisor)
 from .networking import ChaosFault, ChaosProxy
 from . import job_deployment
 from . import checkpoint

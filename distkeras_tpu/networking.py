@@ -554,6 +554,11 @@ class ChaosFault(NamedTuple):
     - ``"tear"``   — forward the opcode plus roughly half of its payload
       frame, then RST (a torn frame at the server, a reset at the worker);
     - ``"delay"``  — sleep ``arg`` seconds before forwarding (stall);
+    - ``"stall"``  — stop relaying this connection entirely while holding
+      it OPEN (no forward, no reply, no reset) until the proxy stops: the
+      worker wedges inside its recv — the deterministic stand-in for a
+      hung worker/host, so wedged-worker detection is testable without
+      real timeouts;
     - ``"dup_reply"`` — relay the request and its reply, then send the
       reply a second time (a duplicated in-flight reply);
     - ``"call"``   — invoke ``arg()`` before forwarding (the deterministic
@@ -596,6 +601,7 @@ class ChaosProxy:
         self.connections = 0
         self._lock = threading.Lock()
         self._running = True
+        self._stall = threading.Event()  # released by stop(): frees 'stall'
         self._pairs: List[tuple] = []  # live (client, upstream) socket pairs
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -619,6 +625,7 @@ class ChaosProxy:
 
     def stop(self):
         self._running = False
+        self._stall.set()  # unblock connections wedged on a 'stall' fault
         try:
             self._server.close()
         except OSError:
@@ -683,6 +690,12 @@ class ChaosProxy:
                     self.injected.append((idx, op_index - 1, fault.action))
                     if fault.action == "delay":
                         time.sleep(float(fault.arg or 0.05))
+                    elif fault.action == "stall":
+                        # hold the connection open but relay nothing more:
+                        # the worker wedges in its recv until the proxy
+                        # stops (the finally then RSTs both sides)
+                        self._stall.wait()
+                        return
                     elif fault.action == "call":
                         fault.arg()
                     elif fault.action == "reset":

@@ -295,6 +295,15 @@ class SocketParameterServer:
                     except OSError:
                         pass
 
+    @property
+    def live_connections(self) -> int:
+        """Connections with a live handler thread — the bookkeeping a
+        half-frame worker death must decrement (a dying worker's torn
+        commit drops its connection silently: no codec error escapes the
+        handler, no `_conns` entry leaks; tests/test_elastic_workers.py)."""
+        with self._conn_lock:
+            return len(self._conns)
+
     def crash(self):
         """Abrupt-death simulation (chaos/bench hook): close the listener
         and every connection with no graceful shutdown, no joins, no final
@@ -485,10 +494,26 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
             "path checkpoints at epoch waves")
     if resume and trainer.checkpoint_dir is None:
         raise ValueError("train(resume=True) needs checkpoint_dir")
+    elastic = bool(getattr(trainer, "elastic", False))
+    from .workers import parse_fault_injection
+    fault_kinds = parse_fault_injection(getattr(trainer, "fault_injection",
+                                                None))
+    if elastic and (resume or trainer.checkpoint_dir is not None):
+        raise ValueError(
+            "elastic=True owns its own lease-based epoch loop and does not "
+            "compose with checkpoint/resume yet — use elastic=False for "
+            "checkpointed host_ps runs")
+    if not elastic and any(k == "hang" for k, _ in fault_kinds.values()):
+        raise ValueError(
+            "fault_injection kind 'hang' wedges a worker until teardown; "
+            "without elastic=True nothing ever revokes its work and the "
+            "epoch join would deadlock — use elastic=True (or kinds "
+            "'raise'/'exit')")
 
     trainer.record_training_start()
     trainer.failed_workers = []
     trainer.worker_failures = {}
+    trainer.elastic_stats = {}
     x = np.asarray(dataset[trainer.features_col])
     y = np.asarray(dataset[trainer.label_col])
     if shuffle:
@@ -562,6 +587,25 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     if recovery:
         kw.update(recovery=True,
                   retry_policy=getattr(trainer, "recovery_policy", None))
+
+    if elastic:
+        # elastic workers (resilience.py): lease-based shard redistribution,
+        # death-respawn, and straggler stealing replace the static
+        # round-robin deal + epoch-wave joins below
+        try:
+            workers = _run_elastic_host_ps(trainer, x, y, n, worker_cls,
+                                           blob, kw)
+        finally:
+            if supervisor is not None:
+                supervisor.stop()
+            server.stop()
+        trainer.history.clear()
+        for w in workers:
+            trainer.history.extend(w.history)
+        fitted = server.get_model()
+        trainer._fitted = fitted
+        trainer.record_training_stop()
+        return fitted
 
     workers = [worker_cls(blob, **kw) for _ in range(n)]
     share_compiled_state(workers)  # compile the window program once, not N×
@@ -679,7 +723,13 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
                 if shard_err is not None:
                     raise shard_err
                 if not getattr(trainer, "fault_tolerance", False):
-                    raise errors[0][1]
+                    err = errors[0][1]
+                    if isinstance(err, SystemExit):
+                        # an 'exit'-faulted worker thread must surface as a
+                        # training error, not exit the driver process
+                        raise RuntimeError(
+                            f"worker {errors[0][0]} exited: {err}") from err
+                    raise err
                 # degraded completion (SURVEY §5 fault table: reference
                 # relied on Spark retry; we continue with survivors — the
                 # center keeps every commit applied before the death).  A
@@ -725,6 +775,93 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     trainer._fitted = fitted
     trainer.record_training_stop()
     return fitted
+
+
+def _run_elastic_host_ps(trainer, x, y, n: int, worker_cls, blob: dict,
+                         kw: dict):
+    """The elastic worker engine (``elastic=True`` — resilience.py).
+
+    Replaces the static round-robin shard deal with a per-epoch
+    ``LeaseLedger``: the epoch's rows are globally shuffled (deterministic
+    in seed+epoch) and tiled into window-aligned leases that the worker
+    threads acquire/renew/complete; a ``WorkerSupervisor`` revokes the
+    leases of dead or wedged workers (survivors steal them) and respawns
+    replacements under fresh ids from a live center pull.  After every
+    epoch the ledger's exactly-once contract is asserted: killing k of N
+    workers mid-epoch loses zero training examples.
+
+    Returns the full worker list (original ids + respawns, id order) for
+    history collection; resilience observability lands on the trainer as
+    ``elastic_stats`` / ``failed_workers`` / ``worker_failures`` and
+    ``_worker_supervisor``.
+    """
+    from .resilience import LeaseLedger, WorkerSupervisor
+
+    win_rows = trainer.communication_window * trainer.batch_size
+    total_windows = -(-len(x) // win_rows)
+    lease_windows = getattr(trainer, "lease_windows", None)
+    if lease_windows is None:
+        # ~4 leases per worker per epoch: enough granularity for stealing
+        # and respawn pickup without drowning in ledger round trips
+        lease_windows = max(1, total_windows // (4 * n))
+    head = worker_cls(blob, **kw)
+    # compile the shared window program before the ledger clock starts (the
+    # first lease's deadline must not pay the jit compile) and seed the
+    # cold-start window estimate with the measured time: × n because the
+    # real windows run under n-way thread contention.  The estimate is
+    # generous by construction; each worker's EWMA tightens it from its
+    # first renewal on.
+    t_window = head.compile_windows(x, y)
+    ledger = LeaseLedger(len(x), win_rows, lease_windows,
+                         min_deadline=getattr(trainer, "lease_timeout", 5.0),
+                         default_window_s=t_window * n)
+
+    def factory(wid: int):
+        w = head if wid == 0 else worker_cls(blob, **kw)
+        share_compiled_state([head, w])  # one window program for everyone
+        return w
+
+    epoch_data: Dict[str, np.ndarray] = {}
+
+    def run_fn(wid: int, worker):
+        xe, ye = epoch_data["x"], epoch_data["y"]
+
+        def data_fn(lease):
+            return xe[lease.start:lease.stop], ye[lease.start:lease.stop]
+
+        res = worker.train_leases(wid, ledger, data_fn,
+                                  initial_state=sup.states.get(wid))
+        sup.states[wid] = res["state"]
+        return res
+
+    sup = WorkerSupervisor(ledger, factory, run_fn, n)
+    trainer._worker_supervisor = sup  # observability (tests/bench)
+    epoch_reports = {}
+    try:
+        for epoch in range(trainer.num_epoch):
+            # global per-epoch shuffle: leases are contiguous row ranges of
+            # this permutation, so lease boundaries resample every epoch
+            perm = np.random.default_rng(
+                trainer.seed + 7919 * epoch).permutation(len(x))
+            epoch_data["x"], epoch_data["y"] = x[perm], y[perm]
+            sup.run_epoch(epoch)
+            # the zero-data-loss contract, asserted per epoch
+            epoch_reports[epoch] = ledger.assert_epoch_complete(epoch)
+    finally:
+        sup.shutdown()  # release 'hang'-faulted threads, join stragglers
+        trainer.failed_workers = sorted(sup.failures)
+        trainer.worker_failures = dict(sup.failures)
+        trainer.elastic_stats = {
+            "respawns": sup.respawns,
+            "respawn_records": list(sup.respawn_records),
+            "leases_reassigned": ledger.reassigned,
+            "windows_per_worker": dict(ledger.windows_by_worker),
+            "lease_completions": epoch_reports,
+            "events": list(sup.events),
+        }
+        workers = [sup.workers[wid] for wid in sorted(sup.workers)]
+        trainer._ps_workers = workers
+    return workers
 
 
 def _worker_kwargs(trainer, n: int, rows: int) -> dict:
@@ -799,6 +936,13 @@ def run_process_ps_training(trainer, dataset, shuffle: bool = False
         raise ValueError(
             "checkpoint/resume is not supported on execution='process_ps' "
             "(use 'host_ps' for epoch-wave checkpoints)")
+    from .workers import parse_fault_injection
+    if any(k == "hang" for k, _ in parse_fault_injection(
+            getattr(trainer, "fault_injection", None)).values()):
+        raise ValueError(
+            "fault_injection kind 'hang' wedges a worker process forever; "
+            "the process engine has no lease ledger to revoke its work — "
+            "use execution='host_ps' with elastic=True")
 
     trainer.record_training_start()
     trainer.failed_workers = []

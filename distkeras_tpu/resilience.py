@@ -39,6 +39,31 @@ Worker-side reconnect-resume lives in ``workers.PSWorker`` /
 ``ps_sharding.ShardedPSClient`` (re-dial under a ``RetryPolicy``, re-sync
 with a pull, generation handshake); the deterministic network
 fault-injection proxy lives in ``networking.ChaosProxy``.
+
+Elastic workers (the worker-side twin of the above, ``elastic=True`` on the
+async host-PS trainers):
+
+ - ``LeaseLedger`` — each epoch's data is partitioned into window-aligned
+   **leases** that workers acquire, renew (one heartbeat per committed
+   window, piggybacked on the commit cadence — no extra RPC), and complete.
+   A lease whose deadline expires (holder died or wedged) is revoked back to
+   the pool for a surviving worker to steal; completion is recorded exactly
+   once per lease per epoch, which is the zero-data-loss contract: killing
+   k of N workers mid-epoch drops no training examples, because their
+   unfinished leases are retrained by someone else.  Deadlines come from a
+   per-worker window-rate EWMA × a slack factor (floored by
+   ``min_deadline``), so straggler detection follows each worker's own
+   measured pace instead of a global constant.
+ - ``WorkerSupervisor`` — drives the elastic worker threads over the
+   ledger: detects death (thread exception / SystemExit) and wedging (an
+   expired lease whose holder thread is still alive), revokes the
+   casualty's leases, and **respawns** a replacement worker under a fresh
+   id (membership is elastic — the replacement re-pulls the center and
+   resumes within the same bounded-staleness class the async rules already
+   tolerate).  Observability: ``respawns``, ``respawn_records`` (with
+   recovery latency, the ``host_ps_worker_recovery_ms`` bench observable),
+   ``failures`` (tracebacks), and the ledger's reassignment/coverage
+   counters, all surfaced on the trainer as ``elastic_stats``.
 """
 
 from __future__ import annotations
@@ -52,7 +77,8 @@ import socket
 import tempfile
 import threading
 import time
-from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+from typing import (Any, Callable, Dict, Iterator, List, NamedTuple,
+                    Optional, Tuple)
 
 import numpy as np
 
@@ -415,3 +441,429 @@ class ShardSupervisor:
                         self.snapshot_shard(j)
                     except Exception:
                         logger.exception("snapshot of PS shard %d failed", j)
+
+
+# ---------------------------------------------------------------------------
+# elastic workers: the lease ledger
+# ---------------------------------------------------------------------------
+
+class Lease(NamedTuple):
+    """One window-aligned slice of an epoch's (already shuffled) row range.
+
+    ``[start, stop)`` indexes the epoch's shuffled arrays; ``windows`` is the
+    number of communication windows the slice shapes into (the tail window is
+    wrap-padded and masked by the worker's shaping, the same zero-drop
+    contract as the static shards)."""
+
+    lease_id: int
+    epoch: int
+    start: int
+    stop: int
+    windows: int
+
+
+class LeaseLedger:
+    """Exactly-once lease accounting for elastic workers (one per run).
+
+    Per epoch, ``begin_epoch`` tiles the row range into leases of
+    ``lease_windows`` communication windows each (``rows_per_window`` rows
+    per window; the last lease takes the remainder).  Workers ``acquire`` a
+    lease, ``renew`` it once per committed window (the heartbeat — it rides
+    the commit cadence, no extra RPC), and ``complete`` it; a lease whose
+    deadline passes without a renewal is revoked back to the pool by
+    ``revoke_expired`` for another worker to steal, and ``revoke_worker``
+    returns a dead worker's holdings.
+
+    **Exactly-once**: a lease transitions ``held → done`` at most once, and
+    a ``renew``/``complete`` from a worker the lease was revoked from
+    returns ``False`` (the straggler abandons; the stealer's completion is
+    the one recorded).  ``assert_epoch_complete`` is the zero-data-loss
+    check: every lease of the epoch completed by exactly one worker, rows
+    summing to the full dataset.
+
+    **Deadlines** adapt per worker: each renewal feeds a per-worker
+    window-rate EWMA; a lease's deadline is ``slack`` × the holder's
+    expected time for its remaining windows (cross-worker mean for workers
+    with no history yet), floored by ``min_deadline`` — so a wedged worker
+    is detected on its own measured pace, while a merely-slow worker keeps
+    renewing and is never falsely revoked.
+
+    All methods are thread-safe under one internal lock; ``clock`` is
+    injectable for deterministic tests.
+    """
+
+    def __init__(self, num_rows: int, rows_per_window: int,
+                 lease_windows: int = 1, min_deadline: float = 5.0,
+                 slack: float = 4.0,
+                 default_window_s: Optional[float] = None,
+                 clock=time.monotonic):
+        self.num_rows = int(num_rows)
+        self.rows_per_window = max(int(rows_per_window), 1)
+        self.lease_windows = max(int(lease_windows), 1)
+        self.min_deadline = float(min_deadline)
+        self.slack = float(slack)
+        #: per-window seconds to assume before ANY renewal exists (cold
+        #: start): the driver seeds it with the measured warmup window
+        #: (deliberately generous — it includes the compile — times the
+        #: worker count for contention); None falls back to min_deadline
+        self.default_window_s = (None if default_window_s is None
+                                 else float(default_window_s))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.epoch: Optional[int] = None
+        self.leases: List[Lease] = []
+        self._state: Dict[int, Dict[str, Any]] = {}
+        #: per-worker windows/sec EWMA (the straggler-detection baseline)
+        self.rates: Dict[int, float] = {}
+        self._last_beat: Dict[int, float] = {}
+        #: epoch -> {lease_id: completing worker id} (exactly-once record)
+        self.completions: Dict[int, Dict[int, int]] = {}
+        #: leases revoked (expiry or holder death) and returned to the pool
+        self.reassigned = 0
+        #: windows completed per worker id, across epochs (diagnosability)
+        self.windows_by_worker: Dict[int, int] = {}
+
+    # -- epoch lifecycle -----------------------------------------------------
+    def begin_epoch(self, epoch: int) -> List[Lease]:
+        """(Re)tile the row range into pending leases for ``epoch``."""
+        with self._lock:
+            self.epoch = int(epoch)
+            rows_per_lease = self.rows_per_window * self.lease_windows
+            self.leases = []
+            self._state = {}
+            start, lid = 0, 0
+            while start < self.num_rows:
+                stop = min(start + rows_per_lease, self.num_rows)
+                wins = -(-(stop - start) // self.rows_per_window)
+                self.leases.append(Lease(lid, self.epoch, start, stop, wins))
+                self._state[lid] = {"status": "pending", "holder": None,
+                                    "deadline": None, "done": 0}
+                lid += 1
+                start = stop
+            self.completions.setdefault(self.epoch, {})
+            return list(self.leases)
+
+    def epoch_done(self) -> bool:
+        with self._lock:
+            return all(st["status"] == "done" for st in self._state.values())
+
+    def pending(self) -> int:
+        """Leases not yet done (pending or held)."""
+        with self._lock:
+            return sum(1 for st in self._state.values()
+                       if st["status"] != "done")
+
+    # -- deadline math (lock held) -------------------------------------------
+    def _per_window_locked(self, worker: int) -> Optional[float]:
+        rate = self.rates.get(worker)
+        if rate is None and self.rates:
+            rate = sum(self.rates.values()) / len(self.rates)
+        if rate:
+            return 1.0 / rate
+        return self.default_window_s  # cold start: the warmup-seeded guess
+
+    def _deadline_locked(self, worker: int, windows_left: int,
+                         now: float) -> float:
+        per = self._per_window_locked(worker)
+        if per is None:
+            return now + self.min_deadline
+        return now + max(self.min_deadline,
+                         self.slack * per * max(int(windows_left), 1))
+
+    # -- the worker-facing protocol ------------------------------------------
+    def acquire(self, worker: int) -> Optional[Lease]:
+        """Claim the lowest-id pending lease, or None when nothing is left
+        to hand out (held leases may still revert via revocation)."""
+        worker = int(worker)
+        now = self._clock()
+        with self._lock:
+            for lease in self.leases:
+                st = self._state[lease.lease_id]
+                if st["status"] == "pending":
+                    st.update(status="held", holder=worker, done=0,
+                              deadline=self._deadline_locked(
+                                  worker, lease.windows, now))
+                    self._last_beat[worker] = now
+                    return lease
+        return None
+
+    def renew(self, lease_id: int, worker: int) -> bool:
+        """One completed window's heartbeat.  False means the lease was
+        revoked from this worker (stolen) — abandon the rest of it."""
+        worker = int(worker)
+        now = self._clock()
+        with self._lock:
+            st = self._state.get(int(lease_id))
+            if st is None or st["status"] != "held" \
+                    or st["holder"] != worker:
+                return False
+            lb = self._last_beat.get(worker)
+            if lb is not None and now > lb:
+                inst = 1.0 / max(now - lb, 1e-9)
+                old = self.rates.get(worker)
+                self.rates[worker] = (inst if old is None
+                                      else 0.5 * old + 0.5 * inst)
+            self._last_beat[worker] = now
+            st["done"] += 1
+            self.windows_by_worker[worker] = (
+                self.windows_by_worker.get(worker, 0) + 1)
+            lease = self.leases[int(lease_id)]
+            st["deadline"] = self._deadline_locked(
+                worker, lease.windows - st["done"], now)
+            return True
+
+    def complete(self, lease_id: int, worker: int) -> bool:
+        """Mark a lease done.  Recorded at most once per lease per epoch;
+        False if the lease was revoked from this worker meanwhile."""
+        worker = int(worker)
+        with self._lock:
+            st = self._state.get(int(lease_id))
+            if st is None or st["status"] != "held" \
+                    or st["holder"] != worker:
+                return False
+            st.update(status="done", deadline=None)
+            self.completions[self.epoch][int(lease_id)] = worker
+            return True
+
+    # -- the supervisor-facing protocol --------------------------------------
+    def revoke_expired(self) -> List[Tuple[Lease, int]]:
+        """Return held leases past their deadline to the pool; yields
+        ``(lease, former holder)`` per revocation."""
+        now = self._clock()
+        out: List[Tuple[Lease, int]] = []
+        with self._lock:
+            for lease in self.leases:
+                st = self._state[lease.lease_id]
+                if (st["status"] == "held" and st["deadline"] is not None
+                        and now > st["deadline"]):
+                    out.append((lease, st["holder"]))
+                    st.update(status="pending", holder=None, deadline=None,
+                              done=0)
+                    self.reassigned += 1
+        return out
+
+    def revoke_worker(self, worker: int) -> int:
+        """Return every lease a (dead) worker holds to the pool."""
+        worker = int(worker)
+        n = 0
+        with self._lock:
+            for st in self._state.values():
+                if st["status"] == "held" and st["holder"] == worker:
+                    st.update(status="pending", holder=None, deadline=None,
+                              done=0)
+                    self.reassigned += 1
+                    n += 1
+        return n
+
+    # -- the contract --------------------------------------------------------
+    def epoch_report(self, epoch: int) -> Dict[str, Any]:
+        with self._lock:
+            done = dict(self.completions.get(int(epoch), {}))
+            leases = [l for l in self.leases if l.epoch == int(epoch)]
+            rows = sum(l.stop - l.start for l in leases
+                       if l.lease_id in done)
+            return {"leases": len(leases), "completed": len(done),
+                    "rows_completed": rows, "by_worker": done}
+
+    def assert_epoch_complete(self, epoch: int) -> Dict[str, Any]:
+        """The zero-data-loss contract: every lease of ``epoch`` completed
+        exactly once (``completions`` is keyed by lease id, so at-most-once
+        holds by construction; this checks at-least-once and row coverage).
+        """
+        rep = self.epoch_report(epoch)
+        if rep["completed"] != rep["leases"] \
+                or rep["rows_completed"] != self.num_rows:
+            missing = [l.lease_id for l in self.leases
+                       if l.lease_id not in rep["by_worker"]]
+            raise RuntimeError(
+                f"epoch {epoch} lease ledger incomplete: "
+                f"{rep['completed']}/{rep['leases']} leases done, "
+                f"{rep['rows_completed']}/{self.num_rows} rows covered "
+                f"(missing leases {missing})")
+        return rep
+
+
+# ---------------------------------------------------------------------------
+# elastic workers: the supervisor
+# ---------------------------------------------------------------------------
+
+class WorkerSupervisor:
+    """Detect-and-respawn loop over elastic worker threads.
+
+    ``factory(worker_id)`` builds a worker object; ``run_fn(worker_id,
+    worker)`` runs its lease loop (``workers.PSWorker.train_leases``) and
+    returns its result dict.  Per epoch the supervisor starts one thread per
+    active worker id and polls until the ledger's epoch is done:
+
+     - a thread that raised (``RuntimeError`` from an injected fault, a
+       transport error, ``SystemExit`` from an 'exit' fault — any
+       ``BaseException``) is a **death**: its leases are revoked and a
+       replacement worker is spawned under a fresh id (``max_respawns``
+       bounds the total).  ``PSShardDown`` and ``KeyboardInterrupt`` are
+       not worker deaths and re-raise.
+     - a lease that expires while its holder thread is still alive is a
+       **wedge** (hung device, stuck commit): the lease returns to the pool
+       (stolen by survivors), the holder is declared failed, and a
+       replacement is spawned.  The wedged thread itself is left to unblock
+       on teardown (``release_hung``).
+     - if every active thread has finished but leases remain (e.g. all
+       still-pending work was revoked after the pool drained), a finished
+       worker is restarted — the epoch always converges or fails loudly.
+
+    Respawned workers start from a fresh center pull (state ``None``), the
+    same bounded-staleness class as any late-joining async worker.
+    """
+
+    def __init__(self, ledger: LeaseLedger, factory, run_fn,
+                 num_workers: int, poll_interval: float = 0.02,
+                 max_respawns: Optional[int] = None,
+                 join_timeout: float = 10.0):
+        self.ledger = ledger
+        self.factory = factory
+        self.run_fn = run_fn
+        self.num_workers = int(num_workers)
+        self.poll_interval = float(poll_interval)
+        self.max_respawns = (2 * self.num_workers if max_respawns is None
+                             else int(max_respawns))
+        self.join_timeout = float(join_timeout)
+        self._lock = threading.Lock()
+        self.workers: Dict[int, Any] = {}
+        self.states: Dict[int, Any] = {}  # worker id -> carried train state
+        self._threads: Dict[int, threading.Thread] = {}
+        self.active: set = set()
+        self.results: Dict[int, Any] = {}
+        self.errors: Dict[int, BaseException] = {}
+        self.failures: Dict[int, str] = {}  # worker id -> traceback / note
+        self.death_times: Dict[int, float] = {}
+        self._next_id = self.num_workers
+        self.respawns = 0
+        #: one dict per respawn: died, replacement, reason, recovery_ms
+        self.respawn_records: List[Dict[str, Any]] = []
+        #: resilience event log (revocations, deaths, respawns) for metrics
+        self.events: List[Dict[str, Any]] = []
+        for wid in range(self.num_workers):
+            self.workers[wid] = factory(wid)
+            self.active.add(wid)
+
+    # -- threads -------------------------------------------------------------
+    def _thread_main(self, wid: int):
+        try:
+            res = self.run_fn(wid, self.workers[wid])
+            with self._lock:
+                self.results[wid] = res
+        except BaseException as e:  # SystemExit ('exit' faults) included
+            import traceback
+            with self._lock:
+                self.errors.setdefault(wid, e)
+                # first cause wins: a wedge-declared worker's eventual
+                # unwind (e.g. a released 'hang') must not overwrite the
+                # supervisor's diagnosis
+                self.failures.setdefault(wid, "".join(
+                    traceback.format_exception(e)).strip())
+                self.death_times.setdefault(wid, time.monotonic())
+            self.ledger.revoke_worker(wid)
+
+    def _start(self, wid: int):
+        t = threading.Thread(target=self._thread_main, args=(wid,),
+                             daemon=True, name=f"dkt-elastic-{wid}")
+        self._threads[wid] = t
+        t.start()
+
+    def _alive(self, wid: int) -> bool:
+        t = self._threads.get(wid)
+        return t is not None and t.is_alive()
+
+    def _respawn(self, died: int, reason: str) -> Optional[int]:
+        if self.respawns >= self.max_respawns:
+            return None
+        nid = self._next_id
+        self._next_id += 1
+        self.workers[nid] = self.factory(nid)
+        self.active.add(nid)
+        self.respawns += 1
+        self._start(nid)
+        t_death = self.death_times.get(died)
+        rec = {"died": died, "replacement": nid, "reason": reason,
+               "recovery_ms": (round((time.monotonic() - t_death) * 1e3, 1)
+                               if t_death is not None else None)}
+        self.respawn_records.append(rec)
+        self.events.append({"kind": "respawn", **rec})
+        logger.warning("elastic worker %d %s; respawned as worker %d",
+                       died, reason, nid)
+        return nid
+
+    def _declare_dead(self, wid: int, note: str, reason: str):
+        self.active.discard(wid)
+        self.failures.setdefault(wid, note)
+        self.death_times.setdefault(wid, time.monotonic())
+        self.ledger.revoke_worker(wid)
+        self.events.append({"kind": "death", "worker": wid,
+                            "reason": reason})
+        if not self.ledger.epoch_done():
+            self._respawn(wid, reason)
+
+    # -- the per-epoch loop ----------------------------------------------------
+    def run_epoch(self, epoch: int):
+        """Drive one epoch of the ledger to completion (or raise)."""
+        self.ledger.begin_epoch(epoch)
+        for wid in sorted(self.active):
+            if not self._alive(wid):
+                self._start(wid)
+        while not self.ledger.epoch_done():
+            # wedge/straggler detection: expired leases return to the pool;
+            # a holder whose thread is still alive is wedged, not dead
+            for lease, holder in self.ledger.revoke_expired():
+                self.events.append({"kind": "lease_revoked", "epoch": epoch,
+                                    "lease": lease.lease_id,
+                                    "worker": holder})
+                if holder in self.active and self._alive(holder):
+                    self._declare_dead(
+                        holder,
+                        f"wedged: lease {lease.lease_id} deadline expired "
+                        f"with no renewal (epoch {epoch})",
+                        reason="wedged")
+            # deaths: threads that raised out of their lease loop
+            with self._lock:
+                dead = [w for w in sorted(self.active) if w in self.errors]
+            for wid in dead:
+                err = self.errors[wid]
+                if isinstance(err, KeyboardInterrupt):
+                    raise err
+                from .ps_sharding import PSShardDown
+                if isinstance(err, PSShardDown):
+                    raise err  # a lost center partition is not a worker death
+                self._declare_dead(wid, self.failures[wid], reason="died")
+            # liveness: leases remain but nobody is working on them
+            if not self.ledger.epoch_done() \
+                    and not any(self._alive(w) for w in self.active):
+                restartable = [w for w in sorted(self.active)
+                               if w in self.results]
+                if restartable:
+                    # finished workers rejoin to drain revoked leases
+                    self._start(restartable[0])
+                elif self._respawn(-1, "worker pool drained") is None:
+                    last = None
+                    with self._lock:
+                        if self.errors:
+                            last = list(self.errors.values())[-1]
+                    raise RuntimeError(
+                        f"all elastic workers failed with {self.respawns} "
+                        f"respawns spent (max_respawns="
+                        f"{self.max_respawns})") from last
+            time.sleep(self.poll_interval)
+        for wid in sorted(self.active):
+            t = self._threads.get(wid)
+            if t is not None:
+                t.join(timeout=self.join_timeout)
+
+    def release_hung(self):
+        """Unblock workers wedged on an injected 'hang' fault (teardown)."""
+        for w in self.workers.values():
+            ev = getattr(w, "_hang_released", None)
+            if ev is not None:
+                ev.set()
+
+    def shutdown(self):
+        self.release_hung()
+        for t in self._threads.values():
+            t.join(timeout=1.0)

@@ -624,6 +624,24 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     the single-PS path, and ``ps_shards=1`` (default) is today's
     single-server behavior bit for bit.  See docs/host_ps.md.
 
+    ``elastic`` (``execution='host_ps'`` only): make the *workers*
+    survivable too (``resilience.LeaseLedger``/``WorkerSupervisor``).  Each
+    epoch's data is partitioned into window-aligned **leases** (of
+    ``lease_windows`` communication windows each; default ≈ 4 leases per
+    worker per epoch) that workers acquire, renew once per committed window
+    (the heartbeat rides the commit cadence), and complete.  A worker that
+    dies (raise / exit) has its unfinished leases revoked and a replacement
+    respawned under a fresh id from a live center pull; one that wedges
+    past its lease deadline (per-worker window-rate EWMA × slack, floored
+    by ``lease_timeout`` seconds) has its leases stolen by surviving
+    workers — straggler mitigation.  Contract: every lease is completed
+    exactly once per epoch by someone, so killing k of N workers mid-epoch
+    loses **zero** training examples (asserted after each epoch; see
+    ``elastic_stats``).  Elastic runs use the serial per-window transport
+    (the commit doubles as the lease heartbeat); ``comm_overlap`` is
+    inert under ``elastic=True``.  ``elastic=False`` (default) keeps the
+    static-shard engine bit for bit.
+
     ``recovery`` (``execution='host_ps'`` only): make the parameter servers
     themselves survivable (``resilience.py``).  A ``ShardSupervisor``
     journals periodic per-shard snapshots (center slice + clock, atomic
@@ -647,6 +665,9 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     def __init__(self, keras_model, *, parallelism_factor: int = 1,
                  comm_overlap: Optional[bool] = None, ps_shards: int = 1,
                  recovery: bool = False, recovery_policy=None,
+                 elastic: bool = False,
+                 lease_windows: Optional[int] = None,
+                 lease_timeout: float = 5.0,
                  **kw):
         super().__init__(keras_model, **kw)
         self.parallelism_factor = int(parallelism_factor)
@@ -680,6 +701,23 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                 "engine's recovery story is checkpoint_dir + train(resume="
                 "True); process_ps worker processes are respawned by the "
                 "job layer)")
+        self.elastic = bool(elastic)
+        if self.elastic and self.execution != "host_ps":
+            raise ValueError(
+                "elastic=True requires execution='host_ps' (the SPMD "
+                "engine is bulk-synchronous — a lost participant is a lost "
+                "collective; process_ps workers are whole OS processes the "
+                "job layer owns)")
+        self.lease_windows = (None if lease_windows is None
+                              else int(lease_windows))
+        if self.lease_windows is not None and self.lease_windows < 1:
+            raise ValueError("lease_windows must be >= 1")
+        self.lease_timeout = float(lease_timeout)
+        if self.lease_timeout <= 0:
+            raise ValueError("lease_timeout must be > 0")
+        #: elastic-run observability (resilience events): respawns, lease
+        #: reassignments, per-worker windows, per-epoch exactly-once reports
+        self.elastic_stats: dict = {}
 
     @property
     def comm_overlap(self) -> bool:

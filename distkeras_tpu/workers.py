@@ -26,6 +26,7 @@ docs/host_ps.md for the per-algorithm staleness contract).
 from __future__ import annotations
 
 import socket
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -41,6 +42,39 @@ from . import networking
 from .ps_sharding import ShardedPSClient
 from .resilience import (DEFAULT_CONNECT_POLICY, DEFAULT_RECOVERY_POLICY,
                          RETRYABLE_CONNECT, RetryPolicy, dial)
+
+
+#: injectable worker fault kinds (fault_injection): 'raise' = thread raises
+#: (the legacy int form), 'exit' = the worker vanishes mid-frame (torn
+#: commit + RST, then SystemExit — the wire signature of a worker host
+#: dying), 'hang' = the worker wedges (stops renewing its lease) while its
+#: PS connection stays open, until released at teardown.
+FAULT_KINDS = ("raise", "exit", "hang")
+
+
+def parse_fault_injection(spec: Optional[dict]) -> Dict[int, Tuple[str, int]]:
+    """Normalize a ``fault_injection`` spec to ``{worker_id: (kind, budget)}``.
+
+    Accepts the legacy ``{id: n}`` form (= ``('raise', n)``) and the
+    PR 5 ``{id: (kind, n)}`` form; keys may be strings (JSON round-trip on
+    the process engine) and tuples may arrive as lists for the same reason.
+    """
+    out: Dict[int, Tuple[str, int]] = {}
+    for k, v in (spec or {}).items():
+        if isinstance(v, (list, tuple)):
+            if len(v) != 2:
+                raise ValueError(
+                    f"fault_injection value for worker {k} must be "
+                    f"(kind, budget), got {v!r}")
+            kind, budget = str(v[0]), int(v[1])
+        else:
+            kind, budget = "raise", int(v)
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"fault_injection kind must be one of {FAULT_KINDS}, "
+                f"got {kind!r} for worker {k}")
+        out[int(k)] = (kind, budget)
+    return out
 
 
 def topk_select(eff: np.ndarray, k: int, code: Optional[str] = None):
@@ -196,7 +230,15 @@ class Worker:
         x = np.asarray(shard[self.features_col])
         y = np.asarray(shard[self.label_col])
         perm = np.random.default_rng(epoch_seed).permutation(len(x))
-        x, y = x[perm], y[perm]
+        return self._stack_windows(x[perm], y[perm], window)
+
+    def _stack_windows(self, x: np.ndarray, y: np.ndarray,
+                       window: Optional[int] = None
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Shape already-ordered rows into (num_windows, window, batch, ...)
+        stacks with the shared wrap-pad + mask contract (no shuffle — the
+        elastic lease path shuffles globally at the driver)."""
+        window = self.window if window is None else int(window)
         # one window = one "batch" of the shared padder, then split it
         xw, yw, mw, nwin = batch_epoch_data(x, y, window * self.batch_size)
         shape = (nwin, window, self.batch_size)
@@ -271,10 +313,13 @@ class PSWorker(Worker):
         #: the transport-cost observable bench.py and tests read
         self.transport_ops = 0
         # fault injection (SURVEY §5: the reference had none): worker id ->
-        # commit budget; the worker raises at its budget+1-th commit.  Keys
-        # arrive as strings after a JSON round-trip (process engine).
-        self.fault_injection = {int(k): int(v)
-                                for k, v in (fault_injection or {}).items()}
+        # (kind, budget) — the worker faults at its budget+1-th commit with
+        # 'raise' (legacy int form), 'exit' (dies mid-frame) or 'hang'
+        # (wedges until _hang_released).  Keys arrive as strings and tuples
+        # as lists after a JSON round-trip (process engine).
+        self.fault_injection = parse_fault_injection(fault_injection)
+        #: set at teardown to unblock a worker wedged on an injected 'hang'
+        self._hang_released = threading.Event()
         self._commits = 0
         # e.g. "bfloat16": halve commit bytes; "int8": quarter them with
         # per-tensor affine quantization + error feedback (see commit()).
@@ -651,26 +696,61 @@ class PSWorker(Worker):
                           np.array(applied_vals, np.float32, copy=True))
         return msg, self._densify(idx, applied_vals)
 
+    def _inject_fault(self, worker_id: int, kind: str):
+        """Realize one injected fault at this commit (see ``FAULT_KINDS``).
+
+        'hang' wedges the worker with its PS connection(s) left open — the
+        signature of a stuck host/device: no EOF for the server, no renewal
+        for the lease ledger — until ``_hang_released`` is set at teardown
+        (then the thread unwinds with a RuntimeError so it never completes
+        work it abandoned).  'raise' hard-closes first so the unwind path's
+        disconnect() is a no-op (no graceful b'q'): the PS sees a plain
+        EOF.  'exit' additionally dies MID-FRAME — opcode plus half a
+        commit frame, then an RST — the wire signature of a worker host
+        falling over mid-send (the PS must drop that connection cleanly
+        without a codec error; tests/test_elastic_workers.py), and raises
+        SystemExit instead of RuntimeError.
+        """
+        if kind == "hang":
+            self._hang_released.wait()
+            raise RuntimeError(
+                f"injected fault: worker {worker_id} hang released at "
+                f"commit {self._commits}")
+        if kind == "exit" and self._sock is not None:
+            # die mid-frame: the torn half-commit exercises the PS
+            # handler's half-frame disconnect path through the real engine
+            try:
+                frame = networking.encode_message(
+                    {"delta": [np.zeros((4,), np.float32)],
+                     "worker_id": worker_id, "clock": self._last_clock})
+                self._sock.sendall(b"c" + frame[:max(9, len(frame) // 2)])
+            except OSError:
+                pass
+            networking._hard_close(self._sock)
+            self._sock = None
+        if self._shard_client is not None:
+            self._shard_client.abort()
+        try:
+            self._sock.close()
+        except (OSError, AttributeError):
+            pass
+        self._sock = None
+        if kind == "exit":
+            raise SystemExit(
+                f"injected fault: worker {worker_id} exits at commit "
+                f"{self._commits}")
+        raise RuntimeError(
+            f"injected fault: worker {worker_id} dies at commit "
+            f"{self._commits}")
+
     def _prepare_commit(self, delta: List[np.ndarray], worker_id: int):
         """Fault-injection gate + wire compression shared by 'c' and 'u'.
         Returns ``(msg, applied)``: the wire message and the delta the PS
         will actually apply after decompression (see ``commit``)."""
         self._commits += 1
-        budget = self.fault_injection.get(worker_id)
-        if budget is not None and self._commits > budget:
-            # hard-close the socket(s) FIRST so the unwind path's
-            # disconnect() is a no-op (no graceful b'q'): the PS sees a
-            # plain EOF, exactly the signature of a worker host falling over
-            if self._shard_client is not None:
-                self._shard_client.abort()
-            try:
-                self._sock.close()
-            except (OSError, AttributeError):
-                pass
-            self._sock = None
-            raise RuntimeError(
-                f"injected fault: worker {worker_id} dies at commit "
-                f"{self._commits}")
+        fault = self.fault_injection.get(worker_id)
+        if fault is not None and self._commits > fault[1]:
+            self._inject_fault(worker_id, fault[0])
         if self._topk_density is not None:
             return self._prepare_topk_commit(delta, worker_id)
         if self._quantize:
@@ -908,6 +988,113 @@ class PSWorker(Worker):
     def _window_step(self, window_fn, params, opt_state, xw, yw, mw, rng,
                      index: int):
         raise NotImplementedError
+
+    # -- elastic lease loop ---------------------------------------------------
+    def compile_windows(self, x_sample: np.ndarray,
+                        y_sample: np.ndarray) -> float:
+        """Compile the window program off the training clock; returns the
+        measured wall-clock seconds of the (compile + one window) call.
+
+        Elastic runs measure lease deadlines from the moment a lease is
+        acquired; without this, the first window of the run pays the jit
+        trace+compile *inside* a live deadline and a healthy worker can
+        read as wedged.  The returned time seeds the ledger's
+        pre-first-renewal window estimate (``LeaseLedger.default_window_s``)
+        — deliberately an OVERestimate (it includes the compile), so cold
+        deadlines err generous and the per-worker EWMA tightens them from
+        the first real renewal on.  Donation-safe: runs on throwaway
+        copies.  Shared across workers via ``share_compiled_state`` (the
+        executable caches on the shared function object)."""
+        self._ensure_model()
+        # np → jnp.asarray, exactly as the real window loop converts its
+        # stacks (same dtype demotion, same compiled signature)
+        xw = jnp.asarray(np.zeros(
+            (self.window, self.batch_size) + x_sample.shape[1:],
+            x_sample.dtype))
+        yw = jnp.asarray(np.zeros(
+            (self.window, self.batch_size) + y_sample.shape[1:],
+            y_sample.dtype))
+        mw = jnp.asarray(np.zeros((self.window, self.batch_size),
+                                  np.float32))
+        params = jax.tree_util.tree_map(jnp.array, self._params0)
+        opt_state = self._tx.init(params)
+        rng = jax.random.PRNGKey(0)
+        t0 = time.monotonic()
+        if self._topk_density is not None and self._DEVICE_TOPK:
+            self._ensure_topk()
+            fn = self._build_topk_window_fn()
+            residual = jnp.zeros((self._wire_total,), jnp.float32)
+            out = fn(params, opt_state, residual, xw, yw, mw, rng)
+        else:
+            out = self._build_window_fn()(params, opt_state, xw, yw, mw, rng)
+        jax.block_until_ready(out)
+        return time.monotonic() - t0
+
+    def train_leases(self, worker_id: int, ledger, data_fn,
+                     initial_state=None) -> dict:
+        """The elastic worker loop (``elastic=True`` — resilience.py):
+        acquire a lease from the ``LeaseLedger``, train its windows with the
+        per-algorithm serial ``_window_step`` (commit + pull per window),
+        renew the lease once per committed window (the heartbeat rides the
+        commit cadence — no extra transport), complete it, repeat until the
+        ledger's epoch runs dry.
+
+        A ``renew`` returning False means the lease was revoked (this
+        worker was presumed dead or wedged and a survivor stole the lease):
+        the rest of the lease is abandoned — the stealer's completion is
+        the one the exactly-once ledger records, and the windows already
+        committed here are ordinary extra async commits, the same class as
+        any hogwild interleaving.
+
+        A respawned replacement starts with ``initial_state=None``: a fresh
+        ``pull()`` of the live center — resuming within the same
+        bounded-staleness class the async update rules already tolerate.
+        ``data_fn(lease)`` maps a lease to its (x, y) rows of the epoch's
+        globally-shuffled arrays.
+        """
+        window_fn = self._build_window_fn()
+        self.connect()
+        try:
+            center = self.pull()
+            if initial_state is None:
+                params = self._weights_to_params(center)
+                opt_state = self._tx.init(params)
+            else:
+                params, opt_state = initial_state
+                # the window fn donates params/opt_state; the driver keeps
+                # this state across epochs — train on a device copy
+                params = jax.tree_util.tree_map(jnp.array, params)
+                opt_state = jax.tree_util.tree_map(jnp.array, opt_state)
+            base_rng = jax.random.PRNGKey(self.seed + 100 + worker_id)
+            while True:
+                lease = ledger.acquire(worker_id)
+                if lease is None:
+                    break
+                x, y = data_fn(lease)
+                xw, yw, mw = self._stack_windows(np.asarray(x),
+                                                 np.asarray(y))
+                # per-lease RNG: deterministic in (epoch, lease), so a
+                # stolen lease retrains under the stealer's own stream
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(base_rng, lease.epoch),
+                    lease.lease_id)
+                revoked = False
+                for i in range(len(xw)):
+                    rng, sub = jax.random.split(rng)
+                    params, opt_state, loss = self._window_step(
+                        window_fn, params, opt_state, xw[i], yw[i], mw[i],
+                        sub, worker_id)
+                    self.history.append(float(loss))
+                    # renewal piggybacks on the commit this window just
+                    # made; False = revoked -> abandon the rest
+                    if not ledger.renew(lease.lease_id, worker_id):
+                        revoked = True
+                        break
+                if not revoked:
+                    ledger.complete(lease.lease_id, worker_id)
+        finally:
+            self.disconnect()
+        return {"history": self.history, "state": (params, opt_state)}
 
     # -- overlapped (pipelined) window loop -----------------------------------
     def _train_epoch_overlapped(self, window_fn, params, opt_state, xw, yw,

@@ -40,6 +40,13 @@ def main():
                          "(requires --execution host_ps/process_ps)")
     ap.add_argument("--wire-topk", type=float, default=0.01,
                     help="top-k density for --wire topk (docs/TUNING.md)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="lease-based elastic workers: worker deaths/"
+                         "stragglers lose zero examples (requires "
+                         "--execution host_ps; docs/host_ps.md)")
+    ap.add_argument("--chaos-kill", type=int, default=None, metavar="N",
+                    help="with --elastic: inject worker 0 exiting at its "
+                         "N-th commit (death/respawn demo)")
     args = ap.parse_args()
 
     train, test = load_cifar10(n_train=args.rows, n_test=args.test_rows)
@@ -48,15 +55,23 @@ def main():
         train, test = t.transform(train), t.transform(test)
 
     workers = args.workers or len(jax.devices())
+    faults = ({0: ("exit", args.chaos_kill)}
+              if args.elastic and args.chaos_kill else None)
     trainer = DOWNPOUR(cifar10_convnet(), num_workers=workers,
                        batch_size=args.batch_size, num_epoch=args.epochs,
                        communication_window=args.window,
                        label_col="label_encoded", worker_optimizer="adam",
                        learning_rate=5e-4, execution=args.execution,
-                       wire_dtype=args.wire, wire_topk=args.wire_topk)
+                       wire_dtype=args.wire, wire_topk=args.wire_topk,
+                       elastic=args.elastic, fault_injection=faults)
     fitted = trainer.train(train, shuffle=True)
     print(f"time: {trainer.get_training_time():.2f}s  "
           f"final loss: {trainer.get_history()[-1]:.4f}")
+    if args.elastic:
+        s = trainer.elastic_stats
+        print(f"elastic: respawns={s['respawns']} "
+              f"leases_reassigned={s['leases_reassigned']} "
+              f"windows_per_worker={s['windows_per_worker']}")
 
     predicted = ModelPredictor(fitted).predict(test)
     predicted = LabelIndexTransformer().transform(predicted)

@@ -33,6 +33,24 @@ def test_host_ps_survives_injected_worker_death():
     assert len(t.get_history()) > 0
 
 
+def test_host_ps_tolerates_exit_fault_kind():
+    """PR 5 fault kinds on the legacy (non-elastic) engine: an ('exit', n)
+    worker dies MID-FRAME via SystemExit — no traceback-bearing raise —
+    and fault_tolerance still finishes on the survivors with the death
+    diagnosable.  (The 'hang' kind needs elastic=True and is rejected
+    here — tests/test_elastic_workers.py.)"""
+    ds = make_dataset(n=1024)
+    t = ADAG(make_model(), num_workers=4, batch_size=16, num_epoch=3,
+             communication_window=4, label_col="label_encoded",
+             worker_optimizer="adam", learning_rate=2e-3,
+             execution="host_ps", fault_tolerance=True,
+             fault_injection={1: ("exit", 2)})
+    fitted = t.train(ds)
+    assert t.failed_workers == [1]
+    assert "SystemExit" in t.worker_failures[1]
+    assert eval_accuracy(fitted, ds) > 0.85
+
+
 def test_injected_fault_without_tolerance_raises():
     ds = make_dataset(n=512)
     t = DOWNPOUR(make_model(), num_workers=2, batch_size=16, num_epoch=1,
