@@ -354,6 +354,46 @@ def host_ps_straggler_bench(budget_s: float = 120.0):
             round(times["chaos"] / max(times["clean"], 1e-9), 2)}
 
 
+def serving_bench(budget_s: float = 90.0):
+    """Continuous-batching serving observables (distkeras_tpu/serving.py):
+    the fixed seeded request trace from ``examples/loadgen.py`` in a
+    closed loop (8 users, 4 slots) against the slot-pooled engine, vs the
+    SAME trace through sequential per-request ``generate`` — the
+    pre-engine serving story.  Fields: ``serving_tokens_per_sec`` (engine),
+    ``serving_p50_ms``/``serving_p99_ms`` (submit→done, queueing included),
+    ``serving_slot_occupancy`` (mean busy-slot fraction per decode step),
+    and ``serving_sequential_tokens_per_sec`` for the comparison the
+    engine must win at ≥ 4 concurrent requests.  Returns Nones on
+    overrun/failure — never fatal to the north-star artifact.
+    """
+    sys.path.insert(0, os.path.join(_REPO, "examples"))
+    import loadgen
+
+    none = {"serving_tokens_per_sec": None, "serving_p50_ms": None,
+            "serving_p99_ms": None, "serving_slot_occupancy": None,
+            "serving_sequential_tokens_per_sec": None}
+    if budget_s < 5.0:  # not enough budget to even warm the engine up
+        return none
+    t0 = time.perf_counter()
+    fitted, engine = loadgen.build_engine(num_slots=4)
+    trace = loadgen.make_trace(24, num_steps=16, temperature=0.7)
+    try:
+        closed = loadgen.run_closed_loop(engine, trace, concurrency=8,
+                                         timeout_s=budget_s)
+    finally:
+        engine.stop()
+    if time.perf_counter() - t0 > budget_s:
+        return none
+    seq = loadgen.sequential_baseline(fitted, trace, max_len=engine.max_len)
+    return {
+        "serving_tokens_per_sec": closed["tokens_per_sec"],
+        "serving_p50_ms": closed["p50_ms"],
+        "serving_p99_ms": closed["p99_ms"],
+        "serving_slot_occupancy": closed["slot_occupancy"],
+        "serving_sequential_tokens_per_sec": seq["tokens_per_sec"],
+    }
+
+
 def main():
     t_start = time.perf_counter()
     debug = os.environ.get("DISTKERAS_BENCH_DEBUG", "") == "1"
@@ -589,6 +629,20 @@ def main():
             print(f"[bench] host_ps elastic bench failed: {e}",
                   file=sys.stderr)
     result.update(elastic_fields)
+    # continuous-batching serving observables (serving.py + loadgen):
+    # engine vs sequential per-request generate on the same request trace
+    stage("serving loadgen")
+    serving_fields = {"serving_tokens_per_sec": None,
+                      "serving_p50_ms": None, "serving_p99_ms": None,
+                      "serving_slot_occupancy": None,
+                      "serving_sequential_tokens_per_sec": None}
+    serving_remaining = budget - (time.perf_counter() - t_start)
+    if serving_remaining > 45:
+        try:
+            serving_fields = serving_bench(budget_s=serving_remaining)
+        except Exception as e:
+            print(f"[bench] serving bench failed: {e}", file=sys.stderr)
+    result.update(serving_fields)
     if real_platform == "cpu":
         # CPU fallback: carry the hardware signal instead of erasing it
         result["probe_history"] = probe_history

@@ -21,6 +21,9 @@ from .trainers import (Trainer, SingleTrainer, AveragingTrainer,
                        SynchronousDistributedTrainer,
                        ADAG, DOWNPOUR, AEASGD, EAMSGD, DynSGD)
 from .predictors import Predictor, ModelPredictor
+from . import serving
+from .serving import (QueueFull, RequestHandle, ServingClient,
+                      ServingEngine, ServingServer)
 from .evaluators import (Evaluator, AccuracyEvaluator, AUCEvaluator,
                          F1Evaluator, LossEvaluator, TopKAccuracyEvaluator)
 from . import utils

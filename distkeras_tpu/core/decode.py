@@ -115,15 +115,25 @@ def init_cache(model, batch: int, max_len: int,
     return caches
 
 
+def _per_row(pos) -> bool:
+    """True when ``pos`` is a (B,) per-row position vector (the serving
+    engine's slot pool) rather than the scalar all-rows-share-one-position
+    form.  Scalar ``pos`` keeps the exact original code path."""
+    return getattr(pos, "ndim", 0) == 1
+
+
 def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype,
                  rolling: bool = False):
     """Cached attention over (B, L, D) queries starting at position
     ``pos``; writes k/v for those L positions into the cache and attends
     through ``ops.attention.dot_product_attention`` (same numerics as the
-    training forward)."""
+    training forward).  ``pos`` may be a (B,) vector (single-token steps
+    only): each row writes its k/v at — and attends from — its own
+    position."""
     from ..ops.attention import dot_product_attention
     b, length = h.shape[0], h.shape[1]
     dh = mha.key_dim
+    per_row = _per_row(pos)
 
     def proj(name, heads):
         bias = params.get("b" + name[1]) if mha.use_bias else None
@@ -137,10 +147,34 @@ def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype,
         # rotate by the suffix's ABSOLUTE positions; cached k stay rotated
         # by their own positions (RoPE scores depend only on distance)
         from ..ops.rope import apply_rope
-        positions = pos + jnp.arange(length)
+        positions = (pos[:, None] + jnp.arange(length)[None, :] if per_row
+                     else pos + jnp.arange(length))
         q = apply_rope(q, positions, mha.rope_theta, mha.rope_scale)
         k_t = apply_rope(k_t, positions, mha.rope_theta, mha.rope_scale)
-    if rolling:
+    if per_row:
+        if length != 1:
+            raise ValueError("per-row positions are single-token steps "
+                             "(prefill each request at scalar pos, then "
+                             "batch the decode steps)")
+        rows = jnp.arange(b)
+        if rolling:
+            w = cache["k"].shape[1]
+            slot = pos % w
+            k = cache["k"].at[rows, slot].set(k_t[:, 0])
+            v = cache["v"].at[rows, slot].set(v_t[:, 0])
+            j = jnp.arange(w)
+            kv_positions = pos[:, None] - jnp.mod(pos[:, None] - j[None, :],
+                                                  w)
+            out = dot_product_attention(q, k, v, causal=True, q_offset=pos,
+                                        window=mha.attention_window,
+                                        kv_positions=kv_positions)
+        else:
+            k = cache["k"].at[rows, pos].set(k_t[:, 0])
+            v = cache["v"].at[rows, pos].set(v_t[:, 0])
+            out = dot_product_attention(q, k, v, causal=True, q_offset=pos,
+                                        kv_length=pos + length,
+                                        window=mha.attention_window)
+    elif rolling:
         # ring buffer of the block's window: slot p % W holds position p.
         # Single-token writes only — generate() prefills with a full cache
         # and converts (a batched ring write would wrap around the buffer).
@@ -188,7 +222,9 @@ def _block_forward(block: TransformerBlock, params, x, cache, pos, cdtype,
 def _forward(model, params, caches, toks, pos, rolling: bool = False):
     """Walk the layer stack over (B, L) tokens starting at position
     ``pos``; returns ((B, L, V) f32 logits, new caches).  L == 1 is a
-    decode step, L == P is the batched prompt prefill."""
+    decode step, L == P is the batched prompt prefill.  ``pos`` may be a
+    (B,) per-row position vector (L == 1 only): every row advances at its
+    own position — the serving engine's mixed-length slot batch."""
     cdtype = model._cdtype
     x = None
     new_caches: List[Any] = []
@@ -198,9 +234,13 @@ def _forward(model, params, caches, toks, pos, rolling: bool = False):
             # (FittedModel), which tracer-indexing rejects
             x = jnp.asarray(p["embedding"]).astype(cdtype)[toks]
         elif isinstance(layer, PositionalEmbedding):
-            pe = jax.lax.dynamic_slice_in_dim(
-                jnp.asarray(p["embedding"]), pos, toks.shape[1])
-            x = x + pe.astype(x.dtype)[None]
+            if _per_row(pos):
+                pe = jnp.asarray(p["embedding"])[pos]          # (B, D)
+                x = x + pe.astype(x.dtype)[:, None]
+            else:
+                pe = jax.lax.dynamic_slice_in_dim(
+                    jnp.asarray(p["embedding"]), pos, toks.shape[1])
+                x = x + pe.astype(x.dtype)[None]
         elif isinstance(layer, TransformerBlock):
             x, cache = _block_forward(layer, p, x, cache, pos, cdtype,
                                       rolling)
@@ -212,9 +252,12 @@ def _forward(model, params, caches, toks, pos, rolling: bool = False):
 
 def decode_step(model, params, caches, tok, pos, rolling: bool = False):
     """Advance one position.  tok: (B,) int32 current tokens; pos: scalar
-    int32 position (0-based).  Returns (logits (B, V) f32, new caches).
-    Jittable — wrap in ``jax.jit`` (or let ``generate`` do it) for real
-    use; ``jit_decode_step`` packages exactly that."""
+    int32 position (0-based), or a (B,) int32 vector advancing every row
+    at its OWN position (the serving engine's slot batch — each row writes
+    its k/v at, and attends from, its own position).  Returns (logits
+    (B, V) f32, new caches).  Jittable — wrap in ``jax.jit`` (or let
+    ``generate`` do it) for real use; ``jit_decode_step`` packages exactly
+    that."""
     logits, caches = _forward(model, params, caches, tok[:, None], pos,
                               rolling)
     return logits[:, 0], caches
@@ -305,6 +348,74 @@ def _filter_logits(logits, top_k: Optional[int], top_p: Optional[float]):
     return logits
 
 
+def sample_logits(logits, pos, temperature: float = 0.0,
+                  rng: Optional[jax.Array] = None,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jnp.ndarray:
+    """The ONE per-step sampling rule: (B, V) f32 logits at absolute
+    position ``pos`` → (B,) int32 next tokens.  temperature 0 = greedy
+    argmax; > 0 = softmax sampling after ``_filter_logits`` warping, with
+    the step key derived as ``fold_in(rng, pos)`` so a position's draw is
+    a pure function of (rng, pos).  ``generate`` samples through exactly
+    this function, and the serving engine reuses it for per-request
+    prefill sampling — the two paths cannot drift."""
+    if temperature > 0.0:
+        step_rng = jax.random.fold_in(rng, pos)
+        logits = _filter_logits(logits / temperature, top_k, top_p)
+        nxt = jax.random.categorical(step_rng, logits)
+    else:
+        nxt = jnp.argmax(logits, axis=-1)
+    return nxt.astype(jnp.int32)
+
+
+def filter_logits_batched(logits, top_k, top_p):
+    """Per-row ``_filter_logits`` with TRACED per-row parameters: ``top_k``
+    (B,) int32 (0 = disabled), ``top_p`` (B,) f32 (0 = disabled).  Row r
+    with ``top_k[r] == K > 0`` and ``top_p[r] == P > 0`` computes exactly
+    what ``_filter_logits(row, K, P)`` computes (the k-th value comes from
+    a descending sort instead of ``lax.top_k`` — the same exact selection —
+    and the k-then-p composition order is preserved), so one jitted program
+    serves a slot batch with heterogeneous sampling configs."""
+    v = logits.shape[-1]
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    k = jnp.clip(top_k, 1, v)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    logits = jnp.where((top_k > 0)[:, None] & (logits < kth),
+                       -jnp.inf, logits)
+    # p filter runs on the k-filtered logits (k-then-p, as _filter_logits)
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    kept = jnp.sum((cum - probs) < top_p[:, None], axis=-1, keepdims=True)
+    cut = jnp.take_along_axis(sorted_desc, jnp.maximum(kept, 1) - 1, axis=-1)
+    return jnp.where((top_p > 0)[:, None] & (logits < cut),
+                     -jnp.inf, logits)
+
+
+def sample_logits_batched(logits, positions, temperature, rngs,
+                          top_k, top_p) -> jnp.ndarray:
+    """Per-row ``sample_logits``: every row carries its own sampling config.
+
+    ``positions`` (B,) int32 absolute positions; ``temperature`` (B,) f32
+    (<= 0 = greedy argmax for that row); ``rngs`` (B, 2) uint32 per-row base
+    keys (each folded by its row's position, exactly as ``sample_logits``
+    folds the shared key); ``top_k``/``top_p`` as in
+    ``filter_logits_batched``.  Row-for-row this reproduces
+    ``sample_logits`` on that row's scalar params — vmapped ``fold_in`` +
+    ``categorical`` draw the same counter-based random bits as the
+    unbatched calls, which is what makes the serving engine's output
+    bit-identical to offline ``generate``."""
+    temp = jnp.asarray(temperature, jnp.float32)
+    safe = jnp.where(temp > 0.0, temp, 1.0)
+    warped = filter_logits_batched(logits / safe[:, None], top_k, top_p)
+    keys = jax.vmap(jax.random.fold_in)(rngs, positions)
+    sampled = jax.vmap(jax.random.categorical)(keys, warped)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temp > 0.0, sampled, greedy).astype(jnp.int32)
+
+
 def _to_ring(full_cache, p_len: int, window: int):
     """Convert a full prefill cache (positions 0..p_len-1 at slots
     0..p_len-1) into a W-slot ring where slot ``p % W`` holds position
@@ -380,13 +491,7 @@ def generate(model, params, prompt, num_steps: int,
     caches = init_cache(model, b, p_len if rolling else max_len)
 
     def sample(logits, pos):
-        if temperature > 0.0:
-            step_rng = jax.random.fold_in(rng, pos)
-            logits = _filter_logits(logits / temperature, top_k, top_p)
-            nxt = jax.random.categorical(step_rng, logits)
-        else:
-            nxt = jnp.argmax(logits, axis=-1)
-        return nxt.astype(jnp.int32)
+        return sample_logits(logits, pos, temperature, rng, top_k, top_p)
 
     # prefill: all P prompt positions in one batched forward
     logits, caches = _forward(model, params, caches, prompt, 0)

@@ -332,35 +332,46 @@ class BufferPool:
     holding one pool per PS shard doesn't pin N full weight-sized buffers
     forever after a pull-size change (e.g. a resumed run with a different
     wire layout).  ``max_idle=None`` disables eviction.
+
+    ``get`` (and the hit/miss/eviction bookkeeping) is thread-safe: the
+    serving server's per-connection reuse pattern has handler threads and
+    the engine thread alive at once, and the pure-Python dict bookkeeping
+    here is not atomic under concurrent mutation.  Thread-safety of
+    acquisition does NOT extend the buffer-lifetime contract — two threads
+    that acquire the SAME size still share one buffer, so a pool may be
+    shared across threads only when at most one frame per pool is live at
+    a time (per-connection pools, the pattern both servers use).
     """
 
     def __init__(self, max_idle: Optional[int] = 32):
         self._bufs: Dict[int, bytearray] = {}
         self._last_used: Dict[int, int] = {}
         self._acquisitions = 0
+        self._get_lock = threading.Lock()
         self.max_idle = max_idle
         self.hits = 0
         self.misses = 0
         self.evictions = 0
 
     def get(self, size: int) -> bytearray:
-        self._acquisitions += 1
-        buf = self._bufs.get(size)
-        if buf is None:
-            buf = bytearray(size)
-            self._bufs[size] = buf
-            self.misses += 1
-        else:
-            self.hits += 1
-        self._last_used[size] = self._acquisitions
-        if self.max_idle is not None:
-            stale = [s for s, last in self._last_used.items()
-                     if self._acquisitions - last >= self.max_idle]
-            for s in stale:
-                del self._bufs[s]
-                del self._last_used[s]
-                self.evictions += 1
-        return buf
+        with self._get_lock:
+            self._acquisitions += 1
+            buf = self._bufs.get(size)
+            if buf is None:
+                buf = bytearray(size)
+                self._bufs[size] = buf
+                self.misses += 1
+            else:
+                self.hits += 1
+            self._last_used[size] = self._acquisitions
+            if self.max_idle is not None:
+                stale = [s for s, last in self._last_used.items()
+                         if self._acquisitions - last >= self.max_idle]
+                for s in stale:
+                    del self._bufs[s]
+                    del self._last_used[s]
+                    self.evictions += 1
+            return buf
 
 
 # ---------------------------------------------------------------------------
@@ -508,10 +519,23 @@ def read_frame(sock: socket.socket) -> bytes:
     return b"".join(parts)
 
 
+#: Serving-protocol opcodes (``serving.ServingServer`` — its OWN opcode
+#: namespace on its own port; the PS protocol's ``'q'`` quit is unrelated):
+#: ``'q'`` enqueue request (frame follows; server acks or backpressures),
+#: ``'r'`` stream reply (frame ``{"id"}`` follows; server streams chunk
+#: frames until ``done``).  Both ride the ordinary codec — request/reply
+#: bodies are plain trees, so the native and pure-Python codecs carry them
+#: unchanged (round-trip-tested in tests/test_wirecodec.py).
+SERVING_OP_ENQUEUE = b"q"
+SERVING_OP_STREAM = b"r"
+
+
 def send_opcode(sock: socket.socket, op: bytes) -> None:
     """Send a 1-byte action opcode (reference protocol: ``'p'`` pull /
     ``'c'`` commit; we add ``'u'`` update = commit+pull in one round trip,
-    ``'h'`` heartbeat, and ``'q'`` quit)."""
+    ``'h'`` heartbeat, and ``'q'`` quit; the serving protocol reuses this
+    framing with its own namespace — ``SERVING_OP_ENQUEUE`` /
+    ``SERVING_OP_STREAM``)."""
     assert len(op) == 1
     sock.sendall(op)
 

@@ -67,6 +67,12 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     overriding the identity slot→position layout that ``causal``/
     ``kv_length`` otherwise assume.  All accept tracers.
 
+    Each hook also accepts a PER-ROW form — ``q_offset``/``kv_length`` of
+    shape (B,), ``kv_positions`` of shape (B, Sk) — so one batched decode
+    step can advance every row at its own position (the serving engine's
+    slot pool, where slots hold requests of different lengths).  The scalar
+    form takes the exact code path it always did.
+
     ``segment_ids`` (B, S) int: sequence-packing isolation — query and key
     attend only within equal segment ids (on top of causal/window), so
     several documents packed into one row never see each other.  Id 0 is
@@ -96,17 +102,38 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
                         preferred_element_type=jnp.float32) * scale
     k_pos = (jnp.arange(k.shape[1]) if kv_positions is None
              else jnp.asarray(kv_positions))
+    per_row = (k_pos.ndim == 2
+               or getattr(q_offset, "ndim", 0) >= 1
+               or getattr(kv_length, "ndim", 0) >= 1)
     if causal:
-        q_pos = jnp.arange(sq) + (0 if q_offset is None else q_offset)
-        mask = k_pos[None, :] > q_pos[:, None]  # (Sq, Sk): True = hide
-        if window is not None:
-            mask = mask | (k_pos[None, :] <= q_pos[:, None] - window)
-        if kv_positions is not None:
-            mask = mask | (k_pos[None, :] < 0)  # negative = empty slot
-        scores = jnp.where(mask[None, None, None], NEG_INF, scores)
+        if per_row:
+            # batched masks: row r is a request at its own position
+            q_off = jnp.asarray(0 if q_offset is None else q_offset)
+            q_pos = jnp.arange(sq)[None, :] + jnp.reshape(q_off, (-1, 1))
+            kp = k_pos if k_pos.ndim == 2 else k_pos[None, :]  # (B|1, Sk)
+            mask = kp[:, None, :] > q_pos[:, :, None]          # (B, Sq, Sk)
+            if window is not None:
+                mask = mask | (kp[:, None, :] <= q_pos[:, :, None] - window)
+            if kv_positions is not None:
+                mask = mask | (kp[:, None, :] < 0)  # negative = empty slot
+            scores = jnp.where(mask[:, None, None], NEG_INF, scores)
+        else:
+            q_pos = jnp.arange(sq) + (0 if q_offset is None else q_offset)
+            mask = k_pos[None, :] > q_pos[:, None]  # (Sq, Sk): True = hide
+            if window is not None:
+                mask = mask | (k_pos[None, :] <= q_pos[:, None] - window)
+            if kv_positions is not None:
+                mask = mask | (k_pos[None, :] < 0)  # negative = empty slot
+            scores = jnp.where(mask[None, None, None], NEG_INF, scores)
     if kv_length is not None:
-        scores = jnp.where((k_pos < kv_length)[None, None, None, None],
-                           scores, NEG_INF)
+        if per_row:
+            kl = jnp.reshape(jnp.asarray(kv_length), (-1, 1))  # (B, 1)
+            kp = k_pos if k_pos.ndim == 2 else k_pos[None, :]
+            scores = jnp.where((kp < kl)[:, None, None, None, :],
+                               scores, NEG_INF)
+        else:
+            scores = jnp.where((k_pos < kv_length)[None, None, None, None],
+                               scores, NEG_INF)
     if segment_ids is not None:
         seg = jnp.asarray(segment_ids)
         cross = seg[:, :, None] != seg[:, None, :]        # (B, Sq, Sk)

@@ -61,10 +61,24 @@ def apply_rope(x, positions, theta: float = 10000.0, scale: float = 1.0):
     """Rotate (B, S, H, D) q or k by per-position angles.
 
     ``positions``: (S,) absolute token positions — pass the true offsets
-    when decoding a suffix against a cache.  ``theta``/``scale``: see
-    ``rope_angles`` (context-extension knobs; defaults = classic RoPE).
+    when decoding a suffix against a cache — or (B, S) PER-ROW positions
+    (a batched decode step where every row sits at its own position; the
+    serving engine's slot pool).  ``theta``/``scale``: see ``rope_angles``
+    (context-extension knobs; defaults = classic RoPE).
     """
     b, s, h, d = x.shape
+    if getattr(positions, "ndim", 1) == 2:            # (B, S) per-row
+        validate_rope_dim(d)
+        freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+        pos = positions.astype(jnp.float32) / scale
+        ang = pos[..., None] * freqs[None, None, :]   # (B, S, d/2)
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
+        x32 = x.astype(jnp.float32)
+        x1, x2 = x32[..., 0::2], x32[..., 1::2]
+        out = jnp.stack([x1 * cos - x2 * sin,
+                         x1 * sin + x2 * cos], axis=-1).reshape(b, s, h, d)
+        return out.astype(x.dtype)
     ang = rope_angles(positions, d, theta, scale)     # (S, d/2)
     cos = jnp.cos(ang)[None, :, None, :]
     sin = jnp.sin(ang)[None, :, None, :]

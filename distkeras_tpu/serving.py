@@ -1,0 +1,790 @@
+"""Continuous-batching online serving engine (ROADMAP item 1).
+
+The reference's serving story ends at ``predictors.ModelPredictor`` —
+offline batch inference over a dataset.  This module composes the offline
+decode pieces (``core/decode.py``: KV-cache ``decode_step``, the factored
+sampling surface, eos stopping) into a LIVE inference server with
+iteration-level (Orca-style) scheduling:
+
+ - **Slot pool** — one batched KV cache (``init_cache(model, num_slots,
+   max_len)``); each batch row is a *slot* holding one in-flight request at
+   its own position.  The whole pool advances through ONE jitted per-row
+   ``decode_step`` (per-slot positions + active mask), so requests of
+   different lengths share one compiled decode batch.
+ - **Admission queue with backpressure** — ``submit`` enqueues up to
+   ``queue_capacity`` requests; beyond that it blocks (or raises
+   ``QueueFull`` with ``block=False`` — the wire server turns that into a
+   backpressure reply instead of buffering unboundedly).
+ - **Prefill/decode interleave** — each engine iteration admits up to
+   ``prefills_per_step`` queued requests into free slots (one batched
+   prompt forward each, scattered into the slot's cache row), then runs one
+   decode step for every running request.  New work never stalls the
+   running batch for more than a bounded number of prefills.
+ - **Retirement + slot reuse** — a request leaves its slot the moment it
+   emits ``eos_id`` or its ``num_steps``-th token; the slot is immediately
+   reusable by the next queued request *mid-run* (continuous batching —
+   the point of the whole engine).
+ - **Hot weight reload** (stretch, off by default) — ``attach_ps`` points
+   the engine at a live parameter server; between decode steps it pulls a
+   fresh center over the existing ``'p'`` opcode, so training and serving
+   can share one deployment.
+
+Determinism contract: a lone request through the engine emits tokens
+BIT-IDENTICAL to offline ``generate`` under the same seed/params
+(tests/test_serving.py) — prefill runs the same eager ``_forward``,
+decode sampling runs the factored ``sample_logits_batched`` whose per-row
+math reproduces ``generate``'s ``sample_logits`` row for row.
+
+The wire layer (``ServingServer``/``ServingClient``) speaks the same frame
+codec + ``BufferPool`` transport as the PS stack, with two opcodes of its
+own: ``'q'`` (enqueue request → ack/backpressure) and ``'r'`` (stream
+reply chunks until done).  The serving protocol owns its port and its
+opcode namespace — the PS protocol's ``'q'`` (quit) lives elsewhere.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import networking
+from .core.decode import (_check_supported, _context_limit, _forward,
+                          _to_ring, _validate_rolling, _validate_sampling,
+                          _validate_stopping, _vocab_size, decode_step,
+                          init_cache, sample_logits, sample_logits_batched)
+from .core.model import FittedModel, Sequential
+
+logger = logging.getLogger("distkeras_tpu.serving")
+
+tmap = jax.tree_util.tree_map
+
+
+class QueueFull(RuntimeError):
+    """Admission backpressure: the engine's bounded queue is at capacity
+    (``submit(block=False)`` / a blocking submit that timed out).  The wire
+    server maps this to an ``{"ok": False, "error": "queue full"}`` reply —
+    the client sheds or retries; the server never buffers unboundedly."""
+
+
+class RequestHandle:
+    """One submitted request's lifecycle + streaming surface.
+
+    Produced tokens arrive incrementally (``next_chunk``) as the engine
+    emits them; ``result()`` blocks until retirement and returns the full
+    ``generate``-shaped row: prompt + emitted tokens, padded with
+    ``pad_id`` (default ``eos_id``, else 0) out to ``num_steps`` — exactly
+    the static-shape row offline ``generate`` would return.
+    """
+
+    __slots__ = ("id", "prompt", "num_steps", "temperature", "top_k",
+                 "top_p", "eos_id", "pad_id", "key", "tokens", "finish",
+                 "slot", "submitted_at", "started_at", "finished_at",
+                 "_cond", "_chunk_read")
+
+    def __init__(self, rid: int, prompt: np.ndarray, num_steps: int,
+                 temperature: float, top_k: Optional[int],
+                 top_p: Optional[float], eos_id: Optional[int],
+                 pad_id: Optional[int], key):
+        self.id = rid
+        self.prompt = prompt
+        self.num_steps = int(num_steps)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self.key = key
+        self.tokens: List[int] = []     # emitted (pre-padding) tokens
+        self.finish: Optional[str] = None   # "eos" | "length" | "empty"
+        self.slot: Optional[int] = None
+        self.submitted_at = time.perf_counter()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self._cond = threading.Condition()
+        self._chunk_read = 0            # tokens already handed out as chunks
+
+    @property
+    def done(self) -> bool:
+        return self.finish is not None
+
+    @property
+    def pad(self) -> int:
+        return int(self.pad_id if self.pad_id is not None
+                   else (self.eos_id or 0))
+
+    # -- engine side ---------------------------------------------------------
+    def _push(self, token: int) -> None:
+        with self._cond:
+            self.tokens.append(int(token))
+            self._cond.notify_all()
+
+    def _finish(self, reason: str) -> None:
+        with self._cond:
+            self.finish = reason
+            self.finished_at = time.perf_counter()
+            self._cond.notify_all()
+
+    # -- consumer side -------------------------------------------------------
+    def next_chunk(self, timeout: Optional[float] = None
+                   ) -> Tuple[np.ndarray, bool]:
+        """Block until new tokens exist (or the request finished); return
+        ``(new_tokens, done)``.  After ``done`` the chunk may be empty —
+        the stream's final frame."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self.done or len(self.tokens) > self._chunk_read,
+                timeout=timeout)
+            chunk = np.asarray(self.tokens[self._chunk_read:], np.int32)
+            self._chunk_read = len(self.tokens)
+            return chunk, self.done
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        with self._cond:
+            return self._cond.wait_for(lambda: self.done, timeout=timeout)
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """The full ``generate``-shaped row (prompt + tokens, padded to
+        ``num_steps``) — blocks until the request retires."""
+        if not self.wait(timeout):
+            raise TimeoutError(f"request {self.id} not done")
+        gen = list(self.tokens) + [self.pad] * (self.num_steps
+                                                - len(self.tokens))
+        return np.concatenate([self.prompt,
+                               np.asarray(gen, np.int32)])
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        return (None if self.finished_at is None
+                else self.finished_at - self.submitted_at)
+
+
+class ServingEngine:
+    """Iteration-level continuous-batching engine over a slot-pooled KV
+    cache.
+
+    ``model``: a ``FittedModel`` (or ``(Sequential, params)`` pair) from the
+    decode-supported family (``transformer_lm``).  ``num_slots`` is the
+    decode batch — the number of simultaneously running requests;
+    ``max_len`` bounds prompt+continuation per request (defaults to the
+    model's positional range).  ``rolling=True`` (sliding-window models
+    only) makes each slot an O(W) ring instead of ``max_len`` slots.
+
+    Threading: ``submit`` is thread-safe (any number of producers);
+    the scheduler itself — ``step`` / ``run_until_idle`` / the ``start``
+    background thread — must be driven from ONE thread at a time.
+    """
+
+    def __init__(self, model: Union[FittedModel, Tuple[Sequential, Any]],
+                 num_slots: int = 4, max_len: Optional[int] = None,
+                 queue_capacity: int = 64, prefills_per_step: int = 1,
+                 rolling: bool = False):
+        if isinstance(model, FittedModel):
+            self.model, self.params = model.model, model.params
+        else:
+            self.model, self.params = model
+        _check_supported(self.model)
+        if rolling:
+            _validate_rolling(self.model)
+        self.num_slots = int(num_slots)
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        limit = _context_limit(self.model)
+        if max_len is None:
+            if limit is None:
+                raise ValueError("max_len is required for models without a "
+                                 "positional-embedding range")
+            max_len = limit
+        if limit is not None and max_len > limit:
+            raise ValueError(f"max_len {max_len} exceeds the model's "
+                             f"positional-embedding range {limit}")
+        self.max_len = int(max_len)
+        self.rolling = bool(rolling)
+        self.queue_capacity = int(queue_capacity)
+        self.prefills_per_step = max(int(prefills_per_step), 1)
+        self._vocab = _vocab_size(self.model)
+
+        # -- slot pool: ONE batched cache, one host-side row of state per slot
+        self.caches = init_cache(self.model, self.num_slots, self.max_len,
+                                 rolling=self.rolling)
+        self._handles: List[Optional[RequestHandle]] = [None] * self.num_slots
+        self._free: List[int] = list(range(self.num_slots - 1, -1, -1))
+        self._positions = np.zeros((self.num_slots,), np.int32)
+        self._cur_tok = np.zeros((self.num_slots,), np.int32)
+        self._active = np.zeros((self.num_slots,), bool)
+        self._temp = np.zeros((self.num_slots,), np.float32)
+        self._topk = np.zeros((self.num_slots,), np.int32)    # 0 = off
+        self._topp = np.zeros((self.num_slots,), np.float32)  # 0 = off
+        self._keys = np.zeros((self.num_slots, 2), np.uint32)
+
+        # -- admission queue (the ONLY cross-thread state besides handles)
+        self._queue: "collections.deque[RequestHandle]" = collections.deque()
+        self._qlock = threading.Lock()
+        self._not_full = threading.Condition(self._qlock)
+        self._have_work = threading.Condition(self._qlock)
+        self._next_id = 0
+
+        # -- jitted programs (compiled once per engine: shapes are fixed)
+        self._step_fn = self._build_step_fn()
+        self._write_slot_fn = jax.jit(
+            lambda big, row, s: tmap(
+                lambda B, r: jax.lax.dynamic_update_slice(
+                    B, r, (s, 0, 0, 0)), big, row),
+            donate_argnums=(0,))
+
+        # -- hot weight reload (stretch; off unless attach_ps is called)
+        self._ps_addr: Optional[Tuple[str, int]] = None
+        self._reload_every = 0
+        self._reload_sock: Optional[socket.socket] = None
+        self._reload_pool = networking.BufferPool()
+
+        # -- scheduler thread + stats
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self.stats: Dict[str, Any] = {
+            "requests_submitted": 0, "requests_completed": 0,
+            "requests_rejected": 0, "tokens_generated": 0,
+            "prefills": 0, "decode_steps": 0, "active_slot_steps": 0,
+            "queue_peak": 0, "slot_requests": [0] * self.num_slots,
+            "weight_reloads": 0,
+        }
+
+    # ------------------------------------------------------------------ jit
+    def _build_step_fn(self):
+        model, rolling = self.model, self.rolling
+
+        def step(params, caches, tok, positions, active, temp, topk, topp,
+                 keys):
+            logits, caches = decode_step(model, params, caches, tok,
+                                         positions, rolling)
+            nxt = sample_logits_batched(logits, positions, temp, keys,
+                                        topk, topp)
+            # active mask: free slots keep their token (their row computes a
+            # junk forward into their own cache row, which the next
+            # prefill fully overwrites — never into anyone else's)
+            return jnp.where(active, nxt, tok), caches
+
+        return jax.jit(step, donate_argnums=(1,))
+
+    # ------------------------------------------------------------ admission
+    def submit(self, prompt, num_steps: int, temperature: float = 0.0,
+               top_k: Optional[int] = None, top_p: Optional[float] = None,
+               eos_id: Optional[int] = None, pad_id: Optional[int] = None,
+               seed: int = 0, rng: Optional[jax.Array] = None,
+               block: bool = True,
+               timeout: Optional[float] = None) -> RequestHandle:
+        """Enqueue one request; returns its :class:`RequestHandle`.
+
+        ``prompt``: (P,) int tokens.  Sampling/stopping knobs mirror
+        ``generate`` exactly (that is the bit-identity contract); the
+        request's rng is ``rng`` if given, else ``PRNGKey(seed)``.
+        Backpressure: with the queue at ``queue_capacity``, ``block=True``
+        waits (up to ``timeout``), ``block=False`` raises :class:`QueueFull`
+        immediately.
+        """
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1:
+            raise ValueError(f"prompt must be 1-D tokens, got shape "
+                             f"{prompt.shape} — submit one request per row")
+        if num_steps < 0:
+            raise ValueError(f"num_steps must be >= 0, got {num_steps}")
+        key = rng if rng is not None else jax.random.PRNGKey(int(seed))
+        _validate_sampling(temperature, key, top_k, top_p)
+        _validate_stopping(eos_id, pad_id, self._vocab)
+        total = len(prompt) + int(num_steps)
+        if len(prompt) < 1:
+            raise ValueError("prompt must hold at least one token")
+        if total > self.max_len:
+            raise ValueError(f"prompt ({len(prompt)}) + num_steps "
+                             f"({num_steps}) = {total} exceeds the engine's "
+                             f"max_len {self.max_len}")
+        with self._qlock:
+            self._next_id += 1
+            handle = RequestHandle(self._next_id, prompt, num_steps,
+                                   temperature, top_k, top_p, eos_id,
+                                   pad_id, key)
+            self.stats["requests_submitted"] += 1
+            if num_steps == 0:  # nothing to generate: complete in place
+                handle._finish("empty")
+                self.stats["requests_completed"] += 1
+                return handle
+            while len(self._queue) >= self.queue_capacity:
+                if not block or not self._not_full.wait(timeout=timeout):
+                    self.stats["requests_rejected"] += 1
+                    raise QueueFull(
+                        f"admission queue at capacity "
+                        f"({self.queue_capacity}); request {handle.id} shed")
+            self._queue.append(handle)
+            self.stats["queue_peak"] = max(self.stats["queue_peak"],
+                                           len(self._queue))
+            self._have_work.notify()
+        return handle
+
+    @property
+    def queue_depth(self) -> int:
+        with self._qlock:
+            return len(self._queue)
+
+    @property
+    def active_requests(self) -> int:
+        return int(self._active.sum())
+
+    def _pop_queued(self) -> Optional[RequestHandle]:
+        with self._qlock:
+            if not self._queue:
+                return None
+            h = self._queue.popleft()
+            self._not_full.notify()
+            return h
+
+    # ------------------------------------------------------------- prefill
+    def _prefill(self, slot: int, h: RequestHandle) -> None:
+        """Admit ``h`` into ``slot``: one batched prompt forward (the same
+        eager ``_forward`` offline ``generate`` prefills with — identical
+        numerics), first token sampled at ``p_len - 1`` through the shared
+        ``sample_logits``, cache row scattered into the pool."""
+        p_len = len(h.prompt)
+        prompt = jnp.asarray(h.prompt[None], jnp.int32)
+        row = init_cache(self.model, 1,
+                         p_len if self.rolling else self.max_len)
+        logits, row = _forward(self.model, self.params, row, prompt, 0)
+        first = sample_logits(logits[:, -1], p_len - 1, h.temperature,
+                              h.key, h.top_k, h.top_p)
+        if self.rolling:
+            ringed = []
+            for layer, cache in zip(self.model.layers, row):
+                if cache is None:
+                    ringed.append(None)
+                    continue
+                w = layer._mha().attention_window
+                ringed.append({name: _to_ring(cache[name], p_len, w)
+                               for name in ("k", "v")})
+            row = ringed
+        self.caches = self._write_slot_fn(self.caches, row,
+                                          jnp.int32(slot))
+        h.slot = slot
+        h.started_at = time.perf_counter()
+        self._handles[slot] = h
+        self._positions[slot] = p_len
+        self._cur_tok[slot] = int(first[0])
+        self._active[slot] = True
+        self._temp[slot] = h.temperature
+        self._topk[slot] = 0 if h.top_k is None else int(h.top_k)
+        self._topp[slot] = 0.0 if h.top_p is None else float(h.top_p)
+        self._keys[slot] = np.asarray(h.key, np.uint32)
+        self.stats["prefills"] += 1
+        self.stats["slot_requests"][slot] += 1
+        self._emit(slot, int(first[0]))
+
+    # ---------------------------------------------------------- retirement
+    def _emit(self, slot: int, token: int) -> None:
+        """Record one produced token for the request in ``slot``; retire on
+        eos (the eos itself is emitted, as in ``generate``) or length."""
+        h = self._handles[slot]
+        h._push(token)
+        self.stats["tokens_generated"] += 1
+        if h.eos_id is not None and token == h.eos_id:
+            self._retire(slot, "eos")
+        elif len(h.tokens) >= h.num_steps:
+            self._retire(slot, "length")
+
+    def _retire(self, slot: int, reason: str) -> None:
+        h = self._handles[slot]
+        self._handles[slot] = None
+        self._active[slot] = False
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        self._topp[slot] = 0.0
+        self._positions[slot] = 0
+        self._cur_tok[slot] = 0
+        self._free.append(slot)
+        self.stats["requests_completed"] += 1
+        h._finish(reason)
+
+    # ------------------------------------------------------------ schedule
+    def step(self) -> bool:
+        """One engine iteration: admit up to ``prefills_per_step`` queued
+        requests into free slots (prefill), then advance every running
+        request by one token (one batched per-row decode step).  Returns
+        whether any work happened."""
+        did = False
+        for _ in range(self.prefills_per_step):
+            if not self._free:
+                break
+            h = self._pop_queued()
+            if h is None:
+                break
+            self._prefill(self._free.pop(), h)
+            did = True
+        if self._active.any():
+            self._decode_once()
+            did = True
+        if did and self._reload_every:
+            if self.stats["decode_steps"] % self._reload_every == 0:
+                self._pull_weights()
+        return did
+
+    def _decode_once(self) -> None:
+        nxt, self.caches = self._step_fn(
+            self.params, self.caches, jnp.asarray(self._cur_tok),
+            jnp.asarray(self._positions), jnp.asarray(self._active),
+            jnp.asarray(self._temp), jnp.asarray(self._topk),
+            jnp.asarray(self._topp), jnp.asarray(self._keys))
+        nxt = np.asarray(nxt)
+        self.stats["decode_steps"] += 1
+        self.stats["active_slot_steps"] += int(self._active.sum())
+        for slot in np.flatnonzero(self._active):
+            self._positions[slot] += 1
+            self._cur_tok[slot] = nxt[slot]
+            self._emit(int(slot), int(nxt[slot]))
+
+    def run_until_idle(self, max_steps: Optional[int] = None) -> None:
+        """Drive the scheduler inline until queue and slots are empty (the
+        synchronous mode tests and closed-loop benches use)."""
+        steps = 0
+        while self.step():
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"engine still busy after {max_steps} steps "
+                    f"(queue={self.queue_depth}, "
+                    f"active={self.active_requests})")
+
+    @property
+    def slot_occupancy(self) -> Optional[float]:
+        """Mean fraction of slots doing useful work per decode step — the
+        continuous-batching health metric (1.0 = every step fully packed)."""
+        if not self.stats["decode_steps"]:
+            return None
+        return (self.stats["active_slot_steps"]
+                / (self.stats["decode_steps"] * self.num_slots))
+
+    # ------------------------------------------------------- thread driver
+    def start(self) -> "ServingEngine":
+        """Run the scheduler on a background thread (the wire server's
+        mode); idles on the work condition when nothing is queued/active."""
+        if self._thread is not None:
+            return self
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dkt-serving-engine")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        with self._qlock:
+            self._have_work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if self._reload_sock is not None:
+            try:
+                networking.send_opcode(self._reload_sock, b"q")
+                self._reload_sock.close()
+            except OSError:
+                pass
+            self._reload_sock = None
+
+    def _loop(self) -> None:
+        while self._running:
+            if not self.step():
+                with self._qlock:
+                    self._have_work.wait_for(
+                        lambda: bool(self._queue) or not self._running,
+                        timeout=0.05)
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------- hot reload (stretch)
+    def attach_ps(self, host: str, port: int, every: int = 1) -> None:
+        """Hot weight reload: pull a fresh center from a live parameter
+        server (the PS stack's ``'p'`` opcode — same wire the training
+        workers speak) every ``every`` decode steps, so a training run and
+        this engine share one deployment.  The pull happens BETWEEN decode
+        steps — in-flight requests simply continue on the new weights (the
+        KV cache keeps old-weight k/v until those positions roll out, the
+        standard live-reload tradeoff)."""
+        if every < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        self._ps_addr = (host, int(port))
+        self._reload_every = int(every)
+
+    def _pull_weights(self) -> None:
+        try:
+            if self._reload_sock is None:
+                self._reload_sock = networking.connect(*self._ps_addr)
+            networking.send_opcode(self._reload_sock, b"p")
+            msg = networking.recv_data(self._reload_sock,
+                                       pool=self._reload_pool)
+            self.params = self.model.set_weights(self.params,
+                                                 msg["weights"])
+            self.stats["weight_reloads"] += 1
+        except (ConnectionError, OSError, ValueError) as e:
+            logger.warning("serving hot-reload pull failed (%s); keeping "
+                           "current weights", e)
+            if self._reload_sock is not None:
+                try:
+                    self._reload_sock.close()
+                except OSError:
+                    pass
+                self._reload_sock = None
+
+
+# ---------------------------------------------------------------------------
+# wire layer: the serving protocol over the shared frame codec
+# ---------------------------------------------------------------------------
+
+#: serving-protocol opcodes (this protocol's own namespace — a serving
+#: server port never speaks the PS protocol): 'q' enqueue request (frame:
+#: prompt + sampling params → ack/backpressure reply), 'r' stream reply
+#: (frame: {"id"} → chunk frames until {"done": True}).
+OP_ENQUEUE = networking.SERVING_OP_ENQUEUE
+OP_STREAM = networking.SERVING_OP_STREAM
+
+
+class ServingServer:
+    """TCP front-end for a :class:`ServingEngine` — same accept-loop /
+    frame-codec / BufferPool idiom as ``SocketParameterServer``, so serving
+    clients speak the exact wire the PS stack already speaks.
+
+    Per connection: ``'q'`` + request frame → ack ``{"ok": True, "id": n}``
+    or backpressure ``{"ok": False, "error": "queue full"}`` (the bounded
+    admission queue shed the request — nothing was buffered); ``'r'`` +
+    ``{"id": n}`` → a stream of ``{"id", "tokens", "done"}`` chunk frames,
+    the last one carrying ``done=True`` + ``finish`` + the final padded
+    ``row``.  EOF closes the connection; the engine keeps running.
+    """
+
+    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.engine = engine
+        self.host = host
+        self.port = int(port)
+        self._handles: Dict[int, RequestHandle] = {}
+        self._hlock = threading.Lock()
+        self._server: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._running = False
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def start(self) -> "ServingServer":
+        self.engine.start()
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((self.host, self.port))
+        self.port = self._server.getsockname()[1]
+        self._server.listen(128)
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="dkt-serving-accept")
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._server is not None:
+            try:  # wake the blocked accept()
+                socket.create_connection((self.host, self.port),
+                                         timeout=1.0).close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.engine.stop()
+
+    def __enter__(self) -> "ServingServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            if not self._running:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True, name="dkt-serving-conn").start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        # per-connection pools: requests land in a reusable receive buffer,
+        # replies re-serialize into a reusable send buffer.  The send pool
+        # is per-connection (BufferPool is lock-protected, but a shared
+        # pool would still let another connection's encode overwrite a
+        # frame between encode and sendall).
+        recv_pool = networking.BufferPool()
+        send_pool = networking.BufferPool()
+        try:
+            while True:
+                op = networking.recv_opcode(conn)
+                if op == b"":
+                    return
+                if op == OP_ENQUEUE:
+                    msg = networking.recv_data(conn, pool=recv_pool)
+                    try:
+                        h = self.engine.submit(
+                            np.array(msg["prompt"], np.int32, copy=True),
+                            int(msg["num_steps"]),
+                            temperature=float(msg.get("temperature", 0.0)),
+                            top_k=msg.get("top_k"),
+                            top_p=msg.get("top_p"),
+                            eos_id=msg.get("eos_id"),
+                            pad_id=msg.get("pad_id"),
+                            seed=int(msg.get("seed", 0)),
+                            block=False)
+                    except QueueFull:
+                        networking.send_data(
+                            conn, {"ok": False, "error": "queue full"},
+                            pool=send_pool)
+                        continue
+                    except ValueError as e:
+                        networking.send_data(
+                            conn, {"ok": False, "error": str(e)},
+                            pool=send_pool)
+                        continue
+                    with self._hlock:
+                        self._handles[h.id] = h
+                    networking.send_data(conn, {"ok": True, "id": h.id},
+                                         pool=send_pool)
+                elif op == OP_STREAM:
+                    msg = networking.recv_data(conn, pool=recv_pool)
+                    with self._hlock:
+                        h = self._handles.get(int(msg["id"]))
+                    if h is None:
+                        networking.send_data(
+                            conn, {"ok": False, "done": True,
+                                   "error": f"unknown id {msg['id']}"},
+                            pool=send_pool)
+                        continue
+                    while True:
+                        chunk, done = h.next_chunk(timeout=60.0)
+                        reply = {"id": h.id, "tokens": chunk, "done": done}
+                        if done:
+                            reply["finish"] = h.finish
+                            reply["row"] = h.result()
+                        networking.send_data(conn, reply, pool=send_pool)
+                        if done:
+                            with self._hlock:
+                                self._handles.pop(h.id, None)
+                            break
+                else:
+                    return  # protocol violation: drop the connection
+        except (ConnectionError, OSError, ValueError):
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+
+class ServingClient:
+    """Minimal client for :class:`ServingServer` — one socket, the shared
+    frame codec, pooled receives.  ``generate`` is the one-call form whose
+    returned row matches offline ``generate`` for the same request."""
+
+    def __init__(self, host: str, port: int):
+        self.sock = networking.connect(host, int(port))
+        self._pool = networking.BufferPool()
+        self._send_pool = networking.BufferPool()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ServingClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def submit(self, prompt, num_steps: int, **kw) -> int:
+        """Enqueue a request; returns the server-assigned id.  Raises
+        :class:`QueueFull` on a backpressure reply."""
+        req = {"prompt": np.asarray(prompt, np.int32),
+               "num_steps": int(num_steps), **kw}
+        networking.send_opcode(self.sock, OP_ENQUEUE)
+        networking.send_data(self.sock, req, pool=self._send_pool)
+        ack = networking.recv_data(self.sock, pool=self._pool)
+        if not ack.get("ok"):
+            err = ack.get("error", "rejected")
+            if "queue full" in str(err):
+                raise QueueFull(err)
+            raise ValueError(err)
+        return int(ack["id"])
+
+    def stream(self, rid: int):
+        """Yield ``(tokens, done_reply)`` chunk by chunk; ``done_reply`` is
+        None until the final frame."""
+        networking.send_opcode(self.sock, OP_STREAM)
+        networking.send_data(self.sock, {"id": int(rid)},
+                             pool=self._send_pool)
+        while True:
+            reply = networking.recv_data(self.sock, pool=self._pool)
+            if reply.get("error"):
+                raise ValueError(reply["error"])
+            tokens = np.array(reply["tokens"], np.int32, copy=True)
+            if reply["done"]:
+                yield tokens, {"finish": reply["finish"],
+                               "row": np.array(reply["row"], np.int32,
+                                               copy=True)}
+                return
+            yield tokens, None
+
+    def generate(self, prompt, num_steps: int, **kw) -> np.ndarray:
+        """Submit + stream to completion; returns the full padded row
+        (prompt + tokens), exactly ``generate``-shaped."""
+        rid = self.submit(prompt, num_steps, **kw)
+        for _, done in self.stream(rid):
+            if done is not None:
+                return done["row"]
+        raise ConnectionError("stream ended without a done frame")

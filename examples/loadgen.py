@@ -1,0 +1,265 @@
+"""Load generator for the continuous-batching serving engine.
+
+Drives a :class:`distkeras_tpu.serving.ServingEngine` with a FIXED,
+seeded request trace (deterministic prompt contents, lengths, and
+continuation lengths) in two modes:
+
+ - **closed loop** (``run_closed_loop``): N concurrent "users", each
+   submitting its next request the moment the previous one completes —
+   the canonical serving-bench harness (offered load == capacity at the
+   given concurrency).  This is what ``bench.py``'s ``serving_*`` fields
+   run.
+ - **open loop / offered QPS** (``run_open_loop``): requests arrive on a
+   fixed schedule at a target rate regardless of completion, so latency
+   degradation under overload (and queue backpressure shedding) is
+   visible.  ``main`` sweeps a list of offered-QPS points and prints one
+   JSON line per point.
+
+``sequential_baseline`` runs the SAME trace through offline per-request
+``generate`` — one request at a time, no batching — which is the
+comparison continuous batching must beat at ≥ 4 concurrent requests
+(tests/test_serving_bench.py asserts it; ``bench.py`` records it).
+
+Run:  JAX_PLATFORMS=cpu python examples/loadgen.py [--requests 24]
+      [--slots 4] [--concurrency 8] [--qps-sweep 20,50,100]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run without installing
+
+import numpy as np
+
+#: prompt lengths are drawn from a SMALL set so the per-length prefill /
+#: sequential-generate programs stay bounded (each distinct shape is one
+#: XLA compile); continuation length is fixed per trace for the same reason
+PROMPT_LENGTHS = (4, 6, 8)
+
+
+def make_trace(num_requests: int, seed: int = 0, vocab: int = 16,
+               num_steps: int = 16, temperature: float = 0.0,
+               sampled_fraction: float = 0.5) -> List[Dict[str, Any]]:
+    """A deterministic request trace: seeded prompt contents + lengths, a
+    ``sampled_fraction`` of requests sampling at ``temperature`` (per-
+    request seeds), the rest greedy — so the slot batch always mixes
+    sampling configs, exercising the per-slot sampler."""
+    rng = np.random.default_rng(seed)
+    trace = []
+    for i in range(int(num_requests)):
+        p_len = int(PROMPT_LENGTHS[rng.integers(0, len(PROMPT_LENGTHS))])
+        req: Dict[str, Any] = {
+            "prompt": rng.integers(0, vocab, p_len).astype(np.int32),
+            "num_steps": int(num_steps),
+            "seed": int(seed * 10_000 + i),
+        }
+        if temperature > 0.0 and rng.random() < sampled_fraction:
+            req["temperature"] = float(temperature)
+        trace.append(req)
+    return trace
+
+
+def _percentile_ms(latencies_s: Sequence[float], q: float) -> Optional[float]:
+    if not latencies_s:
+        return None
+    return round(float(np.percentile(np.asarray(latencies_s), q)) * 1e3, 2)
+
+
+def _metrics(engine, latencies: List[float], wall_s: float,
+             tokens: int, completed: int, shed: int = 0) -> Dict[str, Any]:
+    return {
+        "completed": completed,
+        "shed": shed,
+        "tokens": tokens,
+        "wall_s": round(wall_s, 3),
+        "tokens_per_sec": round(tokens / wall_s, 1) if wall_s > 0 else None,
+        "p50_ms": _percentile_ms(latencies, 50),
+        "p99_ms": _percentile_ms(latencies, 99),
+        "slot_occupancy": (round(engine.slot_occupancy, 3)
+                           if engine.slot_occupancy is not None else None),
+    }
+
+
+def run_closed_loop(engine, trace: Sequence[Dict[str, Any]],
+                    concurrency: int = 8,
+                    timeout_s: float = 300.0) -> Dict[str, Any]:
+    """``concurrency`` users, each submitting its next trace request when
+    its previous one finishes.  Returns throughput/latency/occupancy
+    metrics; the engine runs on its background thread for the duration."""
+    it = iter(trace)
+    lock = threading.Lock()
+    latencies: List[float] = []
+    errors: List[BaseException] = []
+    tokens0 = engine.stats["tokens_generated"]
+    completed0 = engine.stats["requests_completed"]
+
+    def user():
+        while True:
+            with lock:
+                req = next(it, None)
+            if req is None:
+                return
+            try:
+                h = engine.submit(block=True, timeout=timeout_s, **req)
+                if not h.wait(timeout=timeout_s):
+                    raise TimeoutError(f"request {h.id} incomplete")
+            except BaseException as e:  # noqa: BLE001 - surfaced below
+                with lock:
+                    errors.append(e)
+                return
+            with lock:
+                latencies.append(h.latency_s)
+
+    engine.start()
+    threads = [threading.Thread(target=user, name=f"loadgen-user-{i}")
+               for i in range(int(concurrency))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s)
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    return _metrics(engine, latencies, wall,
+                    engine.stats["tokens_generated"] - tokens0,
+                    engine.stats["requests_completed"] - completed0)
+
+
+def run_open_loop(engine, trace: Sequence[Dict[str, Any]], qps: float,
+                  timeout_s: float = 300.0) -> Dict[str, Any]:
+    """Offered-QPS arrivals: submit request i at ``i / qps`` seconds after
+    start, whatever the engine's progress.  Backpressured submissions
+    (bounded queue full) are SHED and counted — overload degrades by
+    shedding, not by unbounded buffering."""
+    from distkeras_tpu.serving import QueueFull
+
+    engine.start()
+    handles = []
+    shed = 0
+    tokens0 = engine.stats["tokens_generated"]
+    completed0 = engine.stats["requests_completed"]
+    t0 = time.perf_counter()
+    for i, req in enumerate(trace):
+        due = t0 + i / float(qps)
+        delay = due - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            handles.append(engine.submit(block=False, **req))
+        except QueueFull:
+            shed += 1
+    latencies = []
+    for h in handles:
+        if not h.wait(timeout=timeout_s):
+            raise TimeoutError(f"request {h.id} incomplete")
+        latencies.append(h.latency_s)
+    wall = time.perf_counter() - t0
+    out = _metrics(engine, latencies, wall,
+                   engine.stats["tokens_generated"] - tokens0,
+                   engine.stats["requests_completed"] - completed0,
+                   shed=shed)
+    out["offered_qps"] = float(qps)
+    return out
+
+
+def sequential_baseline(fitted, trace: Sequence[Dict[str, Any]],
+                        max_len: int) -> Dict[str, Any]:
+    """The same trace, one request at a time through offline ``generate``
+    (the pre-engine serving story): per-request latency IS the service
+    time, and tokens/sec has no batching to lean on."""
+    import jax
+
+    latencies: List[float] = []
+    tokens = 0
+    t0 = time.perf_counter()
+    for req in trace:
+        r0 = time.perf_counter()
+        out = fitted.generate(
+            req["prompt"][None], req["num_steps"],
+            temperature=req.get("temperature", 0.0),
+            rng=(jax.random.PRNGKey(req["seed"])
+                 if req.get("temperature") else None),
+            max_len=max_len)
+        np.asarray(out)  # materialize before stopping the clock
+        latencies.append(time.perf_counter() - r0)
+        tokens += int(req["num_steps"])
+    wall = time.perf_counter() - t0
+    return {
+        "completed": len(trace),
+        "tokens": tokens,
+        "wall_s": round(wall, 3),
+        "tokens_per_sec": round(tokens / wall, 1) if wall > 0 else None,
+        "p50_ms": _percentile_ms(latencies, 50),
+        "p99_ms": _percentile_ms(latencies, 99),
+    }
+
+
+def build_engine(num_slots: int = 4, max_len: int = 32, vocab: int = 16,
+                 queue_capacity: int = 64, seed: int = 0):
+    """A small random-weight LM + engine (throughput benches measure
+    scheduling and batching, not model quality) — one place so bench,
+    tests, and the CLI agree on the workload shape."""
+    import jax
+
+    from distkeras_tpu.core.model import FittedModel
+    from distkeras_tpu.models import transformer_lm
+    from distkeras_tpu.serving import ServingEngine
+
+    model = transformer_lm(vocab_size=vocab, seq_len=max_len, d_model=32,
+                           num_heads=4, num_layers=2, mlp_dim=64,
+                           compute_dtype="float32")
+    params = model.init(jax.random.PRNGKey(seed), (max_len,))
+    fitted = FittedModel(model, params)
+    engine = ServingEngine(fitted, num_slots=num_slots, max_len=max_len,
+                           queue_capacity=queue_capacity)
+    return fitted, engine
+
+
+def main():
+    from distkeras_tpu.utils import honor_platform_env
+    honor_platform_env()
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--temperature", type=float, default=0.7)
+    ap.add_argument("--qps-sweep", type=str, default="",
+                    help="comma-separated offered-QPS points (open loop)")
+    args = ap.parse_args()
+
+    fitted, engine = build_engine(num_slots=args.slots)
+    trace = make_trace(args.requests, num_steps=args.steps,
+                       temperature=args.temperature)
+    try:
+        closed = run_closed_loop(engine, trace,
+                                 concurrency=args.concurrency)
+        print(json.dumps({"mode": "closed_loop",
+                          "concurrency": args.concurrency, **closed}))
+        seq = sequential_baseline(fitted, trace, max_len=engine.max_len)
+        print(json.dumps({"mode": "sequential", **seq}))
+        if closed["tokens_per_sec"] and seq["tokens_per_sec"]:
+            print(json.dumps({"mode": "speedup", "continuous_vs_sequential":
+                              round(closed["tokens_per_sec"]
+                                    / seq["tokens_per_sec"], 2)}))
+        for qps in filter(None, args.qps_sweep.split(",")):
+            _, engine = build_engine(num_slots=args.slots)
+            point = run_open_loop(engine, trace, qps=float(qps))
+            engine.stop()
+            print(json.dumps({"mode": "open_loop", **point}))
+    finally:
+        engine.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -4,12 +4,14 @@ Trains the x+1 toy LM once (so outputs are predictable by eye), then runs
 the full inference stack on it:
 
   greedy / sampled ``generate`` (KV cache) → ``beam_search`` →
-  ``speculative_generate`` (1-layer draft) → int8 ``quantize`` serving
+  ``speculative_generate`` (1-layer draft) → int8 ``quantize`` serving →
+  the continuous-batching ``ServingEngine`` (slot pool + wire server)
 
 and checks the invariants the test suite pins: beam-0 == greedy, the
-speculative output == greedy bit-for-bit, and int8 greedy == full-precision
-greedy.  No reference counterpart (SURVEY.md §2.3: no sequence models
-upstream) — this is the beyond-parity serving layer in one script.
+speculative output == greedy bit-for-bit, int8 greedy == full-precision
+greedy, and the engine's lone-request row == offline ``generate``.  No
+reference counterpart (SURVEY.md §2.3: no sequence models upstream) —
+this is the beyond-parity serving layer in one script.
 
 Run:  python examples/serving_tour.py [--steps 16]
 (CPU: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -113,6 +115,34 @@ def main():
     q_greedy = np.asarray(q.generate(prompt, args.steps))
     assert (q_greedy == greedy).all(), "int8 changed greedy decode"
     print("int8 quantized greedy == full precision")
+
+    # the continuous-batching engine: a mixed batch of concurrent requests
+    # through one slot-pooled decode program, then the same thing over the
+    # wire server.  The lone greedy request must equal offline generate
+    # bit-for-bit — the engine is scheduling, never different numerics.
+    from distkeras_tpu.serving import ServingClient, ServingEngine, \
+        ServingServer
+
+    eng = ServingEngine(target, num_slots=3, max_len=4 + args.steps)
+    lone = eng.submit(prompt[0], args.steps)
+    mixed = [eng.submit(np.array([2, 3], np.int32), args.steps // 2),
+             eng.submit(np.array([7, 8, 9], np.int32), args.steps,
+                        temperature=0.7, top_k=4, seed=5),
+             eng.submit(np.array([1], np.int32), 3)]
+    eng.run_until_idle()
+    assert (lone.result() == greedy[0]).all(), "engine != offline generate"
+    occ = eng.slot_occupancy
+    print(f"engine: {1 + len(mixed)} concurrent requests, "
+          f"{eng.stats['tokens_generated']} tokens, "
+          f"slot occupancy {occ:.0%}, "
+          f"slots reused {eng.stats['slot_requests']}")
+
+    with ServingServer(ServingEngine(target, num_slots=2,
+                                     max_len=4 + args.steps)) as srv:
+        with ServingClient(*srv.addr) as client:
+            row = client.generate(prompt[0], args.steps)
+            assert (row == greedy[0]).all(), "wire row != offline generate"
+    print("wire server round trip == offline generate")
     print("SERVING-TOUR-OK")
 
 

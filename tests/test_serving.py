@@ -1,0 +1,412 @@
+"""Continuous-batching serving engine (distkeras_tpu/serving.py).
+
+The invariants pinned here are the engine's whole contract:
+
+ - a lone request through the engine emits tokens BIT-IDENTICAL to offline
+   ``generate`` under the same seed/params (greedy, sampled top-k/top-p,
+   eos stopping, rolling-window caches) — the slot pool is an execution
+   strategy, never a numerics change;
+ - the slot lifecycle: admission → prefill → decode → eos/length
+   retirement → slot reuse, including a mixed-length batch where a short
+   request retires and a queued one back-fills its slot MID-RUN (the
+   continuous-batching property itself);
+ - bounded-queue backpressure (``QueueFull``), in process and over the
+   wire;
+ - the per-row ``decode_step``/sampling substrate matches the scalar path
+   row for row.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.core import decode
+from distkeras_tpu.core.model import FittedModel, serialize_model
+from distkeras_tpu.models import transformer_lm
+from distkeras_tpu.serving import (QueueFull, ServingClient, ServingEngine,
+                                   ServingServer)
+
+VOCAB = 17
+
+
+def _fitted(seed=0, **kw):
+    model = transformer_lm(vocab_size=VOCAB, seq_len=32, d_model=16,
+                           num_heads=2, num_layers=2, mlp_dim=32,
+                           compute_dtype="float32", **kw)
+    params = model.init(jax.random.PRNGKey(seed), (32,))
+    return FittedModel(model, params)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    return _fitted()
+
+
+PROMPT = np.array([3, 4, 5, 6], np.int32)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity with offline generate
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kw", [
+    {},                                                       # greedy
+    {"temperature": 0.7, "seed": 11},                         # plain sample
+    {"temperature": 0.7, "top_k": 5, "top_p": 0.9, "seed": 11},
+])
+def test_lone_request_bit_identical_to_generate(fitted, kw):
+    eng = ServingEngine(fitted, num_slots=3, max_len=24)
+    h = eng.submit(PROMPT, 8, **kw)
+    eng.run_until_idle()
+    gkw = dict(kw)
+    seed = gkw.pop("seed", None)
+    if seed is not None:
+        gkw["rng"] = jax.random.PRNGKey(seed)
+    want = np.asarray(fitted.generate(PROMPT[None], 8, max_len=24, **gkw))[0]
+    np.testing.assert_array_equal(h.result(), want)
+
+
+def test_eos_stopping_matches_generate(fitted):
+    greedy = np.asarray(fitted.generate(PROMPT[None], 8, max_len=24))[0]
+    eos = int(greedy[len(PROMPT) + 2])  # a token greedy WILL emit
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    h = eng.submit(PROMPT, 8, eos_id=eos, pad_id=1)
+    eng.run_until_idle()
+    want = np.asarray(fitted.generate(PROMPT[None], 8, eos_id=eos, pad_id=1,
+                                      max_len=24))[0]
+    np.testing.assert_array_equal(h.result(), want)
+    assert h.finish == "eos"
+    assert len(h.tokens) < 8  # retired early; result() pads to num_steps
+
+
+def test_rolling_slots_bit_identical(fitted):
+    windowed = _fitted(seed=1, attention_window=6)
+    eng = ServingEngine(windowed, num_slots=2, max_len=24, rolling=True)
+    long_p = np.arange(1, 8, dtype=np.int32) % VOCAB
+    h1 = eng.submit(long_p, 10, temperature=0.6, seed=9)
+    h2 = eng.submit(np.array([1, 2], np.int32), 6)
+    eng.run_until_idle()
+    w1 = np.asarray(windowed.generate(long_p[None], 10, temperature=0.6,
+                                      rng=jax.random.PRNGKey(9),
+                                      rolling=True, max_len=24))[0]
+    w2 = np.asarray(windowed.generate(np.array([[1, 2]], np.int32), 6,
+                                      rolling=True, max_len=24))[0]
+    np.testing.assert_array_equal(h1.result(), w1)
+    np.testing.assert_array_equal(h2.result(), w2)
+    # the pool really is a ring: W slots per block, not max_len
+    assert eng.caches[2]["k"].shape[1] == 6
+
+
+# ---------------------------------------------------------------------------
+# slot lifecycle: admission → prefill → decode → retirement → reuse
+# ---------------------------------------------------------------------------
+
+def test_mixed_length_batch_backfills_mid_run(fitted):
+    """2 slots, 3 requests: the short one retires first and the queued
+    third back-fills its slot while the long one is still decoding."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    long_h = eng.submit(np.array([1, 2, 3], np.int32), 14)
+    short_h = eng.submit(np.array([4, 5], np.int32), 3)
+    queued_h = eng.submit(np.array([6, 7, 8, 9], np.int32), 5)
+    assert eng.queue_depth == 3 and not eng._active.any()
+    eng.run_until_idle()
+    # zero requests lost; outputs still match offline generate
+    for h in (long_h, short_h, queued_h):
+        assert h.finish == "length"
+        want = np.asarray(fitted.generate(h.prompt[None], h.num_steps,
+                                          max_len=24))[0]
+        np.testing.assert_array_equal(h.result(), want)
+    # the third request reused the short one's slot, MID-run of the long one
+    assert queued_h.slot == short_h.slot
+    assert queued_h.started_at < long_h.finished_at
+    # every slot served at least one request; the short slot served two
+    assert all(n >= 1 for n in eng.stats["slot_requests"])
+    assert eng.stats["slot_requests"][short_h.slot] == 2
+    assert eng.stats["requests_completed"] == 3
+    assert eng.slot_occupancy > 0.5
+
+
+def test_many_requests_zero_lost_every_slot_reused(fitted):
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    rng = np.random.default_rng(0)
+    handles = []
+    for i in range(7):
+        p_len = int(rng.integers(1, 6))
+        steps = int(rng.integers(1, 8))
+        prompt = rng.integers(0, VOCAB, p_len).astype(np.int32)
+        handles.append(eng.submit(prompt, steps, temperature=0.5,
+                                  seed=100 + i))
+    eng.run_until_idle()
+    assert eng.stats["requests_completed"] == 7  # zero lost
+    assert all(n >= 2 for n in eng.stats["slot_requests"])  # all reused
+    for h in handles:
+        want = np.asarray(fitted.generate(h.prompt[None], h.num_steps,
+                                          temperature=0.5, rng=h.key,
+                                          max_len=24))[0]
+        np.testing.assert_array_equal(h.result(), want)
+
+
+def test_retired_slot_state_is_cleared(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    h = eng.submit(PROMPT, 3, temperature=0.9, top_k=3, seed=5)
+    eng.run_until_idle()
+    assert h.done and eng._handles[0] is None
+    assert not eng._active.any()
+    assert eng._temp[0] == 0.0 and eng._topk[0] == 0 and eng._topp[0] == 0.0
+    assert eng._free == [0]
+    # a greedy follow-up through the same slot is unpolluted by the
+    # previous occupant's sampling params
+    h2 = eng.submit(PROMPT, 4)
+    eng.run_until_idle()
+    want = np.asarray(fitted.generate(PROMPT[None], 4, max_len=24))[0]
+    np.testing.assert_array_equal(h2.result(), want)
+
+
+def test_num_steps_zero_completes_without_slot(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    h = eng.submit(PROMPT, 0)
+    assert h.done and h.finish == "empty"
+    np.testing.assert_array_equal(h.result(), PROMPT)
+    assert eng.queue_depth == 0
+
+
+# ---------------------------------------------------------------------------
+# admission queue + backpressure
+# ---------------------------------------------------------------------------
+
+def test_queue_backpressure_sheds(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24, queue_capacity=2)
+    eng.submit(PROMPT, 4)
+    eng.submit(PROMPT, 4)
+    with pytest.raises(QueueFull):
+        eng.submit(PROMPT, 4, block=False)
+    with pytest.raises(QueueFull):
+        eng.submit(PROMPT, 4, timeout=0.05)  # blocking, bounded wait
+    assert eng.stats["requests_rejected"] == 2
+    eng.run_until_idle()
+    assert eng.stats["requests_completed"] == 2
+
+
+def test_blocking_submit_unblocks_when_queue_drains(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24, queue_capacity=1)
+    eng.submit(PROMPT, 2)
+    results = []
+
+    def producer():
+        results.append(eng.submit(PROMPT, 2, timeout=10.0))
+
+    t = threading.Thread(target=producer)
+    t.start()
+    eng.run_until_idle()   # drains the queue, freeing capacity
+    t.join(timeout=10.0)
+    assert not t.is_alive() and len(results) == 1
+    eng.run_until_idle()
+    assert results[0].done
+
+
+def test_submit_validation(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=16)
+    with pytest.raises(ValueError, match="exceeds the engine's max_len"):
+        eng.submit(np.arange(10, dtype=np.int32) % VOCAB, 10)
+    with pytest.raises(ValueError, match="1-D"):
+        eng.submit(PROMPT[None], 4)
+    with pytest.raises(ValueError, match="top_k"):
+        eng.submit(PROMPT, 4, temperature=0.5, top_k=0)
+    with pytest.raises(ValueError, match="vocabulary"):
+        eng.submit(PROMPT, 4, eos_id=VOCAB + 3)
+    with pytest.raises(ValueError, match="max_len"):
+        ServingEngine(fitted, num_slots=1, max_len=64)  # > positional range
+
+
+# ---------------------------------------------------------------------------
+# background thread + wire server
+# ---------------------------------------------------------------------------
+
+def test_background_thread_drives_requests(fitted):
+    with ServingEngine(fitted, num_slots=2, max_len=24) as eng:
+        h = eng.submit(PROMPT, 6)
+        assert h.wait(timeout=30.0)
+    want = np.asarray(fitted.generate(PROMPT[None], 6, max_len=24))[0]
+    np.testing.assert_array_equal(h.result(), want)
+
+
+def test_wire_server_roundtrip_and_streaming(fitted):
+    with ServingServer(ServingEngine(fitted, num_slots=2, max_len=24)) as srv:
+        with ServingClient(*srv.addr) as c:
+            rid = c.submit(PROMPT, 6, temperature=0.7, top_k=5, seed=11)
+            chunks, final = [], None
+            for tokens, done in c.stream(rid):
+                chunks.append(tokens)
+                if done is not None:
+                    final = done
+            want = np.asarray(fitted.generate(
+                PROMPT[None], 6, temperature=0.7, top_k=5,
+                rng=jax.random.PRNGKey(11), max_len=24))[0]
+            np.testing.assert_array_equal(final["row"], want)
+            # the streamed chunks concatenate to the emitted tokens
+            np.testing.assert_array_equal(np.concatenate(chunks),
+                                          want[len(PROMPT):])
+            assert final["finish"] == "length"
+            # one-call form on the same connection
+            np.testing.assert_array_equal(c.generate(PROMPT, 6),
+                np.asarray(fitted.generate(PROMPT[None], 6, max_len=24))[0])
+
+
+def test_wire_server_backpressure_reply(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24, queue_capacity=1)
+    with ServingServer(eng) as srv:
+        with ServingClient(*srv.addr) as c:
+            # saturate: the engine thread may drain some, so push until shed
+            with pytest.raises(QueueFull):
+                for _ in range(200):
+                    c.submit(PROMPT, 12)
+    assert eng.stats["requests_rejected"] >= 1
+
+
+def test_wire_server_bad_request_reply(fitted):
+    with ServingServer(ServingEngine(fitted, num_slots=1, max_len=16)) as srv:
+        with ServingClient(*srv.addr) as c:
+            with pytest.raises(ValueError, match="max_len"):
+                c.submit(np.arange(12, dtype=np.int32) % VOCAB, 12)
+            with pytest.raises(ValueError, match="unknown id"):
+                list(c.stream(999))
+
+
+# ---------------------------------------------------------------------------
+# hot weight reload (stretch: training and serving share one deployment)
+# ---------------------------------------------------------------------------
+
+def test_hot_reload_pulls_fresh_center(fitted):
+    from distkeras_tpu.parameter_servers import (DeltaParameterServer,
+                                                 SocketParameterServer)
+    blob = serialize_model(fitted.model, fitted.params)
+    ps = SocketParameterServer(DeltaParameterServer(blob))
+    ps.start()
+    try:
+        eng = ServingEngine(_fitted(), num_slots=2, max_len=24)
+        eng.attach_ps("127.0.0.1", ps.port, every=1)
+        before = [w.copy() for w in eng.model.get_weights(eng.params)]
+        ps.ps.handle_commit(
+            {"delta": [np.ones_like(w) for w in blob["weights"]]})
+        eng.submit(PROMPT, 4)
+        eng.run_until_idle()
+        assert eng.stats["weight_reloads"] >= 1
+        after = eng.model.get_weights(eng.params)
+        assert any((np.asarray(a) != b).any()
+                   for a, b in zip(after, before))
+        eng.stop()
+    finally:
+        ps.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine-backed ModelPredictor route
+# ---------------------------------------------------------------------------
+
+def test_model_predictor_engine_route(fitted):
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.predictors import ModelPredictor
+
+    prompts = np.stack([PROMPT, PROMPT[::-1].copy(), (PROMPT + 1) % VOCAB])
+    ds = Dataset({"features": prompts})
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    pred = ModelPredictor(fitted, engine=eng, num_steps=5,
+                          generate_kwargs={"temperature": 0.6, "seed": 3})
+    out = pred.predict(ds)["prediction"]
+    assert out.shape == (3, len(PROMPT) + 5)
+    for row, prompt in zip(out, prompts):  # per-request generate parity
+        want = np.asarray(fitted.generate(
+            prompt[None], 5, temperature=0.6,
+            rng=jax.random.PRNGKey(3), max_len=24))[0]
+        np.testing.assert_array_equal(row, want)
+    assert eng._thread is None  # predictor stopped the thread it started
+
+
+def test_model_predictor_default_path_unchanged(fitted):
+    """No engine constructed → the original sharded-numpy forward, same
+    values as Sequential.predict (the defaults-bit-identical gate)."""
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.predictors import ModelPredictor
+
+    ds = Dataset({"features": np.stack([PROMPT, (PROMPT + 2) % VOCAB])})
+    out = ModelPredictor(fitted, mesh=None).predict(ds)["prediction"]
+    want = fitted.model.predict(fitted.params,
+                                np.asarray(ds["features"]))
+    np.testing.assert_array_equal(out, want)
+
+
+def test_model_predictor_engine_needs_num_steps(fitted):
+    from distkeras_tpu.predictors import ModelPredictor
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    with pytest.raises(ValueError, match="num_steps"):
+        ModelPredictor(fitted, engine=eng)
+
+
+# ---------------------------------------------------------------------------
+# per-row decode substrate (the satellite fix in core/decode.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("positional", ["learned", "rope"])
+def test_per_row_positions_match_scalar_decode(positional):
+    fm = _fitted(seed=2, positional=positional)
+    model, params = fm.model, fm.params
+    prompt = np.array([[3, 4, 5, 6], [7, 8, 9, 1]], np.int32)
+    want = np.asarray(fm.generate(prompt, 6, max_len=16))
+    caches = decode.init_cache(model, 2, 16)
+    logits, caches = decode._forward(model, params, caches,
+                                     jnp.asarray(prompt), 0)
+    tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    got = [tok]
+    pos = jnp.array([4, 4], jnp.int32)   # per-row vector, equal values
+    step = jax.jit(lambda p, c, t, q: decode.decode_step(model, p, c, t, q))
+    for i in range(5):
+        lg, caches = step(params, caches, tok, pos + i)
+        tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        got.append(tok)
+    np.testing.assert_array_equal(
+        np.stack([np.asarray(t) for t in got], 1), want[:, 4:])
+
+
+def test_per_row_positions_reject_multi_token_steps():
+    fm = _fitted(seed=2)
+    caches = decode.init_cache(fm.model, 2, 16)
+    with pytest.raises(ValueError, match="single-token"):
+        decode._forward(fm.model, fm.params, caches,
+                        jnp.zeros((2, 3), jnp.int32),
+                        jnp.array([0, 0], jnp.int32))
+
+
+def test_batched_sampler_matches_scalar_rows():
+    """sample_logits_batched row-for-row == sample_logits with that row's
+    scalar params (the engine's bit-identity substrate)."""
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.standard_normal((4, VOCAB)), jnp.float32)
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(4)])
+    positions = jnp.array([3, 9, 1, 7])
+    temp = jnp.array([0.0, 0.5, 0.7, 1.3], jnp.float32)
+    topk = jnp.array([0, 4, 4, 0], jnp.int32)
+    topp = jnp.array([0.0, 0.0, 0.9, 0.6], jnp.float32)
+    got = np.asarray(jax.jit(decode.sample_logits_batched)(
+        logits, positions, temp, keys, topk, topp))
+    for r in range(4):
+        want = decode.sample_logits(
+            logits[r:r + 1], int(positions[r]), float(temp[r]),
+            jax.random.PRNGKey(r),
+            int(topk[r]) or None,
+            float(topp[r]) or None)
+        assert got[r] == int(np.asarray(want)[0]), f"row {r}"
+
+
+def test_generate_unchanged_by_sampling_factor():
+    """The factored sample_logits left generate's defaults bit-identical:
+    two invocations and a pre/post-refactor spot value agree."""
+    fm = _fitted(seed=4)
+    a = np.asarray(fm.generate(PROMPT[None], 8, temperature=0.7, top_k=4,
+                               top_p=0.9, rng=jax.random.PRNGKey(0)))
+    b = np.asarray(fm.generate(PROMPT[None], 8, temperature=0.7, top_k=4,
+                               top_p=0.9, rng=jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(a, b)

@@ -270,6 +270,86 @@ def test_sparse_dense_equivalence_fuzz(codec):
             np.testing.assert_allclose(got, want, atol=1e-5)
 
 
+# serving-protocol messages ('q' enqueue / 'r' stream reply —
+# networking.SERVING_OP_ENQUEUE / SERVING_OP_STREAM): the request, ack,
+# backpressure, chunk, and final frames the serving server exchanges must
+# round-trip BOTH codec implementations unchanged (either end of a serving
+# connection may run either one).
+
+SERVING_FRAMES = [
+    {"prompt": np.array([3, 4, 5, 6], np.int32), "num_steps": 16,
+     "temperature": 0.7, "top_k": 5, "top_p": 0.9, "eos_id": 2,
+     "pad_id": 0, "seed": 11},
+    {"prompt": np.array([1], np.int32), "num_steps": 1},  # minimal request
+    {"ok": True, "id": 7},
+    {"ok": False, "error": "queue full"},                 # backpressure
+    {"id": 7, "tokens": np.array([9, 4, 1], np.int32), "done": False},
+    {"id": 7, "tokens": np.array([], np.int32), "done": True,
+     "finish": "eos", "row": np.array([3, 4, 5, 6, 9, 4, 1, 2], np.int32)},
+]
+
+
+def test_serving_frames_roundtrip_either_codec(codec):
+    assert len(networking.SERVING_OP_ENQUEUE) == 1
+    assert len(networking.SERVING_OP_STREAM) == 1
+    for frame in SERVING_FRAMES:
+        out = networking.decode_message(networking.encode_message(frame))
+        assert out.keys() == frame.keys()
+        for key, want in frame.items():
+            if isinstance(want, np.ndarray):
+                np.testing.assert_array_equal(out[key], want)
+                assert out[key].dtype == want.dtype
+            else:
+                assert out[key] == want and type(out[key]) is type(want)
+
+
+def test_serving_frames_pooled_socket_roundtrip_either_codec(codec):
+    """The serving wire pattern end to end: every frame kind through a
+    socket with pooled receive AND pooled send, twice (buffer reuse)."""
+    recv_pool = networking.BufferPool()
+    send_pool = networking.BufferPool()
+    a, b = socket.socketpair()
+    try:
+        for _ in range(2):
+            for frame in SERVING_FRAMES:
+                t = threading.Thread(target=networking.send_data,
+                                     args=(a, frame),
+                                     kwargs={"pool": send_pool})
+                t.start()
+                out = networking.recv_data(b, pool=recv_pool)
+                t.join()
+                assert out.keys() == frame.keys()
+    finally:
+        a.close()
+        b.close()
+    assert recv_pool.hits > 0 and send_pool.hits > 0
+
+
+def test_buffer_pool_concurrent_get_safe():
+    """BufferPool.get is thread-safe (the serving server's per-connection
+    reuse pattern has several threads alive against pools): concurrent
+    distinct-size acquisitions under an eviction-prone max_idle must not
+    corrupt the bookkeeping dicts or lose buffers."""
+    pool = networking.BufferPool(max_idle=4)
+    errors = []
+
+    def worker(wid):
+        try:
+            for i in range(300):
+                buf = pool.get(64 + (wid * 7 + i) % 16)
+                buf[0:1] = b"x"  # touch the buffer we were handed
+        except Exception as e:  # pragma: no cover - the failure under test
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert pool.hits + pool.misses == 8 * 300
+
+
 def test_native_rejects_u64_overflow_lengths(native):
     """Hostile u64 lengths that would wrap `off + blen` must terminate with
     'Truncated', not loop or return empty buffers."""
