@@ -1,0 +1,78 @@
+"""Apply-kernel resolution for the host-PS core (``apply_kernel=`` knob).
+
+The PS apply path reduces to two primitives — a dense in-place axpy
+(``center += scale * delta``) and a sequential scatter-add
+(``np.add.at(flat, indices, values)``, the sparse-commit and coalesced-drain
+workhorse).  ``csrc/applykernel.cpp`` provides native twins of both with
+**bit-identical** results (same rounding count, same accumulation order; the
+extension is compiled with ``-ffp-contract=off`` so no FMA collapses numpy's
+two roundings into one).
+
+Same build/fallback pattern as the wire codec: the extension is optional,
+the pure-NumPy path is the default AND the reference — ``apply_kernel=None``
+(or ``"numpy"``) never touches the native module, ``"native"`` requires it
+(loud error when unbuilt), ``"auto"`` uses it when importable and falls back
+silently (the bench-friendly setting).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+try:
+    from . import _applykernel as _native
+except ImportError:  # pragma: no cover - depends on build environment
+    _native = None
+
+#: the accepted ``apply_kernel=`` spellings
+KERNEL_CHOICES = (None, "numpy", "native", "auto")
+
+
+def have_native() -> bool:
+    return _native is not None
+
+
+def resolve(name: Optional[str]):
+    """Resolve an ``apply_kernel=`` knob value to the native module or None.
+
+    None / ``"numpy"`` → None (the pure-NumPy reference path);
+    ``"native"`` → the built extension, raising if it is absent;
+    ``"auto"`` → the extension when built, None otherwise.
+    """
+    if name in (None, "numpy"):
+        return None
+    if name == "auto":
+        return _native
+    if name == "native":
+        if _native is None:
+            raise RuntimeError(
+                "apply_kernel='native' but distkeras_tpu._applykernel is not "
+                "built — run `python setup.py build_ext --inplace` (or use "
+                "apply_kernel='auto' to fall back to numpy silently)")
+        return _native
+    raise ValueError(
+        f"apply_kernel must be one of {KERNEL_CHOICES}, got {name!r}")
+
+
+def axpy(kernel, dst: np.ndarray, src: np.ndarray, scale: float) -> None:
+    """``dst += scale * src`` over flat f32 arrays, through ``kernel`` when
+    given (bit-equal either way).  ``dst`` must be a writable f32 view."""
+    if kernel is not None:
+        kernel.axpy_f32(dst, np.ascontiguousarray(src, np.float32), scale)
+    elif scale == 1.0:
+        dst += src
+    else:
+        dst += scale * src
+
+
+def scatter_add(kernel, dst: np.ndarray, idx: np.ndarray,
+                vals: np.ndarray) -> None:
+    """``dst[idx[i]] += vals[i]`` in array order (``np.add.at`` semantics),
+    through ``kernel`` when given.  ``idx`` int64, ``vals``/``dst`` f32."""
+    if kernel is not None:
+        kernel.scatter_add_f32(dst, np.ascontiguousarray(idx, np.int64),
+                               np.ascontiguousarray(vals, np.float32))
+    else:
+        np.add.at(dst, idx, vals)

@@ -519,6 +519,237 @@ def read_frame(sock: socket.socket) -> bytes:
     return b"".join(parts)
 
 
+class FrameParser:
+    """Incremental parser for the PS opcode byte stream (the event-loop
+    server's receive path — ``parameter_servers.SocketParameterServer``).
+
+    A non-blocking connection hands every ``recv`` chunk to ``feed``;
+    ``messages()`` then yields each COMPLETE ``(opcode, message)`` pair
+    buffered so far (``message`` is None for frameless opcodes) and leaves
+    any trailing partial frame buffered for the next feed.
+
+    Zero-copy fast path: frames that arrive COMPLETE inside one fed chunk
+    (the steady state — a worker's whole commit in one recv) decode
+    straight over that chunk, so the decoded ndarrays are *views* into the
+    caller's receive buffer with the same lifetime contract as the pooled
+    ``recv_data`` path: valid until the caller reuses that memory (the
+    event loop consumes every drained commit before the connection's next
+    recv, so a per-connection pooled scratch is safe).  Only a frame torn
+    across chunks pays copies — its pieces accumulate in ``buf`` and the
+    reassembled frame is promoted to immutable bytes before decoding.
+
+    Validation mirrors ``recv_data``: magic, bounded header, and per-buffer
+    lengths checked against the dtype×shape the header declares — a
+    corrupt or hostile frame raises ``ValueError`` *before* any oversized
+    allocation, and the server drops the connection exactly as it does on
+    a torn frame today.
+    """
+
+    __slots__ = ("buf", "frame_ops", "_filled", "_need", "_src", "_off",
+                 "_retired")
+
+    def __init__(self, frame_ops: bytes = b"cu"):
+        self.frame_ops = frame_ops
+        # reassembly buffer for a frame torn across chunks: preallocated to
+        # the frame's total size as soon as the header has arrived, so a
+        # large frame streams into place (``writable``/``advance``) instead
+        # of growing a bytearray chunk by chunk
+        self.buf = bytearray()
+        self._filled = 0  # valid bytes in buf
+        self._need: Optional[int] = None  # total frame size, once measured
+        self._src = None  # current fast-path chunk (bytes or memoryview)
+        self._off = 0
+        # the last handed-off frame buffer, recycled for the next torn
+        # frame (steady-state same-size commits reassemble into the same
+        # memory — no per-frame allocate-and-zero).  Reuse rides the same
+        # lifetime contract as the fast path: the caller consumed the
+        # previous frame's views before feeding more bytes.
+        self._retired: Optional[bytearray] = None
+
+    def feed(self, data) -> None:
+        if self._src is not None:
+            # unconsumed fast-path tail from an abandoned messages() walk:
+            # fall back to reassembly before taking new bytes.  The tail
+            # may alias the retired buffer — drop that from the recycle
+            # slot so _append cannot be handed its own source memory.
+            tail = memoryview(self._src)[self._off:]
+            if len(tail):
+                self._retired = None
+                self._append(tail)
+            self._src = None
+        if self._filled:
+            self._append(data)
+        else:
+            self._src = data
+            self._off = 0
+
+    def writable(self) -> Optional[memoryview]:
+        """Direct-fill continuation: once a torn frame's total size is
+        known, the writable tail of the preallocated frame buffer —
+        ``recv_into`` it and report with ``advance(n)``, and the frame
+        streams kernel→buffer with no intermediate chunk copy (the
+        event-loop twin of ``_recv_exact_into``).  None while no torn
+        frame is pending (use ``feed``)."""
+        if (self._src is None and self._need is not None
+                and self._filled < self._need):
+            return memoryview(self.buf)[self._filled:self._need]
+        return None
+
+    def advance(self, n: int) -> None:
+        """Account ``n`` bytes received into the ``writable()`` view."""
+        self._filled += n
+
+    def messages(self):
+        while True:
+            item = self._next()
+            if item is None:
+                return
+            yield item
+
+    def _take_buffer(self, capacity: int) -> bytearray:
+        """A frame buffer of at least ``capacity`` bytes — the retired
+        previous frame buffer when it fits (its views were consumed before
+        this parser was fed again), else a fresh allocation."""
+        buf = self._retired
+        if buf is not None and len(buf) >= capacity:
+            self._retired = None
+            return buf
+        return bytearray(capacity)
+
+    def _append(self, data) -> None:
+        n = len(data)
+        need = self._filled + n
+        if len(self.buf) < need:
+            # allocate-and-swap (never resize in place: decoded views may
+            # still be keeping a previously handed-off buffer alive, and a
+            # preallocation below covers the whole frame in one step)
+            new = self._take_buffer(max(need, self._need or 0))
+            new[:self._filled] = memoryview(self.buf)[:self._filled]
+            self.buf = new
+        self.buf[self._filled:need] = data
+        self._filled = need
+
+    def _next(self):
+        if self._src is not None:
+            item, end = self._parse_one(memoryview(self._src), self._off)
+            if item is not None:
+                self._off = end
+                return item
+            # incomplete: keep only the torn tail, release the chunk (the
+            # caller is free to reuse its memory once messages() returns)
+            tail = memoryview(self._src)[self._off:]
+            if len(tail):
+                self._append(tail)
+            self._src = None
+            # fall through to measure the torn frame (sets _need so the
+            # caller can switch to the direct-fill path)
+        return self._next_reassembled()
+
+    def _next_reassembled(self):
+        """Reassembly path: measure the torn frame's total size from its
+        header (preallocating ``buf`` to it), and once complete hand the
+        buffer off to the fast path — ownership moves with it, so decoded
+        views never alias a buffer this parser will write to again."""
+        if not self._filled:
+            return None
+        buf = self.buf
+        op = bytes(buf[:1])
+        if op not in self.frame_ops:
+            del buf[:1]
+            self._filled -= 1
+            return op, None
+        if self._need is None:
+            if self._filled < 9:
+                return None
+            if buf[1:5] != MAGIC:
+                raise ValueError("Bad magic on wire message")
+            (hlen,) = _U32.unpack_from(buf, 5)
+            if hlen > MAX_HEADER_BYTES:
+                raise ValueError(f"Header too large: {hlen}")
+            if self._filled < 9 + hlen:
+                return None
+            header = json.loads(bytes(buf[9:9 + hlen]).decode())
+            self._need = 9 + hlen + self._payload_size(header)
+            if len(buf) < self._need:
+                new = self._take_buffer(self._need)
+                new[:self._filled] = memoryview(buf)[:self._filled]
+                self.buf = new
+        if self._filled < self._need:
+            return None
+        # complete: hand the buffer off and continue on the fast path.
+        # Retire it for recycling only when it holds nothing past this
+        # frame — a trailing next-frame fragment still aliases it (and
+        # will be copied out through _append, which must not be handed
+        # the same memory as its source).
+        self._src = memoryview(self.buf)[:self._filled]
+        self._off = 0
+        if self._filled == self._need:
+            self._retired = self.buf
+        self.buf = bytearray()
+        self._filled = 0
+        self._need = None
+        return self._next()
+
+    @staticmethod
+    def _payload_size(header: dict) -> int:
+        expected: dict = {}
+        _expected_buffer_sizes(header["tree"], expected)
+        payload = 0
+        for i in range(int(header["nbuf"])):
+            if i not in expected:
+                raise ValueError(
+                    f"header declares {header['nbuf']} buffers but "
+                    f"describes no buffer {i}")
+            payload += 8 + expected[i]
+        return payload
+
+    def _parse_one(self, mv, off):
+        """Parse one frame starting at ``off`` in immutable/stable memory.
+        Returns ``((op, msg), end)`` or ``(None, off)`` when incomplete;
+        raises ``ValueError`` on corruption.  Decoded ndarrays are views
+        over ``mv`` — no intermediate frame copy."""
+        n = len(mv)
+        if off >= n:
+            return None, off
+        op = bytes(mv[off:off + 1])
+        if op not in self.frame_ops:
+            return (op, None), off + 1
+        if n - off < 9:
+            return None, off
+        if bytes(mv[off + 1:off + 5]) != MAGIC:
+            raise ValueError("Bad magic on wire message")
+        (hlen,) = _U32.unpack_from(mv, off + 5)
+        if hlen > MAX_HEADER_BYTES:
+            raise ValueError(f"Header too large: {hlen}")
+        hdr_end = off + 9 + hlen
+        if n < hdr_end:
+            return None, off
+        header = json.loads(bytes(mv[off + 9:hdr_end]).decode())
+        expected: dict = {}
+        _expected_buffer_sizes(header["tree"], expected)
+        payload = 0
+        nbuf = int(header["nbuf"])
+        for i in range(nbuf):
+            if i not in expected:
+                raise ValueError(
+                    f"header declares {nbuf} buffers but describes no "
+                    f"buffer {i}")
+            payload += 8 + expected[i]
+        end = hdr_end + payload
+        if n < end:
+            return None, off
+        views = decode_payload(mv[hdr_end:end])
+        if len(views) != nbuf:
+            raise ValueError(
+                f"{len(views)} buffers on wire, header declares {nbuf}")
+        for i, v in enumerate(views):
+            if v.nbytes != expected.get(i, -1):
+                raise ValueError(
+                    f"buffer {i} carries {v.nbytes} bytes, header expects "
+                    f"{expected.get(i)}")
+        return (op, _decode_node(header["tree"], views, copy=False)), end
+
+
 #: Serving-protocol opcodes (``serving.ServingServer`` — its OWN opcode
 #: namespace on its own port; the PS protocol's ``'q'`` quit is unrelated):
 #: ``'q'`` enqueue request (frame follows; server acks or backpressures),

@@ -17,12 +17,33 @@ with each worker thread driving jitted window steps on its device.  Update
 rules mirror the pure functions in ``parallel/rules.py``, applied here as
 in-place numpy loops on flat weight lists for commit-path speed;
 tests/test_host_ps.py asserts the two implementations agree.
+
+The server core (PR 7) is **event-driven**: one I/O thread multiplexes every
+worker connection over a selector (``SocketParameterServer``), and commits
+that arrive while an apply is in flight are **coalesced** — applied as one
+batch per drain, with runs of sparse commits merged into ONE vectorized
+scatter-add (the classic server-side aggregation the PS scaling results
+hinge on: Dean et al. NIPS 2012; Li et al. OSDI 2014).  The seed-era
+thread-per-connection core is retained as ``ThreadedSocketParameterServer``
+(``ps_core="threaded"``) for the before/after worker-scaling bench.
+Coalescing semantics per algorithm (docs/host_ps.md):
+
+ - DOWNPOUR / the elastic family: commits within a drain apply in arrival
+   order with per-commit arithmetic unchanged, so a coalesced drain is
+   BIT-equal to the same commits applied sequentially (sums commute, and
+   the accumulation order is preserved per coordinate).
+ - ADAG: same — the 1/num_workers scale is clock-independent.
+ - DynSGD: staleness is stamped at ENQUEUE (the commit's arrival at the
+   server), not at apply: commits coalesced into one drain do not count
+   each other as staleness.  Single-worker runs are bit-identical (a
+   strict request/reply worker never has two commits in one drain).
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import selectors
 import socket
 import threading
 from typing import Any, Dict, List, Optional
@@ -30,7 +51,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
-from . import networking
+from . import applykernel, networking
 from .core.model import FittedModel, deserialize_model, serialize_model
 from .ps_sharding import PSShardDown, ShardedServerGroup
 from .workers import WORKER_CLASSES, share_compiled_state
@@ -44,50 +65,78 @@ def _as_f32(delta):
     return [np.asarray(d).astype(np.float32, copy=False) for d in delta]
 
 
-def _scatter_add(center: List[np.ndarray], sp: "networking.SparseDelta",
-                 scale: float = 1.0) -> None:
-    """Apply a k-sparse flat delta to a tensor list: O(k) scatter-add.
-
-    ``sp`` indexes the concatenation of ``center`` (C-order flat, list
-    order); indices are validated against the dense length so a hostile or
-    mis-split commit raises instead of corrupting neighbouring tensors.
-    Sorted indices are bisected once over the tensor offsets, then each
-    tensor gets one ``np.add.at`` over its contiguous index run — the
-    whole apply touches k coordinates, not the n-element center.
-    """
+def _flat_offsets(center: List[np.ndarray]):
+    """(per-tensor flat offsets, total elements) of the concatenated list."""
     sizes = np.array([int(c.size) for c in center], np.int64)
     offsets = np.concatenate(([0], np.cumsum(sizes)))
-    total = int(offsets[-1])
+    return offsets, int(offsets[-1])
+
+
+def _validate_sparse(sp: "networking.SparseDelta", total: int,
+                     scale: float = 1.0):
+    """One sparse commit's (sorted int64 indices, scaled f32 values),
+    validated against the dense length — the per-commit normalization of
+    ``_scatter_add``, factored out so a coalesced drain can concatenate
+    many commits into one scatter-add with unchanged per-commit arithmetic
+    (each commit is sorted/scaled exactly as its sequential apply would)."""
     if sp.length != total:
         raise ValueError(
             f"sparse commit declares dense length {sp.length}, center "
             f"has {total} elements")
     idx = sp.indices.astype(np.int64, copy=False)
     vals = sp.f32_values()
-    if idx.size == 0:
-        return
-    if np.any(np.diff(idx) < 0):  # tolerate unsorted senders
-        order = np.argsort(idx, kind="stable")
-        idx, vals = idx[order], vals[order]
-    if idx[0] < 0 or idx[-1] >= total:
-        raise ValueError(
-            f"sparse commit index out of range for dense length {total}")
+    if idx.size:
+        if np.any(np.diff(idx) < 0):  # tolerate unsorted senders
+            order = np.argsort(idx, kind="stable")
+            idx, vals = idx[order], vals[order]
+        if idx[0] < 0 or idx[-1] >= total:
+            raise ValueError(
+                f"sparse commit index out of range for dense length {total}")
     if scale != 1.0:
         vals = vals * np.float32(scale)
+    return idx, vals
+
+
+def _scatter_flat(center: List[np.ndarray], offsets: np.ndarray,
+                  idx: np.ndarray, vals: np.ndarray, kernel=None) -> None:
+    """One scatter-add of (sorted flat indices, f32 values) over the tensor
+    list: the sorted indices are bisected once over the tensor offsets, then
+    each tensor gets one sequential scatter-add (``np.add.at`` or the native
+    kernel — bit-identical) over its contiguous index run."""
     bounds = np.searchsorted(idx, offsets)
     for t in range(len(center)):
         lo, hi = int(bounds[t]), int(bounds[t + 1])
         if lo == hi:
             continue
         flat = center[t].reshape(-1)  # view: center tensors are contiguous
-        np.add.at(flat, idx[lo:hi] - int(offsets[t]), vals[lo:hi])
+        applykernel.scatter_add(kernel, flat, idx[lo:hi] - int(offsets[t]),
+                                vals[lo:hi])
+
+
+def _scatter_add(center: List[np.ndarray], sp: "networking.SparseDelta",
+                 scale: float = 1.0, kernel=None) -> None:
+    """Apply a k-sparse flat delta to a tensor list: O(k) scatter-add.
+
+    ``sp`` indexes the concatenation of ``center`` (C-order flat, list
+    order); indices are validated against the dense length so a hostile or
+    mis-split commit raises instead of corrupting neighbouring tensors.
+    The whole apply touches k coordinates, not the n-element center;
+    ``kernel`` routes the inner scatter through the native apply kernel
+    (``csrc/applykernel.cpp``) when enabled — bit-identical results.
+    """
+    offsets, total = _flat_offsets(center)
+    idx, vals = _validate_sparse(sp, total, scale)
+    if idx.size == 0:
+        return
+    _scatter_flat(center, offsets, idx, vals, kernel)
 
 
 class ParameterServer:
     """Base PS (reference: ``parameter_servers.py :: ParameterServer``):
     holds the center weights + the update clock."""
 
-    def __init__(self, model_blob: dict):
+    def __init__(self, model_blob: dict,
+                 apply_kernel: Optional[str] = None):
         self.model_blob = model_blob
         self.center: List[np.ndarray] = [
             np.array(w, dtype=np.float32, copy=True)
@@ -97,6 +146,13 @@ class ParameterServer:
         # bookkeeping lives behind SocketParameterServer's own lock, so N
         # workers' commits never serialize behind accept/teardown state.
         self._lock = threading.Lock()
+        # apply_kernel= knob (docs/API.md): None/'numpy' keeps the pure-
+        # NumPy apply (the default and the bit-equality reference),
+        # 'native' requires csrc/applykernel.cpp, 'auto' uses it if built.
+        # Resolved eagerly so a bad name / missing build fails loudly at
+        # construction, not mid-training under the apply lock.
+        self.apply_kernel = apply_kernel
+        self._kernel = applykernel.resolve(apply_kernel)
 
     def initialize(self):
         """Reference-parity hook (center is built in __init__ here)."""
@@ -110,27 +166,96 @@ class ParameterServer:
             {"model": self.model_blob["model"], "weights": self.center})
         return FittedModel(model, params)
 
-    # -- the per-algorithm apply rule (subclasses override _apply) -----------
+    # -- the per-algorithm apply rule (subclasses override _scale) -----------
+    def _scale(self, msg: Dict[str, Any]) -> float:
+        """The scalar every rule reduces one commit to (called with
+        ``_lock`` HELD).  This reduction is what lets sparsity AND drain
+        coalescing compose with all the rules: a drain pre-computes each
+        commit's scale, then applies the batch with per-commit arithmetic
+        unchanged."""
+        raise NotImplementedError
+
     def _apply(self, msg: Dict[str, Any]):
         """Apply one commit to the center.  Called with ``_lock`` HELD."""
-        raise NotImplementedError
+        self._apply_scaled(msg, self._scale(msg))
 
     def _apply_scaled(self, msg: Dict[str, Any], scale: float):
         """Shared commit arithmetic: ``center += scale * delta`` for a dense
         tensor list, or an O(k) scatter-add for a k-sparse commit
         (``networking.SparseDelta`` — the ``wire_dtype="topk"`` wire form).
         Every rule reduces to a scalar ``scale``, so sparsity composes with
-        all of them under the same apply lock."""
+        all of them under the same apply lock.  With ``apply_kernel`` the
+        dense axpy and the sparse scatter run through the native kernel —
+        bit-identical to the numpy path (tests/test_applykernel.py)."""
         delta = msg["delta"]
         if isinstance(delta, networking.SparseDelta):
-            _scatter_add(self.center, delta, scale)
-        elif scale == 1.0:
-            for c, d in zip(self.center, _as_f32(delta)):
-                c += d
+            _scatter_add(self.center, delta, scale, self._kernel)
         else:
             for c, d in zip(self.center, _as_f32(delta)):
-                c += scale * d
+                applykernel.axpy(self._kernel, c.reshape(-1),
+                                 d.reshape(-1), scale)
         self.next_update()
+
+    # -- coalesced drains (the event-driven core's batch apply) --------------
+    def apply_drain(self, msgs: List[Dict[str, Any]]) -> int:
+        """Apply transport-decoded commit messages in ARRIVAL ORDER under
+        ONE lock acquisition, merging runs of consecutive sparse commits
+        into one vectorized scatter-add.  Returns the clock after the
+        drain.  Semantics per algorithm (module docstring + docs/host_ps.md):
+        DOWNPOUR/ADAG coalesced results are bit-equal to the same commits
+        applied sequentially; DynSGD prices staleness from each commit's
+        ``_arrival`` stamp (set at enqueue by the event server) instead of
+        the mid-drain clock."""
+        with self._lock:
+            self._apply_drain_locked(msgs)
+            return self.num_updates
+
+    def _apply_drain_locked(self, msgs: List[Dict[str, Any]]):
+        i, n = 0, len(msgs)
+        while i < n:
+            if isinstance(msgs[i].get("delta"), networking.SparseDelta):
+                j = i + 1
+                while j < n and isinstance(msgs[j].get("delta"),
+                                           networking.SparseDelta):
+                    j += 1
+                self._apply_sparse_run_locked(msgs[i:j])
+                i = j
+            else:
+                # dense commits apply in arrival order with per-commit
+                # arithmetic (one axpy per tensor) — pre-summing deltas
+                # would re-round the accumulation and break the DOWNPOUR
+                # bit-equality contract; the coalescing win here is one
+                # lock acquisition and ONE reply snapshot per drain
+                self._apply(msgs[i])
+                i += 1
+
+    def _apply_sparse_run_locked(self, msgs: List[Dict[str, Any]]):
+        """A run of consecutive sparse commits as ONE scatter-add: each
+        commit is sorted/scaled exactly as its sequential apply would be,
+        the segments are concatenated, and a STABLE argsort merges them —
+        stability keeps every coordinate's additions in arrival order, so
+        the float accumulation (and hence the result) is bit-identical to
+        applying the commits one by one."""
+        if len(msgs) == 1:
+            self._apply(msgs[0])
+            return
+        offsets, total = _flat_offsets(self.center)
+        parts_i, parts_v = [], []
+        for m in msgs:
+            # scale BEFORE bumping the clock for this commit — the exact
+            # sequence of the sequential path (DynSGD's fallback baseline
+            # reads num_updates when no _arrival stamp is present)
+            idx, vals = _validate_sparse(m["delta"], total, self._scale(m))
+            parts_i.append(idx)
+            parts_v.append(vals)
+            self.next_update()
+        idx = np.concatenate(parts_i)
+        vals = np.concatenate(parts_v)
+        if idx.size == 0:
+            return
+        order = np.argsort(idx, kind="stable")
+        _scatter_flat(self.center, offsets, idx[order], vals[order],
+                      self._kernel)
 
     def handle_commit(self, msg: Dict[str, Any]):
         with self._lock:
@@ -165,8 +290,8 @@ class DeltaParameterServer(ParameterServer):
     the elastic family's PS; for EASGD the committed 'delta' is the elastic
     term, so the same rule applies)."""
 
-    def _apply(self, msg):
-        self._apply_scaled(msg, 1.0)
+    def _scale(self, msg):
+        return 1.0
 
 
 class ADAGParameterServer(ParameterServer):
@@ -175,28 +300,45 @@ class ADAGParameterServer(ParameterServer):
     applying — the per-commit form of ``rules.adag_commit`` (which divides
     the cross-worker sum by the worker count)."""
 
-    def __init__(self, model_blob, num_workers: int):
-        super().__init__(model_blob)
+    def __init__(self, model_blob, num_workers: int,
+                 apply_kernel: Optional[str] = None):
+        super().__init__(model_blob, apply_kernel=apply_kernel)
         self.num_workers = max(int(num_workers), 1)
 
-    def _apply(self, msg):
-        self._apply_scaled(msg, 1.0 / self.num_workers)
+    def _scale(self, msg):
+        return 1.0 / self.num_workers
 
 
 class DynSGDParameterServer(ParameterServer):
     """Staleness-aware apply (reference: ``DynSGDParameterServer``):
     center += delta / (staleness + 1), where staleness = updates that landed
     since this worker's last pull (the commit's ``clock`` field) — exactly
-    ``rules.dynsgd_commit``."""
+    ``rules.dynsgd_commit``.
 
-    def _apply(self, msg):
-        staleness = max(self.num_updates - int(msg.get("clock", 0)), 0)
-        self._apply_scaled(msg, 1.0 / (staleness + 1.0))
+    Coalescing ordering rule (docs/host_ps.md): the staleness baseline is
+    the ``_arrival`` stamp the event server sets when the commit is
+    ENQUEUED, so commits coalesced into one drain do not count each other
+    as staleness — the drain prices every member against the clock it
+    actually arrived at.  Without a stamp (direct calls, the threaded
+    core) the baseline falls back to the live clock: the exact sequential
+    semantics of the seed-era server, bit for bit."""
+
+    def _scale(self, msg):
+        baseline = int(msg.get("_arrival", self.num_updates))
+        staleness = max(baseline - int(msg.get("clock", 0)), 0)
+        return 1.0 / (staleness + 1.0)
 
 
-class SocketParameterServer:
-    """TCP accept-loop wrapper around a ParameterServer (reference:
+class ThreadedSocketParameterServer:
+    """The seed-era thread-per-connection PS core (reference:
     ``SocketParameterServer.run`` — thread per connection, opcode dispatch).
+
+    Retained behind ``ps_core="threaded"`` as the before/after baseline for
+    the ``host_ps_worker_scaling`` bench: one handler thread per worker
+    connection, one apply-lock acquisition and one full center snapshot per
+    commit.  Structurally wrong at large worker counts — N threads churn
+    the GIL and every 'u' pays an O(n) copy — which is exactly what the
+    event-driven ``SocketParameterServer`` replaces.
 
     Composition instead of inheritance so the apply rules above stay pure-ish
     and unit-testable without sockets.
@@ -333,6 +475,14 @@ class SocketParameterServer:
     def get_model(self) -> FittedModel:
         return self.ps.get_model()
 
+    def respawn_clone(self, ps: ParameterServer
+                      ) -> "ThreadedSocketParameterServer":
+        """A same-core replacement server on this address with the
+        generation bumped (resilience.ShardSupervisor.respawn_shard)."""
+        return ThreadedSocketParameterServer(
+            ps, host=self.host, port=self.port,
+            generation=self.generation + 1)
+
     # -- service loops -------------------------------------------------------
     def _accept_loop(self):
         while True:
@@ -446,6 +596,608 @@ class SocketParameterServer:
                 self._conn_of.pop(me, None)
 
 
+#: event-loop receive chunk: big enough that a steady-state commit frame
+#: lands complete in ONE recv (the parser's zero-copy fast path); frames
+#: larger than this reassemble through the parser accumulator (correct,
+#: just pays copies — docs/TUNING.md)
+_RECV_CHUNK = 1 << 20
+
+
+class _EventConn:
+    """Per-connection state on the event loop: a pooled receive scratch
+    (``recv_into`` lands every chunk in the same reused memory — no
+    per-recv allocation), the incremental frame parser decoding zero-copy
+    views over that scratch, and the pending-write queue with its encode
+    pool (replies re-serialize into reusable pooled memory).
+
+    Lifetime contract for the decoded views: the loop drains every parsed
+    request at the end of the SAME iteration that read it, and the next
+    ``recv_into`` on this connection can only happen in a later iteration
+    — so the scratch is never overwritten under a live commit.  This is
+    the pooled-``recv_data`` contract, per connection."""
+
+    __slots__ = ("sock", "parser", "out", "recv_pool", "send_pool",
+                 "want_write")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.parser = networking.FrameParser()
+        self.out: List[memoryview] = []
+        self.recv_pool = networking.BufferPool()
+        self.send_pool = networking.BufferPool()
+        self.want_write = False
+
+
+class SocketParameterServer:
+    """The event-driven PS core: ONE I/O thread multiplexes every worker
+    connection over a selector (the ``ChaosProxy``-style frame relay idiom,
+    turned into the server), with per-connection read/write buffers and
+    commit COALESCING.
+
+    Protocol, reply shapes, generation handshake, heartbeat semantics, and
+    torn-frame policy are identical to ``ThreadedSocketParameterServer`` —
+    the full resilience/elastic/chaos test matrix runs unchanged on this
+    core.  What changes is the execution shape:
+
+     - **No thread per connection.**  Accepting, reading, parsing, applying,
+       and replying all happen on one thread driving a ``selectors``
+       event loop; hundreds of workers cost hundreds of registered fds,
+       not hundreds of Python threads fighting the GIL.
+     - **Coalesced applies.**  Commits that arrive while an apply is in
+       flight accumulate in the kernel's socket buffers; the next loop
+       iteration parses them all and applies them as ONE drain — one apply-
+       lock acquisition, runs of sparse commits merged into one vectorized
+       scatter-add (``ParameterServer.apply_drain``), and the post-drain
+       center serialized ONCE with every 'u' reply in the drain sharing
+       the same encoded frame (the seed core paid an O(n) snapshot copy
+       plus an O(n) encode per commit).  Ordering: commits apply in arrival
+       order; DOWNPOUR/ADAG drains are bit-equal to sequential applies,
+       DynSGD stamps staleness at enqueue (class docstrings +
+       docs/host_ps.md).  ``coalesce=False`` degrades every drain to
+       batches of one with a per-commit snapshot — the sequential
+       semantics, still on the event loop.
+     - **Heartbeats still probe the apply.**  'h' is answered by the same
+       thread that applies, after everything queued before it — a server
+       wedged inside an apply answers no probe, exactly the property
+       ``resilience.ShardSupervisor`` detects wedges by.
+
+    An apply-rule error (hostile shapes, mis-split sparse commit) is logged
+    with its traceback and costs the offending drain's connections — the
+    loop itself survives, where the threaded core sacrificed one handler
+    thread.  ``_conn_threads`` is kept as an (always empty) attribute for
+    callers that assert the seed core's per-connection threads unwound.
+    """
+
+    def __init__(self, ps: ParameterServer, host: str = "127.0.0.1",
+                 port: int = 0, generation: int = 0, coalesce: bool = True):
+        self.ps = ps
+        self.host = host
+        self.port = port  # 0 → ephemeral; real port set by start()
+        # recovery epoch (resilience.ShardSupervisor): bumped on every
+        # respawn of this address; replies carry it, older-generation
+        # commits are rejected (the epoch/generation handshake)
+        self.generation = int(generation)
+        self.coalesce = bool(coalesce)
+        self._server: Optional[socket.socket] = None
+        self._selector: Optional[selectors.BaseSelector] = None
+        self._waker: Optional[tuple] = None  # (recv side, send side)
+        #: the I/O thread.  The name is load-bearing: the shard
+        #: supervisor's liveness check reads ``_accept_thread.is_alive()``
+        #: on either core.
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: Dict[socket.socket, _EventConn] = {}
+        self._conn_lock = threading.Lock()  # guards _conns/_running
+        self._conn_threads: List[threading.Thread] = []  # event core: none
+        # server-level pool for the drain's SHARED 'u' reply frame (every
+        # connection in a drain queues a view of the same encoded bytes)
+        self._reply_pool = networking.BufferPool()
+        self._running = False
+        #: coalescing observability (bench host_ps_worker_scaling + tests):
+        #: drains = commit batches applied, commits_applied = commits in
+        #: them, coalesced_drains = drains that merged >= 2, max_drain =
+        #: largest batch
+        self.drains = 0
+        self.commits_applied = 0
+        self.coalesced_drains = 0
+        self.max_drain = 0
+
+    @property
+    def coalesce_stats(self) -> Dict[str, Any]:
+        return {"drains": self.drains,
+                "commits_applied": self.commits_applied,
+                "coalesced_drains": self.coalesced_drains,
+                "max_drain": self.max_drain,
+                "mean_drain": (round(self.commits_applied
+                                     / self.drains, 3)
+                               if self.drains else None)}
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        self.ps.initialize()
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((self.host, self.port))
+        self.port = self._server.getsockname()[1]
+        self._server.listen(128)
+        self._server.setblocking(False)
+        # the waker: a socketpair registered in the selector.  stop()/
+        # crash() write one byte to interrupt a blocked select() — no
+        # self-connection through the public listener required.
+        r, w = socket.socketpair()
+        r.setblocking(False)
+        self._waker = (r, w)
+        self._selector = selectors.DefaultSelector()
+        self._selector.register(self._server, selectors.EVENT_READ, None)
+        self._selector.register(r, selectors.EVENT_READ, None)
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._io_loop, daemon=True, name="dkt-ps-io")
+        self._accept_thread.start()
+
+    def _wake(self):
+        if self._waker is not None:
+            try:
+                self._waker[1].send(b"\0")
+            except OSError:
+                pass
+
+    def stop(self, join_timeout: float = 5.0):
+        """Idempotent shutdown, entirely through the event loop.
+
+        The seed core had to wake its blocked ``accept()`` with a
+        self-connection to its own port (closing an fd from another thread
+        does not reliably interrupt ``accept`` on Linux); the event core
+        needs no such hack — the loop blocks in ``select()`` over a
+        socketpair waker, so stop() writes one byte, the loop wakes,
+        drains the selector, flushes every connection's pending write
+        buffer (bounded best-effort), and closes every registered
+        connection plus the listener itself.
+
+        A loop that outlives ``join_timeout`` is wedged inside an apply
+        (not I/O — the loop never blocks on a socket).  The leak is logged
+        and every connection plus the listener is force-closed from here,
+        so the wedged thread fails fast on its next socket op and a
+        same-address respawn is not blocked by the old listener.
+        """
+        with self._conn_lock:
+            was_running = self._running
+            self._running = False
+        self._wake()
+        t = self._accept_thread
+        if t is not None:
+            t.join(timeout=join_timeout)
+            if t.is_alive():
+                logger.warning(
+                    "PS I/O thread %s still alive after stop(join_timeout="
+                    "%.1fs) — likely wedged in an apply; force-closing its "
+                    "connections and listener and leaving it to die "
+                    "detached", t.name, join_timeout)
+                with self._conn_lock:
+                    conns = list(self._conns.values())
+                    self._conns.clear()
+                for conn in conns:
+                    try:
+                        conn.sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        conn.sock.close()
+                    except OSError:
+                        pass
+        # belt and braces: the loop's own shutdown closes these; after a
+        # crash()/wedge they may still be open
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        if was_running is False and t is not None and not t.is_alive():
+            self._close_waker()
+
+    def _close_waker(self):
+        if self._waker is not None:
+            for s in self._waker:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._waker = None
+
+    @property
+    def live_connections(self) -> int:
+        """Registered worker connections — the bookkeeping a half-frame
+        worker death must decrement (a dying worker's torn commit drops
+        its connection silently: no codec error escapes the loop, no
+        registration leaks; tests/test_elastic_workers.py)."""
+        with self._conn_lock:
+            return len(self._conns)
+
+    def crash(self):
+        """Abrupt-death simulation (chaos/bench hook): close the listener
+        and every connection with no graceful shutdown, no flush, no final
+        state — the in-process analogue of a SIGKILLed shard.  The
+        in-memory center is deliberately abandoned; recovery must come
+        from the last journal snapshot (resilience.ShardSupervisor), the
+        bounded-loss contract under test.  The port is released
+        immediately so a same-address respawn can bind."""
+        with self._conn_lock:
+            self._running = False
+            conns = list(self._conns.values())
+            self._conns.clear()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        for conn in conns:
+            networking._hard_close(conn.sock)
+        self._wake()
+
+    def get_model(self) -> FittedModel:
+        return self.ps.get_model()
+
+    def respawn_clone(self, ps: ParameterServer) -> "SocketParameterServer":
+        """A same-core replacement server on this address with the
+        generation bumped and the coalescing knob carried over
+        (resilience.ShardSupervisor.respawn_shard)."""
+        return SocketParameterServer(ps, host=self.host, port=self.port,
+                                     generation=self.generation + 1,
+                                     coalesce=self.coalesce)
+
+    # -- the event loop ------------------------------------------------------
+    def _io_loop(self):
+        sel = self._selector
+        entries: List[tuple] = []
+        try:
+            while True:
+                with self._conn_lock:
+                    if not self._running:
+                        return
+                try:
+                    events = sel.select(timeout=None)
+                except OSError:
+                    # fds hard-closed under us (crash()); re-check and exit
+                    continue
+                del entries[:]
+                for key, mask in events:
+                    if key.fileobj is self._server:
+                        self._accept_ready()
+                    elif (self._waker is not None
+                          and key.fileobj is self._waker[0]):
+                        try:
+                            self._waker[0].recv(4096)
+                        except OSError:
+                            pass
+                    else:
+                        conn = key.data
+                        if conn is None:
+                            continue
+                        if mask & selectors.EVENT_WRITE:
+                            self._flush(conn)
+                        if mask & selectors.EVENT_READ:
+                            self._read_ready(conn, entries)
+                if entries:
+                    self._process_drain(entries)
+        finally:
+            self._shutdown_io()
+
+    def _accept_ready(self):
+        while True:
+            try:
+                sock, _ = self._server.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            with self._conn_lock:
+                if not self._running:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    return
+                try:
+                    sock.setblocking(False)
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                except OSError:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    continue
+                conn = _EventConn(sock)
+                self._conns[sock] = conn
+            try:
+                self._selector.register(sock, selectors.EVENT_READ, conn)
+            except (KeyError, ValueError, OSError):
+                self._drop(conn)
+
+    def _drop(self, conn: _EventConn):
+        """Silent connection teardown (EOF, torn frame, protocol
+        violation, send fault) — the reference policy: the server keeps
+        serving the others, bookkeeping decrements."""
+        with self._conn_lock:
+            self._conns.pop(conn.sock, None)
+        try:
+            self._selector.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        del conn.out[:]
+
+    def _read_ready(self, conn: _EventConn, entries: List[tuple]):
+        while True:
+            # direct-fill continuation first: a frame torn across recvs
+            # streams straight into the parser's preallocated frame buffer
+            # (no chunk copy); otherwise land the bytes in the pooled
+            # scratch and let the parser decode zero-copy views over it
+            target = conn.parser.writable()
+            fed_scratch = target is None
+            if fed_scratch:
+                target = memoryview(conn.recv_pool.get(_RECV_CHUNK))
+            try:
+                n = conn.sock.recv_into(target)
+            except (BlockingIOError, InterruptedError):
+                return
+            except (ConnectionError, OSError):
+                self._drop(conn)
+                return
+            if not n:
+                self._drop(conn)  # EOF; a partial frame dropped silently
+                return
+            if fed_scratch:
+                conn.parser.feed(target[:n])
+            else:
+                conn.parser.advance(n)
+            got = False
+            try:
+                for op, msg in conn.parser.messages():
+                    got = True
+                    if op in (b"c", b"u"):
+                        msg = self._decode_commit(msg)
+                        gen = (msg.get("gen") if isinstance(msg, dict)
+                               else None)
+                        stale = (gen is not None
+                                 and int(gen) != self.generation)
+                        if stale and op == b"c":
+                            continue  # dropped: bounded loss, no reply owed
+                        if not stale and isinstance(msg, dict):
+                            # the DynSGD ordering rule: staleness is priced
+                            # against the clock at ENQUEUE, so commits
+                            # coalesced into one drain don't count each
+                            # other
+                            msg["_arrival"] = self.ps.num_updates
+                        entries.append((conn, op, msg, stale))
+                    elif op in (b"p", b"h"):
+                        entries.append((conn, op, None, False))
+                    else:  # b"q" quit, or protocol violation: drop either
+                        self._drop(conn)
+                        return
+            except ValueError:
+                self._drop(conn)  # torn/corrupt frame: drop the connection
+                return
+            if got:
+                # parsed requests may be zero-copy views into this round's
+                # scratch — stop before the next recv can overwrite them
+                # (the drain at this iteration's end consumes them; a
+                # level-triggered selector re-arms for what's left)
+                return
+
+    @staticmethod
+    def _decode_commit(msg):
+        """Transport-boundary decompression, identical to the threaded
+        core: int8 codes × per-tensor scales → f32 deltas; sparse top-k
+        values dequantized to f32 — every PS rule sees ordinary floats."""
+        if isinstance(msg, dict) and "scales" in msg:
+            msg["delta"] = [
+                np.asarray(q, np.float32) * s
+                for q, s in zip(msg["delta"], msg.pop("scales"))]
+        elif (isinstance(msg, dict)
+              and isinstance(msg.get("delta"), networking.SparseDelta)):
+            msg["delta"] = msg["delta"].decoded()
+        return msg
+
+    # -- drain processing ----------------------------------------------------
+    def _process_drain(self, entries: List[tuple]):
+        """One event-loop iteration's parsed requests, in arrival order.
+        Maximal runs of commits become coalesced apply batches; pulls and
+        heartbeats between them snapshot at their own arrival point."""
+        replies: List[tuple] = []
+        i, n = 0, len(entries)
+        while i < n:
+            conn, op, msg, stale = entries[i]
+            if op in (b"c", b"u"):
+                j = i
+                batch = []
+                while j < n and entries[j][1] in (b"c", b"u"):
+                    batch.append(entries[j])
+                    j += 1
+                if self.coalesce:
+                    self._apply_batch(batch, replies)
+                else:
+                    for e in batch:  # sequential semantics, per-commit
+                        self._apply_batch([e], replies)
+                i = j
+            elif op == b"p":
+                reply = self.ps.handle_pull()
+                reply["gen"] = self.generation
+                replies.append((conn, reply))
+                i += 1
+            else:  # b"h": through the apply path, as the threaded core's
+                # heartbeat went through the apply lock — a wedged apply
+                # blocks this loop and the probe times out
+                reply = self.ps.handle_heartbeat()
+                reply["gen"] = self.generation
+                replies.append((conn, reply))
+                i += 1
+        for conn, obj in replies:
+            self._queue_reply(conn, obj)
+
+    def _apply_batch(self, batch: List[tuple], replies: List[tuple]):
+        """Apply one commit batch under ONE lock acquisition and serialize
+        the center ONCE for every 'u' reply in it.  The shared post-drain
+        center is each commit's own result plus any commits that landed in
+        the same drain — a strictly fresher center of the same bounded-
+        staleness class the async rules already tolerate (docs/host_ps.md).
+
+        The reply is encoded straight from the live center *under the
+        apply lock* — the encoded frame IS the snapshot, so a drain pays
+        one O(n) serialization total where the threaded core pays a
+        snapshot copy plus an encode per commit.  The shared bytes are
+        immutable; every involved connection queues a view of the same
+        frame."""
+        live = [e[2] for e in batch if not e[3]]
+        pulls = [e for e in batch if e[1] == b"u"]
+        encoded = encoded_stale = None
+        try:
+            with self.ps._lock:
+                if live:
+                    self.ps._apply_drain_locked(live)
+                if pulls:
+                    reply = {"weights": self.ps.center,
+                             "clock": self.ps.num_updates,
+                             "gen": self.generation}
+                    if any(not e[3] for e in pulls):
+                        encoded = self._encode_shared(reply)
+                    if any(e[3] for e in pulls):
+                        reply["stale"] = True
+                        encoded_stale = networking.encode_message(reply)
+        except Exception:
+            # a hostile/mis-split commit must not kill the loop (the
+            # threaded core sacrificed one handler thread; here the
+            # offending drain's connections pay instead)
+            logger.exception(
+                "PS apply failed for a drain of %d commits; dropping the "
+                "%d involved connections", len(live),
+                len({id(e[0]) for e in batch}))
+            for e in batch:
+                self._drop(e[0])
+            return
+        if live:
+            self.drains += 1
+            self.commits_applied += len(live)
+            if len(live) >= 2:
+                self.coalesced_drains += 1
+            self.max_drain = max(self.max_drain, len(live))
+        for conn, op, msg, stale in pulls:
+            replies.append((conn, encoded_stale if stale else encoded))
+
+    def _encode_shared(self, reply) -> memoryview:
+        """Serialize the drain's shared 'u' reply, into the server-level
+        pooled buffer when it is provably free — i.e. no connection holds
+        a pending (possibly pooled) write — else into fresh bytes.  In
+        steady state replies flush synchronously (loopback/LAN socket
+        buffers dwarf a frame), so every drain reuses the same memory; a
+        backpressured connection downgrades the next drains to fresh
+        allocations until it flushes."""
+        with self._conn_lock:
+            pool_free = all(not c.out for c in self._conns.values())
+        if pool_free:
+            return networking.encode_message_into(reply, self._reply_pool)
+        return memoryview(networking.encode_message(reply))
+
+    # -- the write path ------------------------------------------------------
+    def _queue_reply(self, conn: _EventConn, obj):
+        """Queue one reply.  ``obj`` is either a message dict ('p'/'h'
+        replies, encoded into this connection's pooled send buffer) or the
+        drain's pre-encoded shared 'u' frame (immutable bytes — many
+        connections may hold views of the same frame)."""
+        with self._conn_lock:
+            if conn.sock not in self._conns:
+                return  # dropped while its reply was being built
+        if isinstance(obj, (bytes, memoryview)):
+            data = memoryview(obj)
+        elif conn.out:
+            # the pooled buffer still backs an in-flight reply (a client
+            # pipelining past the request/reply contract): fresh bytes
+            data = memoryview(networking.encode_message(obj))
+        else:
+            data = memoryview(networking.encode_message_into(
+                obj, conn.send_pool))
+        conn.out.append(data)
+        self._flush(conn)
+
+    def _flush(self, conn: _EventConn):
+        while conn.out:
+            buf = conn.out[0]
+            try:
+                sent = conn.sock.send(buf)
+            except (BlockingIOError, InterruptedError):
+                break
+            except (ConnectionError, OSError):
+                self._drop(conn)
+                return
+            if sent < len(buf):
+                conn.out[0] = buf[sent:]
+                break
+            conn.out.pop(0)
+        want = bool(conn.out)
+        if want != conn.want_write:
+            conn.want_write = want
+            mask = selectors.EVENT_READ | (
+                selectors.EVENT_WRITE if want else 0)
+            try:
+                self._selector.modify(conn.sock, mask, conn)
+            except (KeyError, ValueError, OSError):
+                pass
+
+    def _shutdown_io(self):
+        """Loop exit path: flush pending write buffers (bounded best
+        effort), close every registered connection, the listener, the
+        selector, and the waker."""
+        with self._conn_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            if conn.out:
+                try:
+                    conn.sock.setblocking(True)
+                    conn.sock.settimeout(0.5)
+                    for buf in conn.out:
+                        conn.sock.sendall(buf)
+                except (ConnectionError, OSError, socket.timeout):
+                    pass
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        if self._selector is not None:
+            try:
+                self._selector.close()
+            except OSError:
+                pass
+        self._close_waker()
+
+
+#: the selectable PS server cores (``ps_core=`` on the async trainers)
+PS_CORES = {"event": SocketParameterServer,
+            "threaded": ThreadedSocketParameterServer}
+
+
+def make_socket_server(ps: ParameterServer, host: str = "127.0.0.1",
+                       port: int = 0, generation: int = 0,
+                       ps_core: str = "event", coalesce: bool = True):
+    """Construct the selected PS server core around ``ps``.  ``coalesce``
+    only applies to the event core (the threaded core has no drain)."""
+    if ps_core not in PS_CORES:
+        raise ValueError(
+            f"ps_core must be one of {sorted(PS_CORES)}, got {ps_core!r}")
+    if ps_core == "threaded":
+        return ThreadedSocketParameterServer(ps, host=host, port=port,
+                                             generation=generation)
+    return SocketParameterServer(ps, host=host, port=port,
+                                 generation=generation, coalesce=coalesce)
+
+
 PS_CLASSES = {
     "downpour": DeltaParameterServer,
     "adag": ADAGParameterServer,
@@ -456,12 +1208,14 @@ PS_CLASSES = {
 
 
 def allocate_parameter_server(algorithm: str, model_blob: dict,
-                              num_workers: int) -> ParameterServer:
+                              num_workers: int,
+                              apply_kernel: Optional[str] = None
+                              ) -> ParameterServer:
     """Factory (reference: ``DistributedTrainer.allocate_parameter_server``)."""
     cls = PS_CLASSES[algorithm]
     if cls is ADAGParameterServer:
-        return cls(model_blob, num_workers)
-    return cls(model_blob)
+        return cls(model_blob, num_workers, apply_kernel=apply_kernel)
+    return cls(model_blob, apply_kernel=apply_kernel)
 
 
 def run_host_ps_training(trainer, dataset, shuffle: bool = False,
@@ -528,6 +1282,13 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     n = trainer.num_workers * getattr(trainer, "parallelism_factor", 1)
     ps_shards = int(getattr(trainer, "ps_shards", 1) or 1)
     recovery = bool(getattr(trainer, "recovery", False))
+    # event-core knobs (docs/host_ps.md): ps_core selects the server
+    # implementation (event default; "threaded" retains the seed core for
+    # the worker-scaling comparison), coalesce gates drain merging, and
+    # apply_kernel routes the scatter/axpy through csrc/applykernel.cpp
+    ps_core = getattr(trainer, "ps_core", "event") or "event"
+    coalesce = bool(getattr(trainer, "coalesce", True))
+    apply_kernel = getattr(trainer, "apply_kernel", None)
     # recovery routes through the ShardedServerGroup for ANY shard count
     # (the N=1 plan is the identity partition, bit-identical per
     # tests/test_ps_sharding.py) so there is exactly one supervised
@@ -540,11 +1301,14 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
         # apply rule on its slice, with its own apply lock and update clock,
         # so staleness semantics are per-shard identical to the single-PS
         # path and PS CPU/NIC bandwidth scales with the shard count
-        server = ShardedServerGroup(algorithm, blob, n, ps_shards)
+        server = ShardedServerGroup(algorithm, blob, n, ps_shards,
+                                    ps_core=ps_core, coalesce=coalesce,
+                                    apply_kernel=apply_kernel)
         server.start()
     else:
-        ps = allocate_parameter_server(algorithm, blob, n)
-        server = SocketParameterServer(ps)
+        ps = allocate_parameter_server(algorithm, blob, n,
+                                       apply_kernel=apply_kernel)
+        server = make_socket_server(ps, ps_core=ps_core, coalesce=coalesce)
         server.start()
     supervisor = None
     if recovery:
@@ -599,6 +1363,8 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
             if supervisor is not None:
                 supervisor.stop()
             server.stop()
+            trainer.ps_coalesce_stats = getattr(server, "coalesce_stats",
+                                                None)
         trainer.history.clear()
         for w in workers:
             trainer.history.extend(w.history)
@@ -763,6 +1529,9 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
             # read as N shard deaths and trigger a respawn storm
             supervisor.stop()
         server.stop()
+        # coalescing observability (bench host_ps_worker_scaling): counters
+        # survive the stop; None on the threaded core
+        trainer.ps_coalesce_stats = getattr(server, "coalesce_stats", None)
         if ckpt is not None:
             # durable async (orbax) saves + release the manager's
             # background threads — one leaks per train() otherwise
@@ -971,8 +1740,12 @@ def run_process_ps_training(trainer, dataset, shuffle: bool = False
             "worker processes — pass a name or config dict "
             "(e.g. 'warmup_cosine'), or use execution='host_ps'")
 
-    ps = allocate_parameter_server(algorithm, blob, n)
-    server = SocketParameterServer(ps)
+    ps = allocate_parameter_server(
+        algorithm, blob, n,
+        apply_kernel=getattr(trainer, "apply_kernel", None))
+    server = make_socket_server(
+        ps, ps_core=getattr(trainer, "ps_core", "event") or "event",
+        coalesce=bool(getattr(trainer, "coalesce", True)))
     server.start()
     try:
         with tempfile.TemporaryDirectory(prefix="dkt_procps_") as tmp:
@@ -1040,6 +1813,7 @@ def run_process_ps_training(trainer, dataset, shuffle: bool = False
                     trainer.history.extend(z["history"].tolist())
     finally:
         server.stop()
+        trainer.ps_coalesce_stats = getattr(server, "coalesce_stats", None)
 
     fitted = server.get_model()
     trainer._fitted = fitted

@@ -644,9 +644,11 @@ class ShardedServerGroup:
     """
 
     def __init__(self, algorithm: str, model_blob: dict, num_workers: int,
-                 num_shards: int, host: str = "127.0.0.1"):
-        from .parameter_servers import (SocketParameterServer,
-                                        allocate_parameter_server)
+                 num_shards: int, host: str = "127.0.0.1",
+                 ps_core: str = "event", coalesce: bool = True,
+                 apply_kernel: Optional[str] = None):
+        from .parameter_servers import (allocate_parameter_server,
+                                        make_socket_server)
         weights = [np.asarray(w) for w in model_blob["weights"]]
         self.model_blob = model_blob
         self.plan = make_shard_plan([w.shape for w in weights],
@@ -656,8 +658,9 @@ class ShardedServerGroup:
             ps = allocate_parameter_server(
                 algorithm,
                 {"model": model_blob["model"], "weights": shard_w},
-                num_workers)
-            self.servers.append(SocketParameterServer(ps, host=host))
+                num_workers, apply_kernel=apply_kernel)
+            self.servers.append(make_socket_server(
+                ps, host=host, ps_core=ps_core, coalesce=coalesce))
 
     @property
     def num_shards(self) -> int:
@@ -670,6 +673,28 @@ class ShardedServerGroup:
     @property
     def addrs(self) -> List[Tuple[str, int]]:
         return [(s.host, s.port) for s in self.servers]
+
+    @property
+    def coalesce_stats(self) -> Optional[dict]:
+        """Summed event-core drain counters across the shards (None when
+        the group runs the threaded core)."""
+        per_shard = [getattr(s, "coalesce_stats", None)
+                     for s in self.servers]
+        if not any(per_shard):
+            return None
+        out = {"drains": 0, "commits_applied": 0, "coalesced_drains": 0,
+               "max_drain": 0}
+        for st in per_shard:
+            if st is None:
+                continue
+            out["drains"] += st["drains"]
+            out["commits_applied"] += st["commits_applied"]
+            out["coalesced_drains"] += st["coalesced_drains"]
+            out["max_drain"] = max(out["max_drain"], st["max_drain"])
+        out["mean_drain"] = (round(out["commits_applied"]
+                                   / out["drains"], 3)
+                             if out["drains"] else None)
+        return out
 
     def start(self):
         try:

@@ -362,9 +362,11 @@ class ShardSupervisor:
     # -- respawn -------------------------------------------------------------
     def respawn_shard(self, j: int) -> Dict[str, Any]:
         """Stop whatever is left of shard ``j``, restore its last snapshot,
-        and re-listen on the same address with ``generation + 1``."""
-        from .parameter_servers import (SocketParameterServer,
-                                        allocate_parameter_server)
+        and re-listen on the same address with ``generation + 1``.  The
+        replacement is a ``respawn_clone`` of the dead server, so the PS
+        core (event/threaded) and its coalescing/apply-kernel knobs survive
+        the restart."""
+        from .parameter_servers import allocate_parameter_server
         with self._lock:
             t0 = time.monotonic()
             old = self.group.servers[j]
@@ -379,10 +381,10 @@ class ShardSupervisor:
                 self.algorithm,
                 {"model": self.group.model_blob["model"],
                  "weights": snap["center"]},
-                self.num_workers)
+                self.num_workers,
+                apply_kernel=getattr(old.ps, "apply_kernel", None))
             ps.num_updates = int(snap["clock"])
-            new = SocketParameterServer(ps, host=old.host, port=old.port,
-                                        generation=old.generation + 1)
+            new = old.respawn_clone(ps)
             last: Optional[BaseException] = None
             for d in (0.05, 0.1, 0.2, 0.4, 0.8):
                 try:

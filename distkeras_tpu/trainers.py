@@ -642,6 +642,22 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     inert under ``elastic=True``.  ``elastic=False`` (default) keeps the
     static-shard engine bit for bit.
 
+    ``ps_core`` / ``coalesce`` / ``apply_kernel`` (PS engines only): the
+    server-core knobs (docs/host_ps.md, "Event loop + coalescing").
+    ``ps_core="event"`` (default) runs the selector-based core — one I/O
+    thread multiplexing every worker connection, commits that arrive
+    during an apply coalesced into one batched drain (one lock
+    acquisition, one vectorized scatter-add per sparse run, one center
+    snapshot per drain); ``"threaded"`` retains the seed thread-per-
+    connection core (the ``host_ps_worker_scaling`` baseline).
+    ``coalesce=False`` keeps the event loop but applies commits one at a
+    time with per-commit reply snapshots — the sequential semantics.
+    ``apply_kernel`` routes the apply arithmetic through the native
+    ``csrc/applykernel.cpp`` scatter/axpy: ``None``/``"numpy"`` (default)
+    is the pure-NumPy reference, ``"native"`` requires the built
+    extension, ``"auto"`` uses it when available — results are
+    bit-identical either way.
+
     ``recovery`` (``execution='host_ps'`` only): make the parameter servers
     themselves survivable (``resilience.py``).  A ``ShardSupervisor``
     journals periodic per-shard snapshots (center slice + clock, atomic
@@ -668,6 +684,8 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                  elastic: bool = False,
                  lease_windows: Optional[int] = None,
                  lease_timeout: float = 5.0,
+                 ps_core: str = "event", coalesce: bool = True,
+                 apply_kernel: Optional[str] = None,
                  **kw):
         super().__init__(keras_model, **kw)
         self.parallelism_factor = int(parallelism_factor)
@@ -715,6 +733,27 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         self.lease_timeout = float(lease_timeout)
         if self.lease_timeout <= 0:
             raise ValueError("lease_timeout must be > 0")
+        # PS server-core knobs: validated eagerly (a bad core name or an
+        # unbuilt apply_kernel='native' must fail at construction, not in
+        # a server thread mid-run); non-defaults rejected off the PS
+        # engines, same contract as comm_overlap
+        from .parameter_servers import PS_CORES
+        from . import applykernel as _applykernel
+        self.ps_core = str(ps_core)
+        if self.ps_core not in PS_CORES:
+            raise ValueError(
+                f"ps_core must be one of {sorted(PS_CORES)}, got "
+                f"{ps_core!r}")
+        self.coalesce = bool(coalesce)
+        _applykernel.resolve(apply_kernel)
+        self.apply_kernel = apply_kernel
+        if self.execution not in ("host_ps", "process_ps") and (
+                self.ps_core != "event" or not self.coalesce
+                or self.apply_kernel is not None):
+            raise ValueError(
+                "ps_core/coalesce/apply_kernel apply to the PS server "
+                "(execution='host_ps'/'process_ps'); the SPMD engine has "
+                "no socket server to configure")
         #: elastic-run observability (resilience events): respawns, lease
         #: reassignments, per-worker windows, per-epoch exactly-once reports
         self.elastic_stats: dict = {}

@@ -24,6 +24,15 @@ if os.environ.get("DISTKERAS_TPU_NO_NATIVE", "0") != "1":
         extra_compile_args=["-O3", "-std=c++17"],
         optional=True,  # datasets.read_csv falls back to np.genfromtxt
     ))
+    ext_modules.append(Extension(
+        "distkeras_tpu._applykernel",
+        sources=["csrc/applykernel.cpp"],
+        # -ffp-contract=off: the kernel's contract is BIT-equality with the
+        # numpy apply path; an FMA would round `dst + scale*src` once where
+        # numpy rounds the product and the sum separately
+        extra_compile_args=["-O3", "-std=c++17", "-ffp-contract=off"],
+        optional=True,  # the PS apply path falls back to numpy
+    ))
 
 setup(
     name="distkeras_tpu",

@@ -131,23 +131,32 @@ def test_stop_is_idempotent_and_unblocks():
 
 
 def test_stop_unblocks_idle_connected_handlers():
-    """stop() must not hang or leak when workers are connected but idle
-    (handler threads blocked in recv) — the round-1 flaky failure mode."""
+    """stop() must not hang or leak when workers are connected but idle —
+    on the event core that means the I/O loop (which multiplexes every
+    connection; there are no per-connection handler threads to join)
+    drains the selector and closes all three registered connections,
+    woken by the socketpair waker rather than the seed core's
+    self-connection hack."""
     ps, server = _start_ps()
     conns = [networking.connect("127.0.0.1", server.port) for _ in range(3)]
     try:
-        # let the accept loop register all three handler threads
+        # let the event loop register all three connections
         import time
         deadline = time.time() + 5.0
-        while len(server._conn_threads) < 3 and time.time() < deadline:
+        while server.live_connections < 3 and time.time() < deadline:
             time.sleep(0.01)
-        threads = list(server._conn_threads)
-        assert len(threads) == 3
+        assert server.live_connections == 3
+        assert server._conn_threads == []  # one I/O thread, no per-conn ones
+        io_thread = server._accept_thread
         t0 = time.time()
         server.stop()
-        assert time.time() - t0 < 5.0  # no per-thread join timeout burn
-        for t in threads:
-            assert not t.is_alive()
+        assert time.time() - t0 < 5.0  # no join-timeout burn
+        assert not io_thread.is_alive()
+        assert server.live_connections == 0
+        # every registered connection was really closed: the clients see EOF
+        for c in conns:
+            c.settimeout(2.0)
+            assert c.recv(1) == b""
     finally:
         server.stop()
         for c in conns:
@@ -155,10 +164,12 @@ def test_stop_unblocks_idle_connected_handlers():
 
 
 def test_stop_logs_and_force_closes_leaked_handler(caplog):
-    """A handler wedged inside an apply outlives stop()'s join budget.
-    That leak used to be silent; now stop() logs it and force-closes the
-    thread's connection, so the wedged thread fails fast on its next
-    socket op instead of writing to a live peer after teardown."""
+    """An I/O loop wedged inside an apply outlives stop()'s join budget.
+    That leak used to be silent; now stop() logs it and force-closes every
+    registered connection plus the listener, so the wedged thread fails
+    fast on its next socket op instead of writing to a live peer after
+    teardown (and a same-address respawn is never blocked by the old
+    listener)."""
     import logging
     import time
 
@@ -180,21 +191,20 @@ def test_stop_logs_and_force_closes_leaked_handler(caplog):
         networking.send_data(
             sock, {"delta": [np.zeros_like(w) for w in server.ps.center],
                    "clock": 0})
-        deadline = time.time() + 5.0  # wait until the handler is wedged
+        deadline = time.time() + 5.0  # wait until the apply is wedged
         while not server.ps._lock.locked() and time.time() < deadline:
             time.sleep(0.01)
         assert server.ps._lock.locked()
-        threads = list(server._conn_threads)
+        io_thread = server._accept_thread
         with caplog.at_level(logging.WARNING,
                              logger="distkeras_tpu.parameter_servers"):
             t0 = time.time()
             server.stop(join_timeout=0.2)
         assert time.time() - t0 < 5.0  # bounded, despite the wedge
         assert "still alive" in caplog.text  # the leak is reported
-        release.set()  # un-wedge; the thread dies on its closed socket
-        for t in threads:
-            t.join(timeout=5.0)
-            assert not t.is_alive()
+        release.set()  # un-wedge; the loop dies on its closed sockets
+        io_thread.join(timeout=5.0)
+        assert not io_thread.is_alive()
     finally:
         release.set()
         server.stop()
