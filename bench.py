@@ -470,15 +470,23 @@ def serving_bench(budget_s: float = 90.0):
     ``serving_p50_ms``/``serving_p99_ms`` (submit→done, queueing included),
     ``serving_slot_occupancy`` (mean busy-slot fraction per decode step),
     and ``serving_sequential_tokens_per_sec`` for the comparison the
-    engine must win at ≥ 4 concurrent requests.  Returns Nones on
-    overrun/failure — never fatal to the north-star artifact.
+    engine must win at ≥ 4 concurrent requests.  The failure-semantics
+    observables ride the same harness: ``serving_shed_rate`` (fraction of
+    an overload flood shed at admission — bounded buffering),
+    ``serving_slot_reclaim_ms`` (mean cancel/expiry → slot-free latency
+    under the seeded ~10% client-kill chaos schedule), and
+    ``serving_deadline_miss_rate`` (fraction retired ``"deadline"`` under
+    a tight per-request deadline).  Returns Nones on overrun/failure —
+    never fatal to the north-star artifact.
     """
     sys.path.insert(0, os.path.join(_REPO, "examples"))
     import loadgen
 
     none = {"serving_tokens_per_sec": None, "serving_p50_ms": None,
             "serving_p99_ms": None, "serving_slot_occupancy": None,
-            "serving_sequential_tokens_per_sec": None}
+            "serving_sequential_tokens_per_sec": None,
+            "serving_shed_rate": None, "serving_slot_reclaim_ms": None,
+            "serving_deadline_miss_rate": None}
     if budget_s < 5.0:  # not enough budget to even warm the engine up
         return none
     t0 = time.perf_counter()
@@ -492,13 +500,41 @@ def serving_bench(budget_s: float = 90.0):
     if time.perf_counter() - t0 > budget_s:
         return none
     seq = loadgen.sequential_baseline(fitted, trace, max_len=engine.max_len)
-    return {
+    out = {
         "serving_tokens_per_sec": closed["tokens_per_sec"],
         "serving_p50_ms": closed["p50_ms"],
         "serving_p99_ms": closed["p99_ms"],
         "serving_slot_occupancy": closed["slot_occupancy"],
         "serving_sequential_tokens_per_sec": seq["tokens_per_sec"],
+        "serving_shed_rate": None, "serving_slot_reclaim_ms": None,
+        "serving_deadline_miss_rate": None,
     }
+    if time.perf_counter() - t0 > budget_s * 0.7:
+        return out
+    # chaos leg: ~10% seeded client kills + a deadline tight enough that
+    # queue-delayed requests miss it — the reclamation observables
+    _, engine = loadgen.build_engine(num_slots=2, queue_capacity=16)
+    trace = loadgen.make_trace(16, num_steps=12, temperature=0.7)
+    try:
+        chaos = loadgen.run_closed_loop(engine, trace, concurrency=8,
+                                        timeout_s=budget_s, chaos_kill=0.1,
+                                        chaos_seed=0, deadline_s=2.0)
+    finally:
+        engine.stop()
+    out["serving_slot_reclaim_ms"] = chaos["slot_reclaim_ms"]
+    out["serving_deadline_miss_rate"] = chaos["deadline_miss_rate"]
+    if time.perf_counter() - t0 > budget_s * 0.85:
+        return out
+    # overload leg: flood a tiny bounded queue — shed-not-collapse rate
+    _, engine = loadgen.build_engine(num_slots=2, queue_capacity=4)
+    trace = loadgen.make_trace(32, num_steps=4)
+    try:
+        flood = loadgen.run_open_loop(engine, trace, qps=1e6,
+                                      timeout_s=budget_s)
+    finally:
+        engine.stop()
+    out["serving_shed_rate"] = flood["shed_rate"]
+    return out
 
 
 def main():
@@ -756,7 +792,10 @@ def main():
     serving_fields = {"serving_tokens_per_sec": None,
                       "serving_p50_ms": None, "serving_p99_ms": None,
                       "serving_slot_occupancy": None,
-                      "serving_sequential_tokens_per_sec": None}
+                      "serving_sequential_tokens_per_sec": None,
+                      "serving_shed_rate": None,
+                      "serving_slot_reclaim_ms": None,
+                      "serving_deadline_miss_rate": None}
     serving_remaining = budget - (time.perf_counter() - t_start)
     if serving_remaining > 45:
         try:

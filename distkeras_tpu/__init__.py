@@ -22,8 +22,8 @@ from .trainers import (Trainer, SingleTrainer, AveragingTrainer,
                        ADAG, DOWNPOUR, AEASGD, EAMSGD, DynSGD)
 from .predictors import Predictor, ModelPredictor
 from . import serving
-from .serving import (QueueFull, RequestHandle, ServingClient,
-                      ServingEngine, ServingServer)
+from .serving import (Draining, EngineDead, QueueFull, RequestHandle,
+                      ServingClient, ServingEngine, ServingServer)
 from .evaluators import (Evaluator, AccuracyEvaluator, AUCEvaluator,
                          F1Evaluator, LossEvaluator, TopKAccuracyEvaluator)
 from . import utils
@@ -33,8 +33,8 @@ from . import ps_sharding
 from . import parameter_servers
 from . import resilience
 from .ps_sharding import PSShardDown
-from .resilience import (LeaseLedger, RetryPolicy, ShardSupervisor,
-                         WorkerSupervisor)
+from .resilience import (EngineSupervisor, LeaseLedger, RetryPolicy,
+                         ShardSupervisor, WorkerSupervisor)
 from .networking import ChaosFault, ChaosProxy
 from . import job_deployment
 from . import checkpoint

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import random
+import select
 import socket
 import struct
 import threading
@@ -754,11 +755,15 @@ class FrameParser:
 #: namespace on its own port; the PS protocol's ``'q'`` quit is unrelated):
 #: ``'q'`` enqueue request (frame follows; server acks or backpressures),
 #: ``'r'`` stream reply (frame ``{"id"}`` follows; server streams chunk
-#: frames until ``done``).  Both ride the ordinary codec — request/reply
-#: bodies are plain trees, so the native and pure-Python codecs carry them
-#: unchanged (round-trip-tested in tests/test_wirecodec.py).
+#: frames until ``done``), ``'x'`` cancel (frame ``{"id"}`` follows;
+#: server acks — or, sent mid-stream, cancels unacked and the stream's
+#: final frame carries ``finish="cancel"``).  All ride the ordinary codec —
+#: request/reply bodies are plain trees, so the native and pure-Python
+#: codecs carry them unchanged (round-trip-tested in
+#: tests/test_wirecodec.py).
 SERVING_OP_ENQUEUE = b"q"
 SERVING_OP_STREAM = b"r"
+SERVING_OP_CANCEL = b"x"
 
 
 def send_opcode(sock: socket.socket, op: bytes) -> None:
@@ -817,7 +822,11 @@ class ChaosFault(NamedTuple):
     - ``"dup_reply"`` — relay the request and its reply, then send the
       reply a second time (a duplicated in-flight reply);
     - ``"call"``   — invoke ``arg()`` before forwarding (the deterministic
-      trigger for out-of-band chaos, e.g. ``ShardSupervisor.kill_shard``).
+      trigger for out-of-band chaos, e.g. ``ShardSupervisor.kill_shard``);
+    - ``"cut_stream"`` (serving protocol only, on an ``'r'`` opcode) —
+      relay the stream request, forward ``arg`` reply chunk frames
+      (default 1), then RST both sides: the deterministic client-reset
+      MID-stream, driving the server's disconnect-reclamation path.
     """
 
     conn: int
@@ -827,7 +836,9 @@ class ChaosFault(NamedTuple):
 
 
 class ChaosProxy:
-    """Deterministic TCP fault-injection proxy for the PS opcode protocol.
+    """Deterministic TCP fault-injection proxy for the framed opcode
+    protocols (PS by default; ``protocol="serving"`` speaks the serving
+    opcodes).
 
     Sits between workers and one PS (or one PS shard) and relays the real
     byte stream **message by message** (opcode + frame via ``read_frame``),
@@ -840,6 +851,14 @@ class ChaosProxy:
     seeded by ``(seed, connection index)``, so a given connection's fault
     sequence is a pure function of the seed and its opcode count.
 
+    ``protocol="serving"`` relays the serving wire
+    (``serving.ServingServer``): every client opcode (``'q'`` enqueue,
+    ``'r'`` stream, ``'x'`` cancel) carries a request frame; ``'q'``/``'x'``
+    get one reply frame, ``'r'`` a STREAM of chunk frames relayed
+    full-duplex (a mid-stream client cancel or EOF still reaches the
+    server) until the ``done`` frame — plus the serving-only
+    ``"cut_stream"`` action for a deterministic client reset mid-stream.
+
     ``injected`` records every fault as ``(conn, op_index, action)``.
     Usable as a context manager; ``stop()`` hard-closes everything.
     """
@@ -847,8 +866,13 @@ class ChaosProxy:
     def __init__(self, upstream_host: str, upstream_port: int,
                  host: str = "127.0.0.1", seed: int = 0,
                  faults: Sequence[ChaosFault] = (),
-                 auto: Optional[Dict[str, Any]] = None):
+                 auto: Optional[Dict[str, Any]] = None,
+                 protocol: str = "ps"):
+        if protocol not in ("ps", "serving"):
+            raise ValueError(f"protocol must be 'ps' or 'serving', "
+                             f"got {protocol!r}")
         self.upstream = (upstream_host, int(upstream_port))
+        self.protocol = protocol
         self.seed = int(seed)
         self.faults = [ChaosFault(*f) for f in faults]
         self.auto = dict(auto or {})
@@ -932,13 +956,16 @@ class ChaosProxy:
         with self._lock:
             self._pairs.append((client, upstream))
         rng = random.Random((self.seed << 20) ^ idx)
+        serving = self.protocol == "serving"
+        frame_ops = (b"q", b"r", b"x") if serving else (b"c", b"u")
+        reply_ops = (b"q", b"x") if serving else (b"p", b"u", b"h")
         op_index = 0
         try:
             while True:
                 op = client.recv(1)
                 if not op:
                     return
-                frame = (read_frame(client) if op in (b"c", b"u") else None)
+                frame = read_frame(client) if op in frame_ops else None
                 fault = self._fault_for(idx, op_index, rng)
                 op_index += 1
                 if fault is not None:
@@ -963,7 +990,14 @@ class ChaosProxy:
                 upstream.sendall(op)
                 if frame is not None:
                     upstream.sendall(frame)
-                if op in (b"p", b"u", b"h"):
+                if serving and op == b"r":
+                    cut_after = (max(int(fault.arg or 1), 1)
+                                 if fault is not None
+                                 and fault.action == "cut_stream" else None)
+                    self._relay_stream(client, upstream, cut_after)
+                    if cut_after is not None:
+                        return  # finally RSTs both sides mid-stream
+                elif op in reply_ops:
                     reply = read_frame(upstream)
                     client.sendall(reply)
                     if fault is not None and fault.action == "dup_reply":
@@ -976,3 +1010,29 @@ class ChaosProxy:
                     self._pairs.remove((client, upstream))
             _hard_close(client)
             _hard_close(upstream)
+
+    def _relay_stream(self, client: socket.socket, upstream: socket.socket,
+                      cut_after: Optional[int] = None) -> None:
+        """Relay a serving ``'r'`` reply stream full-duplex: chunk frames
+        upstream→client until the ``done`` frame, while any client bytes
+        (a mid-stream ``'x'`` cancel, or EOF) pass through / propagate —
+        the proxy never deadlocks a cancel behind the stream it is meant
+        to abort.  With ``cut_after=n``, returns after relaying ``n``
+        chunk frames (the caller then RSTs both sides)."""
+        relayed = 0
+        while True:
+            readable, _, _ = select.select([client, upstream], [], [], 0.05)
+            if client in readable:
+                data = client.recv(1 << 16)
+                if not data:
+                    raise ConnectionError("client hung up mid-stream")
+                upstream.sendall(data)
+            if upstream in readable:
+                reply = read_frame(upstream)
+                client.sendall(reply)
+                relayed += 1
+                if cut_after is not None and relayed >= cut_after:
+                    return
+                msg = decode_message(reply)
+                if isinstance(msg, dict) and msg.get("done"):
+                    return

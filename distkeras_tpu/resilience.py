@@ -446,6 +446,160 @@ class ShardSupervisor:
 
 
 # ---------------------------------------------------------------------------
+# the serving-engine supervisor
+# ---------------------------------------------------------------------------
+
+class EngineSupervisor:
+    """Detect-and-restart loop over a serving engine — the serving twin of
+    :class:`ShardSupervisor` (``serving.ServingEngine`` grew the same
+    failure surface the PS servers have: a crashed OR wedged decode loop
+    must fail loudly and be replaceable, not hang every
+    ``handle.result()`` waiter).
+
+    Liveness has two layers, mirroring the shard supervisor:
+
+     - **crash** — the decode-loop thread died.  A loop that raised
+       declares the engine dead itself (every in-flight handle fails with
+       a typed ``EngineDead``); the supervisor's job is the restart.
+     - **wedge** — the thread is alive but its heartbeat
+       (``engine.last_beat``, stamped once per scheduler iteration, idle
+       iterations included) is older than ``liveness_deadline``: the loop
+       is stuck inside a decode step (hung compile, wedged device
+       transfer).  The supervisor declares the engine dead — failing the
+       in-flight handles the wedged loop never will — and restarts.
+
+    The restart is ``engine.respawn_clone()``: same model weights and
+    knobs, fresh KV slot pool, empty queue.  When supervising a
+    ``ServingServer`` the server is re-pointed at the replacement
+    (``server.engine = new``), so new submissions land on the fresh
+    engine while ``ServingClient.generate(retry_policy=...)`` resubmits
+    the failed ones (deterministic seeds make the retry idempotent).
+    ``recoveries`` records one entry per detection (with ``restarted`` and
+    ``recovery_ms``), ``max_restarts`` bounds the budget.
+
+    ``target`` is a ``ServingServer`` (its ``.engine`` attribute is
+    watched and swapped) or a bare started ``ServingEngine`` (the
+    replacement is reachable as ``supervisor.engine``).  Inline engines
+    (never ``start()``-ed) have no loop to supervise.
+
+    ``liveness_deadline`` must exceed the engine's worst-case single
+    decode step — including the jit compile a COLD engine pays inside its
+    first step.  Respawned clones are ``warmup()``-ed here before going
+    live for exactly that reason; supervise a fresh engine tightly only
+    after ``engine.warmup()``.
+    """
+
+    def __init__(self, target, heartbeat_interval: float = 0.1,
+                 liveness_deadline: float = 2.0, max_restarts: int = 3,
+                 restart: bool = True):
+        self.target = target
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.liveness_deadline = float(liveness_deadline)
+        self.max_restarts = int(max_restarts)
+        self.restart = bool(restart)
+        self.restarts = 0
+        #: one dict per detection: reason ("crashed"/"wedged"),
+        #: requests_failed at detection, restarted, recovery_ms
+        self.recoveries: List[Dict[str, Any]] = []
+        self._seen: set = set()  # id()s of engines already handled
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    @property
+    def engine(self):
+        return getattr(self.target, "engine", self.target)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "EngineSupervisor":
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dkt-serving-supervisor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def __enter__(self) -> "EngineSupervisor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- detection -----------------------------------------------------------
+    def check(self) -> Optional[str]:
+        """One liveness probe of the current engine: None when healthy (or
+        not running a loop), else ``"crashed"`` / ``"wedged"``."""
+        eng = self.engine
+        if eng.dead is not None:
+            return "crashed"
+        thread = eng._thread
+        if thread is None:
+            return None  # inline or cleanly stopped: nothing to supervise
+        if not thread.is_alive():
+            # the loop exited without declaring death or clearing _thread:
+            # a transient stop() window — re-probe next tick
+            return "crashed" if eng.dead is not None else None
+        if time.monotonic() - eng.last_beat > self.liveness_deadline:
+            return "wedged"
+        return None
+
+    # -- recovery ------------------------------------------------------------
+    def _recover(self, reason: str) -> Dict[str, Any]:
+        with self._lock:
+            eng = self.engine
+            if id(eng) in self._seen:
+                return {}
+            self._seen.add(id(eng))
+            t0 = time.monotonic()
+            eng.declare_dead(
+                f"serving engine {reason}: decode loop "
+                f"{'raised' if reason == 'crashed' else 'missed its heartbeat'}"
+                f" (supervised restart "
+                f"{self.restarts}/{self.max_restarts})")
+            rec: Dict[str, Any] = {
+                "reason": reason, "restarted": False,
+                "requests_failed": int(eng.stats["requests_failed"]),
+            }
+            if self.restart and self.restarts < self.max_restarts:
+                new = eng.respawn_clone()
+                new.warmup()  # compile BEFORE going live: a cold first
+                new.start()   # step must not read as a fresh wedge
+                if self.target is eng:
+                    self.target = new
+                else:
+                    self.target.engine = new
+                self.restarts += 1
+                rec["restarted"] = True
+                rec["recovery_ms"] = round(
+                    (time.monotonic() - t0) * 1e3, 1)
+            self.recoveries.append(rec)
+            logger.warning(
+                "serving engine %s; %d in-flight request(s) failed with "
+                "EngineDead%s", reason, rec["requests_failed"],
+                (", replacement engine started" if rec["restarted"]
+                 else ", no restart (budget spent or restart=False)"))
+            return rec
+
+    # -- the loop ------------------------------------------------------------
+    def _loop(self) -> None:
+        while self._running:
+            time.sleep(self.heartbeat_interval)
+            if not self._running:
+                return
+            reason = self.check()
+            if reason is not None:
+                try:
+                    self._recover(reason)
+                except Exception:
+                    logger.exception("serving engine restart failed")
+
+
+# ---------------------------------------------------------------------------
 # elastic workers: the lease ledger
 # ---------------------------------------------------------------------------
 

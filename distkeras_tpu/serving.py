@@ -28,6 +28,20 @@ iteration-level (Orca-style) scheduling:
    the engine at a live parameter server; between decode steps it pulls a
    fresh center over the existing ``'p'`` opcode, so training and serving
    can share one deployment.
+ - **Failure semantics** (the serving twin of the host-PS robustness
+   stack — see docs/serving.md's failure matrix): per-request
+   **deadlines** (``submit(deadline_s=)`` / an engine-wide default) retire
+   expired requests mid-run with reason ``"deadline"`` — queued ones are
+   shed before ever taking a slot; **cancellation** (``engine.cancel``,
+   the wire ``SERVING_OP_CANCEL`` opcode, and server-side
+   client-disconnect detection) reclaims a KV slot within one scheduler
+   iteration with reason ``"cancel"``; **graceful drain**
+   (``engine.drain``) stops admission (``submit`` raises
+   :class:`Draining`), finishes in-flight work, then stops; and a
+   **crashed or wedged decode loop** fails every in-flight handle with a
+   typed :class:`EngineDead` instead of hanging ``result()`` forever
+   (``resilience.EngineSupervisor`` watches the loop's heartbeat and can
+   restart the engine from the model weights with a fresh slot pool).
 
 Determinism contract: a lone request through the engine emits tokens
 BIT-IDENTICAL to offline ``generate`` under the same seed/params
@@ -46,6 +60,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import select
 import socket
 import threading
 import time
@@ -74,6 +89,22 @@ class QueueFull(RuntimeError):
     the client sheds or retries; the server never buffers unboundedly."""
 
 
+class Draining(RuntimeError):
+    """Admission refused because the engine is draining (``engine.drain``):
+    in-flight requests finish, new ones go elsewhere.  The wire server maps
+    this to a typed ``{"ok": False, "kind": "draining"}`` reply."""
+
+
+class EngineDead(RuntimeError):
+    """The serving engine's decode loop crashed, wedged, or was torn down
+    with work in flight.  Raised from ``RequestHandle.result()`` for every
+    request the dead engine was carrying (no silent hangs), and from
+    ``submit`` on a dead engine.  The wire server maps it to a typed
+    ``{"kind": "engine_dead"}`` frame; ``ServingClient.generate`` with a
+    ``retry_policy`` treats it as retriable (requests are deterministic in
+    their seed, so a resubmit is idempotent)."""
+
+
 class RequestHandle:
     """One submitted request's lifecycle + streaming surface.
 
@@ -82,17 +113,26 @@ class RequestHandle:
     ``generate``-shaped row: prompt + emitted tokens, padded with
     ``pad_id`` (default ``eos_id``, else 0) out to ``num_steps`` — exactly
     the static-shape row offline ``generate`` would return.
+
+    ``finish`` is the retire reason: ``"eos"`` / ``"length"`` / ``"empty"``
+    for normal completion, ``"deadline"`` (per-request deadline expired —
+    the partial row is still returned, padded), ``"cancel"`` (explicit
+    cancel or client disconnect), ``"drain"`` (drain timeout), ``"error"``
+    (the engine died — ``result()`` raises the stored :class:`EngineDead`).
+    ``deadline`` is an absolute ``time.perf_counter()`` instant or None.
     """
 
     __slots__ = ("id", "prompt", "num_steps", "temperature", "top_k",
                  "top_p", "eos_id", "pad_id", "key", "tokens", "finish",
                  "slot", "submitted_at", "started_at", "finished_at",
-                 "_cond", "_chunk_read")
+                 "deadline", "error", "cancelled_at", "_cond",
+                 "_chunk_read")
 
     def __init__(self, rid: int, prompt: np.ndarray, num_steps: int,
                  temperature: float, top_k: Optional[int],
                  top_p: Optional[float], eos_id: Optional[int],
-                 pad_id: Optional[int], key):
+                 pad_id: Optional[int], key,
+                 deadline_s: Optional[float] = None):
         self.id = rid
         self.prompt = prompt
         self.num_steps = int(num_steps)
@@ -103,11 +143,15 @@ class RequestHandle:
         self.pad_id = pad_id
         self.key = key
         self.tokens: List[int] = []     # emitted (pre-padding) tokens
-        self.finish: Optional[str] = None   # "eos" | "length" | "empty"
+        self.finish: Optional[str] = None   # see class docstring
         self.slot: Optional[int] = None
         self.submitted_at = time.perf_counter()
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        self.deadline = (None if deadline_s is None
+                         else self.submitted_at + float(deadline_s))
+        self.error: Optional[BaseException] = None
+        self.cancelled_at: Optional[float] = None
         self._cond = threading.Condition()
         self._chunk_read = 0            # tokens already handed out as chunks
 
@@ -123,14 +167,32 @@ class RequestHandle:
     # -- engine side ---------------------------------------------------------
     def _push(self, token: int) -> None:
         with self._cond:
+            if self.finish is not None:  # a wedged loop emitting past its
+                return                   # declared death: drop, don't grow
             self.tokens.append(int(token))
             self._cond.notify_all()
 
     def _finish(self, reason: str) -> None:
         with self._cond:
+            if self.finish is not None:  # first terminal state wins (a
+                return                   # wedge diagnosis is never undone)
             self.finish = reason
             self.finished_at = time.perf_counter()
             self._cond.notify_all()
+
+    def _fail(self, exc: BaseException, reason: str = "error") -> None:
+        """Terminal failure: ``result()`` raises ``exc`` instead of
+        returning a row.  Idempotent like ``_finish``."""
+        with self._cond:
+            if self.finish is not None:
+                return
+            self.error = exc
+            self.finish = reason
+            self.finished_at = time.perf_counter()
+            self._cond.notify_all()
+
+    def _expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
 
     # -- consumer side -------------------------------------------------------
     def next_chunk(self, timeout: Optional[float] = None
@@ -152,9 +214,14 @@ class RequestHandle:
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         """The full ``generate``-shaped row (prompt + tokens, padded to
-        ``num_steps``) — blocks until the request retires."""
+        ``num_steps``) — blocks until the request retires.  A request the
+        engine failed (crash / wedge / drain timeout) raises its stored
+        typed error (:class:`EngineDead`) instead of hanging or returning
+        a fabricated row."""
         if not self.wait(timeout):
             raise TimeoutError(f"request {self.id} not done")
+        if self.error is not None:
+            raise self.error
         gen = list(self.tokens) + [self.pad] * (self.num_steps
                                                 - len(self.tokens))
         return np.concatenate([self.prompt,
@@ -185,7 +252,8 @@ class ServingEngine:
     def __init__(self, model: Union[FittedModel, Tuple[Sequential, Any]],
                  num_slots: int = 4, max_len: Optional[int] = None,
                  queue_capacity: int = 64, prefills_per_step: int = 1,
-                 rolling: bool = False):
+                 rolling: bool = False,
+                 default_deadline_s: Optional[float] = None):
         if isinstance(model, FittedModel):
             self.model, self.params = model.model, model.params
         else:
@@ -209,6 +277,10 @@ class ServingEngine:
         self.rolling = bool(rolling)
         self.queue_capacity = int(queue_capacity)
         self.prefills_per_step = max(int(prefills_per_step), 1)
+        if default_deadline_s is not None and default_deadline_s <= 0:
+            raise ValueError(f"default_deadline_s must be > 0, got "
+                             f"{default_deadline_s}")
+        self.default_deadline_s = default_deadline_s
         self._vocab = _vocab_size(self.model)
 
         # -- slot pool: ONE batched cache, one host-side row of state per slot
@@ -245,15 +317,28 @@ class ServingEngine:
         self._reload_sock: Optional[socket.socket] = None
         self._reload_pool = networking.BufferPool()
 
-        # -- scheduler thread + stats
+        # -- scheduler thread + stats + failure state
         self._thread: Optional[threading.Thread] = None
         self._running = False
+        self._draining = False
+        self._dead: Optional[BaseException] = None  # the EngineDead cause
+        #: decode-loop heartbeat (monotonic): stamped once per scheduler
+        #: iteration, idle iterations included — a stale beat means the
+        #: loop is wedged inside a decode step (EngineSupervisor watches it)
+        self.last_beat = time.monotonic()
         self.stats: Dict[str, Any] = {
             "requests_submitted": 0, "requests_completed": 0,
             "requests_rejected": 0, "tokens_generated": 0,
             "prefills": 0, "decode_steps": 0, "active_slot_steps": 0,
             "queue_peak": 0, "slot_requests": [0] * self.num_slots,
             "weight_reloads": 0,
+            # failure-semantics observables (this PR's contract surface):
+            # cancelled/expired count retirements by reason; failed counts
+            # handles the engine abandoned with EngineDead; reclaim_ms is
+            # one sample per mid-run cancel/deadline slot reclamation
+            # (cancel/expiry instant → slot free)
+            "requests_cancelled": 0, "requests_expired": 0,
+            "requests_failed": 0, "slot_reclaim_ms": [],
         }
 
     # ------------------------------------------------------------------ jit
@@ -278,8 +363,8 @@ class ServingEngine:
                top_k: Optional[int] = None, top_p: Optional[float] = None,
                eos_id: Optional[int] = None, pad_id: Optional[int] = None,
                seed: int = 0, rng: Optional[jax.Array] = None,
-               block: bool = True,
-               timeout: Optional[float] = None) -> RequestHandle:
+               block: bool = True, timeout: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> RequestHandle:
         """Enqueue one request; returns its :class:`RequestHandle`.
 
         ``prompt``: (P,) int tokens.  Sampling/stopping knobs mirror
@@ -287,7 +372,13 @@ class ServingEngine:
         request's rng is ``rng`` if given, else ``PRNGKey(seed)``.
         Backpressure: with the queue at ``queue_capacity``, ``block=True``
         waits (up to ``timeout``), ``block=False`` raises :class:`QueueFull`
-        immediately.
+        immediately.  ``deadline_s`` (default: the engine's
+        ``default_deadline_s``) bounds the request's whole lifetime,
+        queueing included: an expired request is retired with reason
+        ``"deadline"`` — shed before prefill if still queued, mid-run with
+        its slot freed immediately if decoding.  Raises :class:`Draining`
+        while ``drain`` is in progress and :class:`EngineDead` on a dead
+        engine.
         """
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1:
@@ -295,6 +386,10 @@ class ServingEngine:
                              f"{prompt.shape} — submit one request per row")
         if num_steps < 0:
             raise ValueError(f"num_steps must be >= 0, got {num_steps}")
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        elif deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         key = rng if rng is not None else jax.random.PRNGKey(int(seed))
         _validate_sampling(temperature, key, top_k, top_p)
         _validate_stopping(eos_id, pad_id, self._vocab)
@@ -306,10 +401,15 @@ class ServingEngine:
                              f"({num_steps}) = {total} exceeds the engine's "
                              f"max_len {self.max_len}")
         with self._qlock:
+            if self._dead is not None:
+                raise EngineDead(str(self._dead)) from self._dead
+            if self._draining:
+                raise Draining("serving engine is draining; admission "
+                               "stopped")
             self._next_id += 1
             handle = RequestHandle(self._next_id, prompt, num_steps,
                                    temperature, top_k, top_p, eos_id,
-                                   pad_id, key)
+                                   pad_id, key, deadline_s=deadline_s)
             self.stats["requests_submitted"] += 1
             if num_steps == 0:  # nothing to generate: complete in place
                 handle._finish("empty")
@@ -343,6 +443,72 @@ class ServingEngine:
             h = self._queue.popleft()
             self._not_full.notify()
             return h
+
+    # ------------------------------------------------- cancel + deadlines
+    def cancel(self, handle: RequestHandle) -> bool:
+        """Request cancellation (thread-safe, any thread): the scheduler
+        retires the request with reason ``"cancel"`` within ONE iteration —
+        a queued request is shed before prefill, a running one frees its KV
+        slot immediately (the disconnect-reclamation path the wire server
+        drives).  Returns False if the request already finished."""
+        with handle._cond:
+            if handle.finish is not None:
+                return False
+            if handle.cancelled_at is None:
+                handle.cancelled_at = time.perf_counter()
+        with self._qlock:
+            self._have_work.notify_all()  # prompt reclamation on idle loops
+        return True
+
+    def _reap(self) -> bool:
+        """Retire cancelled and deadline-expired requests: queued ones are
+        shed before ever taking a slot; running ones mid-run, freeing the
+        slot for the next queued request.  Runs at the top of every
+        scheduler iteration."""
+        now = time.perf_counter()
+        shed: List[RequestHandle] = []
+        with self._qlock:
+            if self._queue and any(h.cancelled_at is not None
+                                   or h._expired(now)
+                                   for h in self._queue):
+                keep: "collections.deque[RequestHandle]" = collections.deque()
+                for h in self._queue:
+                    if h.cancelled_at is not None or h._expired(now):
+                        shed.append(h)
+                    else:
+                        keep.append(h)
+                self._queue = keep
+                self._not_full.notify_all()
+        for h in shed:
+            self._account_terminal(h, "cancel" if h.cancelled_at is not None
+                                   else "deadline", now)
+            h._finish("cancel" if h.cancelled_at is not None else "deadline")
+            self.stats["requests_completed"] += 1
+        did = bool(shed)
+        for slot in np.flatnonzero(self._active):
+            h = self._handles[slot]
+            if h.cancelled_at is not None:
+                self._retire(int(slot), "cancel")
+                did = True
+            elif h._expired(now):
+                self._retire(int(slot), "deadline")
+                did = True
+        return did
+
+    def _account_terminal(self, h: RequestHandle, reason: str,
+                          now: float) -> None:
+        """Reason counters + the slot-reclaim latency sample (cancel/expiry
+        instant → reclamation) for the ``serving_slot_reclaim_ms`` bench."""
+        if reason == "cancel":
+            self.stats["requests_cancelled"] += 1
+            if h.cancelled_at is not None:
+                self.stats["slot_reclaim_ms"].append(
+                    round((now - h.cancelled_at) * 1e3, 3))
+        elif reason == "deadline":
+            self.stats["requests_expired"] += 1
+            if h.deadline is not None:
+                self.stats["slot_reclaim_ms"].append(
+                    round((now - h.deadline) * 1e3, 3))
 
     # ------------------------------------------------------------- prefill
     def _prefill(self, slot: int, h: RequestHandle) -> None:
@@ -406,15 +572,19 @@ class ServingEngine:
         self._cur_tok[slot] = 0
         self._free.append(slot)
         self.stats["requests_completed"] += 1
+        self._account_terminal(h, reason, time.perf_counter())
         h._finish(reason)
 
     # ------------------------------------------------------------ schedule
     def step(self) -> bool:
-        """One engine iteration: admit up to ``prefills_per_step`` queued
+        """One engine iteration: retire cancelled/expired requests
+        (``_reap`` — queued ones shed before prefill, running ones freeing
+        their slot mid-run), admit up to ``prefills_per_step`` queued
         requests into free slots (prefill), then advance every running
         request by one token (one batched per-row decode step).  Returns
         whether any work happened."""
-        did = False
+        self.last_beat = time.monotonic()
+        did = self._reap()
         for _ in range(self.prefills_per_step):
             if not self._free:
                 break
@@ -447,9 +617,18 @@ class ServingEngine:
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> None:
         """Drive the scheduler inline until queue and slots are empty (the
-        synchronous mode tests and closed-loop benches use)."""
+        synchronous mode tests and closed-loop benches use).  A crash
+        inside a step fails every in-flight handle with
+        :class:`EngineDead` before re-raising — waiters on other threads
+        never hang on a dead inline engine."""
         steps = 0
-        while self.step():
+        while True:
+            try:
+                if not self.step():
+                    return
+            except Exception as e:
+                self._declare_dead(e)
+                raise
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 raise RuntimeError(
@@ -478,12 +657,29 @@ class ServingEngine:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, join_timeout: float = 10.0) -> None:
+        """Stop the background scheduler thread.
+
+        A decode thread that outlives ``join_timeout`` is wedged inside a
+        decode step (stuck compile, hung device transfer): it is logged,
+        every in-flight handle is failed with :class:`EngineDead` (so no
+        ``result()`` waiter blocks on a thread that will never answer),
+        and the thread is detached — the same leak contract as
+        ``SocketParameterServer.stop(join_timeout)``."""
         self._running = False
         with self._qlock:
             self._have_work.notify_all()
         if self._thread is not None:
-            self._thread.join(timeout=10.0)
+            self._thread.join(timeout=join_timeout)
+            if self._thread.is_alive():
+                logger.error(
+                    "serving engine decode thread still alive after "
+                    "stop(join_timeout=%.1fs) — wedged in a decode step; "
+                    "failing in-flight requests and detaching the thread",
+                    join_timeout)
+                self._declare_dead(EngineDead(
+                    f"decode thread wedged: did not exit within "
+                    f"stop(join_timeout={join_timeout})"))
             self._thread = None
         if self._reload_sock is not None:
             try:
@@ -493,13 +689,146 @@ class ServingEngine:
                 pass
             self._reload_sock = None
 
+    def drain(self, timeout: Optional[float] = None,
+              poll: float = 0.01) -> bool:
+        """Graceful drain: stop admission (``submit`` raises
+        :class:`Draining`), let every queued and running request finish,
+        then stop the scheduler.  Returns True when everything finished
+        within ``timeout`` (None = wait forever).  On timeout the
+        remaining in-flight handles are failed with :class:`EngineDead`
+        (reason ``"drain"``) so no waiter hangs, and False is returned.
+        Engines never ``start()``-ed are driven to idle inline by this
+        call."""
+        with self._qlock:
+            self._draining = True
+        t0 = time.monotonic()
+
+        def busy() -> bool:
+            # terminal accounting, not queue+active snapshots: a request
+            # between queue-pop and slot activation (mid-prefill) is in
+            # neither, but it has not reached a terminal state either
+            s = self.stats
+            return (s["requests_submitted"]
+                    > s["requests_completed"] + s["requests_failed"])
+
+        def timed_out() -> bool:
+            return (timeout is not None
+                    and time.monotonic() - t0 > timeout)
+
+        if self._thread is None and self._dead is None:
+            while busy() and not timed_out():
+                try:
+                    self.step()
+                except Exception as e:
+                    self._declare_dead(e)
+                    raise
+        else:
+            while busy() and self._dead is None and not timed_out():
+                time.sleep(poll)
+        clean = self._dead is None and not busy()
+        if not clean and self._dead is None:
+            # declare BEFORE stop so waiters unblock immediately with
+            # reason "drain" (stop would otherwise block a full
+            # join_timeout on a wedged loop first)
+            self._declare_dead(
+                EngineDead(f"drain timed out after {timeout}s with work "
+                           f"in flight"), reason="drain")
+        self.stop(join_timeout=10.0 if clean else 2.0)
+        return clean
+
+    # -------------------------------------------------- failure semantics
+    def declare_dead(self, reason: str) -> None:
+        """Supervisor-facing: mark the engine dead and fail every in-flight
+        handle with a typed :class:`EngineDead` (``EngineSupervisor`` calls
+        this on a stale heartbeat — a wedged decode step — before
+        restarting from ``respawn_clone``)."""
+        self._declare_dead(EngineDead(reason))
+
+    def _declare_dead(self, cause: BaseException,
+                      reason: str = "error") -> None:
+        """Terminal engine failure: stop the loop, shed the queue, and fail
+        every queued + running handle so no ``result()``/``next_chunk``
+        waiter hangs.  Idempotent (first cause wins).  Slot arrays are NOT
+        recycled — a wedged decode thread may still be writing them; a
+        restart goes through ``respawn_clone`` (fresh pool) instead."""
+        exc = (cause if isinstance(cause, EngineDead)
+               else EngineDead(f"serving engine died: {cause!r}"))
+        if exc is not cause:
+            exc.__cause__ = cause
+        self._running = False
+        with self._qlock:
+            if self._dead is not None:
+                return
+            self._dead = exc
+            queued = list(self._queue)
+            self._queue.clear()
+            self._not_full.notify_all()
+            self._have_work.notify_all()
+        inflight = queued + [h for h in self._handles if h is not None]
+        for h in inflight:
+            h._fail(EngineDead(str(exc)), reason=reason)
+            self.stats["requests_failed"] += 1
+
+    @property
+    def dead(self) -> Optional[BaseException]:
+        """The :class:`EngineDead` that killed this engine, or None."""
+        return self._dead
+
+    def respawn_clone(self) -> "ServingEngine":
+        """A fresh engine over the same model/params and knobs — new KV
+        slot pool, empty queue, fresh stats (the ``EngineSupervisor``
+        restart path; mirrors ``SocketParameterServer.respawn_clone``)."""
+        eng = ServingEngine(
+            (self.model, self.params), num_slots=self.num_slots,
+            max_len=self.max_len, queue_capacity=self.queue_capacity,
+            prefills_per_step=self.prefills_per_step, rolling=self.rolling,
+            default_deadline_s=self.default_deadline_s)
+        if self._ps_addr is not None:
+            eng.attach_ps(*self._ps_addr, every=self._reload_every)
+        return eng
+
+    def warmup(self) -> "ServingEngine":
+        """Compile the engine's jitted programs (one throwaway
+        all-slots-inactive decode step + one self-identical slot write)
+        before serving traffic.  A fresh engine otherwise pays its jit
+        trace/compile inside the FIRST real decode step — under an
+        ``EngineSupervisor`` whose ``liveness_deadline`` is shorter than
+        that compile, a cold engine is indistinguishable from a wedged
+        one, so the supervisor warms every respawned clone before it goes
+        live (and callers who supervise a fresh engine tightly should
+        too).  Idempotent; fresh/idle engines only."""
+        if self._active.any():
+            raise RuntimeError("warmup() on an engine with active slots "
+                               "would consume a real decode step")
+        nxt, self.caches = self._step_fn(
+            self.params, self.caches, jnp.asarray(self._cur_tok),
+            jnp.asarray(self._positions), jnp.asarray(self._active),
+            jnp.asarray(self._temp), jnp.asarray(self._topk),
+            jnp.asarray(self._topp), jnp.asarray(self._keys))
+        jax.block_until_ready(nxt)
+        # slot-write program: rewrite row 0 with a copy of itself (a copy —
+        # the pool is donated, and XLA rejects donating a buffer aliased
+        # by another argument; inactive slots hold junk a prefill fully
+        # overwrites, so this is a no-op in the same sense as the
+        # free-slot decode rows)
+        row = tmap(lambda B: jnp.copy(B[0:1]), self.caches)
+        self.caches = self._write_slot_fn(self.caches, row, jnp.int32(0))
+        jax.block_until_ready(jax.tree_util.tree_leaves(self.caches)[0])
+        return self
+
     def _loop(self) -> None:
-        while self._running:
-            if not self.step():
-                with self._qlock:
-                    self._have_work.wait_for(
-                        lambda: bool(self._queue) or not self._running,
-                        timeout=0.05)
+        try:
+            while self._running:
+                if not self.step():
+                    with self._qlock:
+                        self._have_work.wait_for(
+                            lambda: bool(self._queue) or not self._running,
+                            timeout=0.05)
+        except Exception as e:
+            # a crashed decode loop fails loudly: every in-flight handle
+            # gets a typed EngineDead instead of hanging its waiter
+            logger.exception("serving engine decode loop crashed")
+            self._declare_dead(e)
 
     def __enter__(self) -> "ServingEngine":
         return self.start()
@@ -549,9 +878,12 @@ class ServingEngine:
 #: serving-protocol opcodes (this protocol's own namespace — a serving
 #: server port never speaks the PS protocol): 'q' enqueue request (frame:
 #: prompt + sampling params → ack/backpressure reply), 'r' stream reply
-#: (frame: {"id"} → chunk frames until {"done": True}).
+#: (frame: {"id"} → chunk frames until {"done": True}), 'x' cancel (frame:
+#: {"id"} → ack; mid-stream it is unacked — the stream's final frame
+#: carries finish="cancel").
 OP_ENQUEUE = networking.SERVING_OP_ENQUEUE
 OP_STREAM = networking.SERVING_OP_STREAM
+OP_CANCEL = networking.SERVING_OP_CANCEL
 
 
 class ServingServer:
@@ -560,29 +892,71 @@ class ServingServer:
     clients speak the exact wire the PS stack already speaks.
 
     Per connection: ``'q'`` + request frame → ack ``{"ok": True, "id": n}``
-    or backpressure ``{"ok": False, "error": "queue full"}`` (the bounded
-    admission queue shed the request — nothing was buffered); ``'r'`` +
-    ``{"id": n}`` → a stream of ``{"id", "tokens", "done"}`` chunk frames,
-    the last one carrying ``done=True`` + ``finish`` + the final padded
-    ``row``.  EOF closes the connection; the engine keeps running.
+    or a typed rejection (``kind`` ``"backpressure"`` / ``"draining"`` /
+    ``"engine_dead"`` / ``"bad_request"``); ``'r'`` + ``{"id": n}`` → a
+    stream of ``{"id", "tokens", "done"}`` chunk frames, the last one
+    carrying ``done=True`` + ``finish`` (eos/length/deadline/cancel/…) +
+    the final padded ``row`` (or a typed error instead of a row when the
+    engine died); ``'x'`` + ``{"id": n}`` → cancel ack.  EOF closes the
+    connection; the engine keeps running.
+
+    Failure semantics (this is the client-disconnect reclamation layer):
+
+     - every empty stream-poll slice (``poll_s``) checks the client socket
+       — EOF/RST cancels the streamed request, so an abandoned connection
+       reclaims its KV slot within one scheduler iteration of detection
+       instead of decoding to completion;
+     - a request is *owned* by the connection that submitted it (ownership
+       transfers to whichever connection streams it); when a connection
+       dies, its unfinished owned requests are cancelled
+       (``cancel_on_disconnect``, default True) and their handles
+       released — a dead client leaks neither slots nor handle entries;
+     - a stream that makes no progress is bounded by the request deadline
+       (plus a grace period) or, for deadline-less requests, by
+       ``stream_timeout_s`` — a stalled engine gets a typed ``"stall"``
+       error frame instead of pinning the handler thread for a fixed
+       minute;
+     - a torn/corrupt frame (``protocol_errors``) or transport fault
+       (``disconnects``) sheds the connection silently; its pooled
+       buffers are per-handler locals so they are released with it, and
+       ``live_connections`` decrements (asserted in
+       tests/test_serving_resilience.py).
     """
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, stream_timeout_s: float = 60.0,
+                 poll_s: float = 0.02, cancel_on_disconnect: bool = True):
         self.engine = engine
         self.host = host
         self.port = int(port)
+        self.stream_timeout_s = float(stream_timeout_s)
+        self.poll_s = float(poll_s)
+        self.cancel_on_disconnect = bool(cancel_on_disconnect)
         self._handles: Dict[int, RequestHandle] = {}
+        #: request id → owning connection (submitting conn, re-claimed by
+        #: the streaming conn) — the disconnect-reclamation bookkeeping
+        self._owner: Dict[int, socket.socket] = {}
         self._hlock = threading.Lock()
         self._server: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: List[socket.socket] = []
         self._lock = threading.Lock()
         self._running = False
+        self.disconnects = 0       # transport faults / EOF mid-frame
+        self.protocol_errors = 0   # corrupt frames (bad magic, length lies)
+        self.disconnect_cancels = 0  # requests reclaimed from dead clients
 
     @property
     def addr(self) -> Tuple[str, int]:
         return (self.host, self.port)
+
+    @property
+    def live_connections(self) -> int:
+        """Open client connections with a live handler (the serving twin of
+        ``SocketParameterServer.live_connections`` — shed connections must
+        decrement this, pooled buffers and all)."""
+        with self._lock:
+            return len(self._conns)
 
     def start(self) -> "ServingServer":
         self.engine.start()
@@ -655,7 +1029,9 @@ class ServingServer:
         # replies re-serialize into a reusable send buffer.  The send pool
         # is per-connection (BufferPool is lock-protected, but a shared
         # pool would still let another connection's encode overwrite a
-        # frame between encode and sendall).
+        # frame between encode and sendall).  Both are handler locals, so
+        # every exit path — clean EOF, torn frame, transport fault —
+        # releases them with the handler.
         recv_pool = networking.BufferPool()
         send_pool = networking.BufferPool()
         try:
@@ -675,45 +1051,65 @@ class ServingServer:
                             eos_id=msg.get("eos_id"),
                             pad_id=msg.get("pad_id"),
                             seed=int(msg.get("seed", 0)),
+                            deadline_s=msg.get("deadline_s"),
                             block=False)
                     except QueueFull:
                         networking.send_data(
-                            conn, {"ok": False, "error": "queue full"},
+                            conn, {"ok": False, "error": "queue full",
+                                   "kind": "backpressure"},
                             pool=send_pool)
+                        continue
+                    except Draining as e:
+                        networking.send_data(
+                            conn, {"ok": False, "error": str(e),
+                                   "kind": "draining"}, pool=send_pool)
+                        continue
+                    except EngineDead as e:
+                        networking.send_data(
+                            conn, {"ok": False, "error": str(e),
+                                   "kind": "engine_dead"}, pool=send_pool)
                         continue
                     except ValueError as e:
                         networking.send_data(
-                            conn, {"ok": False, "error": str(e)},
-                            pool=send_pool)
+                            conn, {"ok": False, "error": str(e),
+                                   "kind": "bad_request"}, pool=send_pool)
                         continue
                     with self._hlock:
                         self._handles[h.id] = h
+                        self._owner[h.id] = conn
                     networking.send_data(conn, {"ok": True, "id": h.id},
                                          pool=send_pool)
                 elif op == OP_STREAM:
                     msg = networking.recv_data(conn, pool=recv_pool)
+                    rid = int(msg["id"])
                     with self._hlock:
-                        h = self._handles.get(int(msg["id"]))
+                        h = self._handles.get(rid)
+                        if h is not None:
+                            self._owner[rid] = conn  # stream claims it
                     if h is None:
                         networking.send_data(
                             conn, {"ok": False, "done": True,
-                                   "error": f"unknown id {msg['id']}"},
+                                   "kind": "unknown_id",
+                                   "error": f"unknown id {rid}"},
                             pool=send_pool)
                         continue
-                    while True:
-                        chunk, done = h.next_chunk(timeout=60.0)
-                        reply = {"id": h.id, "tokens": chunk, "done": done}
-                        if done:
-                            reply["finish"] = h.finish
-                            reply["row"] = h.result()
-                        networking.send_data(conn, reply, pool=send_pool)
-                        if done:
-                            with self._hlock:
-                                self._handles.pop(h.id, None)
-                            break
+                    if not self._stream(conn, h, recv_pool, send_pool):
+                        return  # client gone mid-stream (finally reclaims)
+                elif op == OP_CANCEL:
+                    msg = networking.recv_data(conn, pool=recv_pool)
+                    with self._hlock:
+                        h = self._handles.get(int(msg["id"]))
+                    ok = h is not None and self.engine.cancel(h)
+                    networking.send_data(
+                        conn, {"ok": True, "cancelled": bool(ok)},
+                        pool=send_pool)
                 else:
                     return  # protocol violation: drop the connection
-        except (ConnectionError, OSError, ValueError):
+        except ValueError:
+            self.protocol_errors += 1  # corrupt frame: shed silently
+            return
+        except (ConnectionError, OSError):
+            self.disconnects += 1  # incl. a half-frame EOF/RST mid-recv
             return
         finally:
             try:
@@ -723,15 +1119,140 @@ class ServingServer:
             with self._lock:
                 if conn in self._conns:
                     self._conns.remove(conn)
+            self._release_owned(conn)
+
+    def _release_owned(self, conn: socket.socket) -> None:
+        """Disconnect reclamation: cancel this connection's unfinished
+        requests and drop their handle entries — a dead client's KV slot is
+        back in the pool within one scheduler iteration, and the handle
+        table does not grow with abandoned ids."""
+        with self._hlock:
+            owned = [rid for rid, c in self._owner.items() if c is conn]
+            handles = [self._handles.pop(rid, None) for rid in owned]
+            for rid in owned:
+                self._owner.pop(rid, None)
+        if not self.cancel_on_disconnect:
+            return
+        for h in handles:
+            if h is not None and self.engine.cancel(h):
+                self.disconnect_cancels += 1
+
+    def _stream(self, conn: socket.socket, h: RequestHandle,
+                recv_pool: "networking.BufferPool",
+                send_pool: "networking.BufferPool") -> bool:
+        """Relay ``h``'s token chunks until its final frame.  Bounded
+        waits: each empty ``poll_s`` slice checks the client socket for
+        EOF/RST (→ cancel + reclaim) or a mid-stream ``'x'`` cancel
+        opcode; a stream with no progress past the request deadline (+
+        grace) or ``stream_timeout_s`` sends a typed ``"stall"`` error
+        frame.  Returns False when the connection is gone."""
+        grace = max(1.0, 4 * self.poll_s)
+        waited = 0.0
+        while True:
+            # check the client side EVERY iteration (not just idle slices):
+            # a mid-stream cancel or disconnect must land even while chunks
+            # are flowing back-to-back
+            status = self._poll_client(conn, recv_pool)
+            if status == "dead":
+                if self.cancel_on_disconnect:
+                    self.engine.cancel(h)
+                return False
+            chunk, done = h.next_chunk(timeout=self.poll_s)
+            if not done and not len(chunk):
+                waited += self.poll_s
+                now = time.perf_counter()
+                stalled = (now > h.deadline + grace
+                           if h.deadline is not None
+                           else waited >= self.stream_timeout_s)
+                if stalled:
+                    # the engine should have retired this request by now —
+                    # it is wedged or dead; unblock the client with a typed
+                    # error frame instead of holding the handler thread
+                    with self._hlock:
+                        self._handles.pop(h.id, None)
+                        self._owner.pop(h.id, None)
+                    try:
+                        networking.send_data(
+                            conn, {"id": h.id, "ok": False, "done": True,
+                                   "tokens": np.zeros(0, np.int32),
+                                   "finish": "error", "kind": "stall",
+                                   "error": f"no progress on request "
+                                            f"{h.id} (engine stalled)"},
+                            pool=send_pool)
+                    except (ConnectionError, OSError):
+                        return False
+                    return True
+                continue
+            waited = 0.0
+            reply: Dict[str, Any] = {"id": h.id, "tokens": chunk,
+                                     "done": done}
+            if done:
+                reply["finish"] = h.finish
+                if h.error is not None:
+                    reply["ok"] = False
+                    reply["kind"] = "engine_dead"
+                    reply["error"] = str(h.error)
+                else:
+                    reply["row"] = h.result()
+            try:
+                networking.send_data(conn, reply, pool=send_pool)
+            except (ConnectionError, OSError):
+                if self.cancel_on_disconnect:
+                    self.engine.cancel(h)
+                return False
+            if done:
+                with self._hlock:
+                    self._handles.pop(h.id, None)
+                    self._owner.pop(h.id, None)
+                return True
+
+    def _poll_client(self, conn: socket.socket,
+                     recv_pool: "networking.BufferPool") -> str:
+        """Non-blocking client-socket check between stream chunks:
+        ``"idle"`` (nothing to read — the normal case), ``"dead"``
+        (EOF/RST — the disconnect-reclamation trigger), or ``"ok"`` after
+        consuming a mid-stream ``'x'`` cancel (any id; unacked — the
+        stream's final frame is the acknowledgement)."""
+        try:
+            readable, _, _ = select.select([conn], [], [], 0)
+            if not readable:
+                return "idle"
+            op = conn.recv(1)
+            if op == OP_CANCEL:
+                msg = networking.recv_data(conn, pool=recv_pool)
+                with self._hlock:
+                    target = self._handles.get(int(msg["id"]))
+                if target is not None:
+                    self.engine.cancel(target)
+                return "ok"
+        except (ConnectionError, OSError, ValueError):
+            return "dead"
+        # EOF (b"") or mid-stream protocol violation: the client is gone
+        return "dead"
+
+
+def _raise_typed(kind: Optional[str], err: str):
+    """Map a typed error reply back to the exception the engine raised."""
+    if kind == "backpressure" or "queue full" in err:
+        raise QueueFull(err)
+    if kind == "draining":
+        raise Draining(err)
+    if kind in ("engine_dead", "stall"):
+        raise EngineDead(err)
+    raise ValueError(err)
 
 
 class ServingClient:
     """Minimal client for :class:`ServingServer` — one socket, the shared
     frame codec, pooled receives.  ``generate`` is the one-call form whose
-    returned row matches offline ``generate`` for the same request."""
+    returned row matches offline ``generate`` for the same request; with a
+    ``retry_policy`` (``resilience.RetryPolicy``) it re-dials and
+    resubmits across engine deaths and connection resets — requests are
+    deterministic in their seed, so the retry is idempotent."""
 
     def __init__(self, host: str, port: int):
-        self.sock = networking.connect(host, int(port))
+        self.host, self.port = host, int(port)
+        self.sock = networking.connect(self.host, self.port)
         self._pool = networking.BufferPool()
         self._send_pool = networking.BufferPool()
 
@@ -741,6 +1262,10 @@ class ServingClient:
         except OSError:
             pass
 
+    def _redial(self) -> None:
+        self.close()
+        self.sock = networking.connect(self.host, self.port)
+
     def __enter__(self) -> "ServingClient":
         return self
 
@@ -748,30 +1273,45 @@ class ServingClient:
         self.close()
 
     def submit(self, prompt, num_steps: int, **kw) -> int:
-        """Enqueue a request; returns the server-assigned id.  Raises
-        :class:`QueueFull` on a backpressure reply."""
+        """Enqueue a request; returns the server-assigned id.  Raises the
+        typed rejection: :class:`QueueFull` (backpressure),
+        :class:`Draining`, :class:`EngineDead`, or ``ValueError``."""
         req = {"prompt": np.asarray(prompt, np.int32),
                "num_steps": int(num_steps), **kw}
         networking.send_opcode(self.sock, OP_ENQUEUE)
         networking.send_data(self.sock, req, pool=self._send_pool)
         ack = networking.recv_data(self.sock, pool=self._pool)
         if not ack.get("ok"):
-            err = ack.get("error", "rejected")
-            if "queue full" in str(err):
-                raise QueueFull(err)
-            raise ValueError(err)
+            _raise_typed(ack.get("kind"), str(ack.get("error", "rejected")))
         return int(ack["id"])
+
+    def cancel(self, rid: int, await_ack: bool = True) -> bool:
+        """Cancel request ``rid``.  With ``await_ack=False`` the cancel is
+        fire-and-forget — the form to use from another thread while THIS
+        socket is mid-stream (the ack would interleave with chunk frames;
+        the stream's final ``finish="cancel"`` frame is the
+        acknowledgement there)."""
+        networking.send_opcode(self.sock, OP_CANCEL)
+        networking.send_data(self.sock, {"id": int(rid)},
+                             pool=self._send_pool)
+        if not await_ack:
+            return True
+        ack = networking.recv_data(self.sock, pool=self._pool)
+        return bool(ack.get("cancelled"))
 
     def stream(self, rid: int):
         """Yield ``(tokens, done_reply)`` chunk by chunk; ``done_reply`` is
-        None until the final frame."""
+        None until the final frame (which carries ``finish`` —
+        eos/length/deadline/cancel — and the padded ``row``).  Typed error
+        frames raise: :class:`EngineDead` for ``engine_dead``/``stall``,
+        ``ValueError`` otherwise."""
         networking.send_opcode(self.sock, OP_STREAM)
         networking.send_data(self.sock, {"id": int(rid)},
                              pool=self._send_pool)
         while True:
             reply = networking.recv_data(self.sock, pool=self._pool)
             if reply.get("error"):
-                raise ValueError(reply["error"])
+                _raise_typed(reply.get("kind"), str(reply["error"]))
             tokens = np.array(reply["tokens"], np.int32, copy=True)
             if reply["done"]:
                 yield tokens, {"finish": reply["finish"],
@@ -780,11 +1320,33 @@ class ServingClient:
                 return
             yield tokens, None
 
-    def generate(self, prompt, num_steps: int, **kw) -> np.ndarray:
+    def generate(self, prompt, num_steps: int, retry_policy=None,
+                 **kw) -> np.ndarray:
         """Submit + stream to completion; returns the full padded row
-        (prompt + tokens), exactly ``generate``-shaped."""
-        rid = self.submit(prompt, num_steps, **kw)
-        for _, done in self.stream(rid):
-            if done is not None:
-                return done["row"]
-        raise ConnectionError("stream ended without a done frame")
+        (prompt + tokens), exactly ``generate``-shaped.  ``retry_policy``
+        (a ``resilience.RetryPolicy``) retries the whole submit+stream on
+        :class:`EngineDead` or a transport fault, re-dialing first — the
+        client-side half of the supervised-restart story."""
+        def attempt() -> np.ndarray:
+            rid = self.submit(prompt, num_steps, **kw)
+            for _, done in self.stream(rid):
+                if done is not None:
+                    return done["row"]
+            raise ConnectionError("stream ended without a done frame")
+
+        if retry_policy is None:
+            return attempt()
+
+        def redialing_attempt() -> np.ndarray:
+            try:
+                return attempt()
+            except (ConnectionError, OSError):
+                try:
+                    self._redial()
+                except OSError:
+                    pass  # server still down: the policy keeps backing off
+                raise
+
+        return retry_policy.call(
+            redialing_attempt,
+            retry_on=(EngineDead, ConnectionError, OSError))

@@ -74,10 +74,14 @@ def _percentile_ms(latencies_s: Sequence[float], q: float) -> Optional[float]:
 
 
 def _metrics(engine, latencies: List[float], wall_s: float,
-             tokens: int, completed: int, shed: int = 0) -> Dict[str, Any]:
+             tokens: int, completed: int, shed: int = 0,
+             killed: int = 0) -> Dict[str, Any]:
+    s = engine.stats
+    submitted = max(s["requests_submitted"], 1)
     return {
         "completed": completed,
         "shed": shed,
+        "killed": killed,
         "tokens": tokens,
         "wall_s": round(wall_s, 3),
         "tokens_per_sec": round(tokens / wall_s, 1) if wall_s > 0 else None,
@@ -85,30 +89,66 @@ def _metrics(engine, latencies: List[float], wall_s: float,
         "p99_ms": _percentile_ms(latencies, 99),
         "slot_occupancy": (round(engine.slot_occupancy, 3)
                            if engine.slot_occupancy is not None else None),
+        # failure-semantics observables (engine-lifetime rates: loadgen
+        # engines are built fresh per run)
+        "shed_rate": round(s["requests_rejected"] / submitted, 4),
+        "deadline_miss_rate": round(s["requests_expired"] / submitted, 4),
+        "slot_reclaim_ms": (round(float(np.mean(s["slot_reclaim_ms"])), 3)
+                            if s["slot_reclaim_ms"] else None),
     }
 
 
 def run_closed_loop(engine, trace: Sequence[Dict[str, Any]],
-                    concurrency: int = 8,
-                    timeout_s: float = 300.0) -> Dict[str, Any]:
+                    concurrency: int = 8, timeout_s: float = 300.0,
+                    chaos_kill: float = 0.0, chaos_seed: int = 0,
+                    deadline_s: Optional[float] = None) -> Dict[str, Any]:
     """``concurrency`` users, each submitting its next trace request when
     its previous one finishes.  Returns throughput/latency/occupancy
-    metrics; the engine runs on its background thread for the duration."""
-    it = iter(trace)
+    metrics; the engine runs on its background thread for the duration.
+
+    ``chaos_kill`` > 0 turns on the seeded client-kill schedule (the
+    ``--chaos`` soak): each request is independently "killed" with that
+    probability — its user reads a seeded number of tokens, cancels the
+    request (the in-process analog of a client hard-disconnect, which the
+    wire server converts to exactly this cancel), and moves on without
+    waiting.  ``deadline_s`` stamps every request with a per-request
+    deadline.  Killed/expired requests are excluded from the latency
+    percentiles; the kill schedule is a pure function of
+    ``(chaos_seed, request index)``."""
+    it = iter(enumerate(trace))
     lock = threading.Lock()
     latencies: List[float] = []
     errors: List[BaseException] = []
+    killed: List[Any] = []
+    kill_rng = np.random.default_rng(int(chaos_seed) + (1 << 20))
+    kill_plan = {i: (float(kill_rng.random()) < chaos_kill,
+                     int(kill_rng.integers(1, 8)))
+                 for i in range(len(trace))} if chaos_kill > 0 else {}
     tokens0 = engine.stats["tokens_generated"]
     completed0 = engine.stats["requests_completed"]
 
     def user():
         while True:
             with lock:
-                req = next(it, None)
+                i, req = next(it, (None, None))
             if req is None:
                 return
+            kill, after = kill_plan.get(i, (False, 0))
             try:
-                h = engine.submit(block=True, timeout=timeout_s, **req)
+                kw = dict(req)
+                if deadline_s is not None:
+                    kw["deadline_s"] = deadline_s
+                h = engine.submit(block=True, timeout=timeout_s, **kw)
+                if kill:
+                    # killed client: consume a few tokens, then vanish
+                    deadline = time.perf_counter() + timeout_s
+                    while (len(h.tokens) < after and not h.done
+                           and time.perf_counter() < deadline):
+                        time.sleep(0.001)
+                    engine.cancel(h)
+                    with lock:
+                        killed.append(h)
+                    continue
                 if not h.wait(timeout=timeout_s):
                     raise TimeoutError(f"request {h.id} incomplete")
             except BaseException as e:  # noqa: BLE001 - surfaced below
@@ -116,7 +156,8 @@ def run_closed_loop(engine, trace: Sequence[Dict[str, Any]],
                     errors.append(e)
                 return
             with lock:
-                latencies.append(h.latency_s)
+                if h.finish in ("eos", "length", "empty"):
+                    latencies.append(h.latency_s)
 
     engine.start()
     threads = [threading.Thread(target=user, name=f"loadgen-user-{i}")
@@ -131,7 +172,8 @@ def run_closed_loop(engine, trace: Sequence[Dict[str, Any]],
         raise errors[0]
     return _metrics(engine, latencies, wall,
                     engine.stats["tokens_generated"] - tokens0,
-                    engine.stats["requests_completed"] - completed0)
+                    engine.stats["requests_completed"] - completed0,
+                    killed=len(killed))
 
 
 def run_open_loop(engine, trace: Sequence[Dict[str, Any]], qps: float,
@@ -236,6 +278,14 @@ def main():
     ap.add_argument("--temperature", type=float, default=0.7)
     ap.add_argument("--qps-sweep", type=str, default="",
                     help="comma-separated offered-QPS points (open loop)")
+    ap.add_argument("--chaos", type=float, default=0.0,
+                    help="seeded client-kill probability per request "
+                         "(closed loop): killed users read a few tokens, "
+                         "cancel, and vanish — the disconnect-reclamation "
+                         "soak")
+    ap.add_argument("--chaos-seed", type=int, default=0)
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="per-request deadline_s stamped on every request")
     args = ap.parse_args()
 
     fitted, engine = build_engine(num_slots=args.slots)
@@ -243,7 +293,10 @@ def main():
                        temperature=args.temperature)
     try:
         closed = run_closed_loop(engine, trace,
-                                 concurrency=args.concurrency)
+                                 concurrency=args.concurrency,
+                                 chaos_kill=args.chaos,
+                                 chaos_seed=args.chaos_seed,
+                                 deadline_s=args.deadline)
         print(json.dumps({"mode": "closed_loop",
                           "concurrency": args.concurrency, **closed}))
         seq = sequential_baseline(fitted, trace, max_len=engine.max_len)

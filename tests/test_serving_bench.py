@@ -85,7 +85,44 @@ def test_bench_serving_fields_shape():
     out = bench.serving_bench(budget_s=0.0)  # force the overrun path
     assert set(out) == {"serving_tokens_per_sec", "serving_p50_ms",
                         "serving_p99_ms", "serving_slot_occupancy",
-                        "serving_sequential_tokens_per_sec"}
+                        "serving_sequential_tokens_per_sec",
+                        "serving_shed_rate", "serving_slot_reclaim_ms",
+                        "serving_deadline_miss_rate"}
+
+
+def test_closed_loop_chaos_kill_schedule_no_leaks():
+    """The --chaos client-kill schedule: seeded kills cancel mid-run, the
+    engine reclaims every slot (zero leaks), survivors complete, and the
+    new failure-semantics metrics are recorded."""
+    _, engine = loadgen.build_engine(num_slots=2, queue_capacity=16)
+    trace = loadgen.make_trace(8, num_steps=8, temperature=0.5)
+    try:
+        m = loadgen.run_closed_loop(engine, trace, concurrency=4,
+                                    timeout_s=120.0, chaos_kill=0.4,
+                                    chaos_seed=3)
+    finally:
+        engine.stop()
+    assert m["killed"] > 0  # the seeded schedule really killed someone
+    # every request reached a terminal state: zero leaks
+    s = engine.stats
+    assert s["requests_submitted"] == 8
+    assert m["completed"] == 8  # completed counts every retirement
+    assert s["requests_cancelled"] + s["requests_expired"] >= 1
+    assert not engine._active.any()
+    assert sorted(engine._free) == list(range(engine.num_slots))
+    # metric fields recorded (killed requests excluded from latencies)
+    assert m["slot_reclaim_ms"] is None or m["slot_reclaim_ms"] >= 0
+    assert 0.0 <= m["deadline_miss_rate"] <= 1.0
+    assert 0.0 <= m["shed_rate"] <= 1.0
+    # determinism: the kill schedule is a pure function of the seed
+    _, engine2 = loadgen.build_engine(num_slots=2, queue_capacity=16)
+    try:
+        m2 = loadgen.run_closed_loop(engine2, trace, concurrency=4,
+                                     timeout_s=120.0, chaos_kill=0.4,
+                                     chaos_seed=3)
+    finally:
+        engine2.stop()
+    assert m2["killed"] == m["killed"]
 
 
 @pytest.mark.slow

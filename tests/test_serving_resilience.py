@@ -1,0 +1,767 @@
+"""Serving-path fault tolerance (PR 8): deadlines, cancellation/disconnect
+reclamation, graceful drain, the supervised engine, and the serving chaos
+matrix.
+
+The contract pinned here is the serving twin of the host-PS robustness
+stack (PRs 3/5):
+
+ - a request can always be *retired early* — deadline expiry, explicit
+   cancel (wire ``'x'`` or in-process), or client disconnect — and its KV
+   slot returns to the pool within one scheduler iteration, with the
+   retire reason (``finish``) carried to the client on the final stream
+   frame;
+ - no handle ever blocks forever: a crashed or wedged decode loop fails
+   every in-flight handle with a typed ``EngineDead`` (inline and
+   background modes, ``stop(join_timeout)`` leaks included), and the wire
+   server bounds its stream waits by the request deadline /
+   ``stream_timeout_s`` with a typed ``"stall"`` frame;
+ - ``drain`` stops admission (``Draining``), finishes in-flight work,
+   then stops;
+ - ``EngineSupervisor`` detects crash AND wedge (decode-loop heartbeat),
+   restarts from the model weights with a fresh slot pool, and
+   ``ServingClient.generate(retry_policy=...)`` resubmits idempotently —
+   surviving requests stay bit-identical to offline ``generate``;
+ - every fault in the chaos matrix {client reset mid-stream, client
+   stall, explicit cancel, deadline expiry, engine crash} reclaims the
+   affected slot while unaffected concurrent requests produce output
+   bit-identical to offline ``generate``.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from distkeras_tpu import networking
+from distkeras_tpu.core.model import FittedModel
+from distkeras_tpu.networking import ChaosFault, ChaosProxy
+from distkeras_tpu.models import transformer_lm
+from distkeras_tpu.resilience import EngineSupervisor, RetryPolicy
+from distkeras_tpu.serving import (Draining, EngineDead, QueueFull,
+                                   ServingClient, ServingEngine,
+                                   ServingServer)
+
+VOCAB = 17
+PROMPT = np.array([3, 4, 5, 6], np.int32)
+OTHER = np.array([7, 8, 9], np.int32)
+
+
+def _fitted(seed=0, **kw):
+    model = transformer_lm(vocab_size=VOCAB, seq_len=32, d_model=16,
+                           num_heads=2, num_layers=2, mlp_dim=32,
+                           compute_dtype="float32", **kw)
+    params = model.init(jax.random.PRNGKey(seed), (32,))
+    return FittedModel(model, params)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    return _fitted()
+
+
+def _want(fitted, prompt, steps, **kw):
+    seed = kw.pop("seed", None)
+    if seed is not None:
+        kw["rng"] = jax.random.PRNGKey(seed)
+    return np.asarray(fitted.generate(prompt[None], steps, max_len=24,
+                                      **kw))[0]
+
+
+def _hard_close(sock):
+    """RST (SO_LINGER=0) — the signature of a killed client process."""
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                    struct.pack("ii", 1, 0))
+    sock.close()
+
+
+def _wedge(engine):
+    """Monkey-wedge an engine's decode step on an Event (released by the
+    returned callable — always call it in teardown)."""
+    ev = threading.Event()
+    engine._decode_once = lambda: ev.wait(120.0)
+    return ev.set
+
+
+def _wait_for(pred, timeout=10.0, interval=0.005):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(interval)
+    return True
+
+
+def _assert_slots_reclaimed(engine):
+    assert not engine._active.any()
+    assert sorted(engine._free) == list(range(engine.num_slots))
+    assert all(h is None for h in engine._handles)
+
+
+# ---------------------------------------------------------------------------
+# per-request deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_queued_shed_before_prefill(fitted):
+    """A queued request whose deadline expires is retired WITHOUT ever
+    taking a slot; the running request is untouched."""
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    running = eng.submit(PROMPT, 12)
+    queued = eng.submit(OTHER, 5, deadline_s=0.01)
+    eng.step()          # prefills `running` only
+    time.sleep(0.03)    # the queued deadline passes
+    eng.run_until_idle()
+    assert queued.finish == "deadline"
+    assert queued.slot is None and queued.started_at is None
+    assert running.finish == "length"
+    np.testing.assert_array_equal(running.result(),
+                                  _want(fitted, PROMPT, 12))
+    # the shed request still returns a generate-shaped (all-pad) row
+    row = queued.result()
+    assert row.shape == (len(OTHER) + 5,)
+    np.testing.assert_array_equal(row[:len(OTHER)], OTHER)
+    assert eng.stats["requests_expired"] == 1
+    _assert_slots_reclaimed(eng)
+
+
+def test_deadline_expires_mid_run_frees_slot(fitted):
+    """A running request past its deadline is retired mid-run — partial
+    tokens kept, slot freed immediately — while a concurrent request
+    stays bit-identical to offline generate."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    doomed = eng.submit(PROMPT, 16, deadline_s=0.05)
+    healthy = eng.submit(OTHER, 10, temperature=0.6, seed=5)
+    eng.step()
+    eng.step()  # both prefilled + decoding
+    time.sleep(0.06)
+    eng.run_until_idle()
+    assert doomed.finish == "deadline"
+    assert 1 <= len(doomed.tokens) < 16  # partial, padded by result()
+    assert healthy.finish == "length"
+    np.testing.assert_array_equal(
+        healthy.result(), _want(fitted, OTHER, 10, temperature=0.6, seed=5))
+    assert eng.stats["requests_expired"] == 1
+    assert len(eng.stats["slot_reclaim_ms"]) == 1
+    _assert_slots_reclaimed(eng)
+
+
+def test_engine_wide_default_deadline(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24,
+                        default_deadline_s=0.02)
+    h = eng.submit(PROMPT, 16)
+    assert h.deadline is not None
+    time.sleep(0.04)
+    eng.run_until_idle()
+    assert h.finish == "deadline"
+    # an explicit per-request deadline overrides the default
+    h2 = eng.submit(PROMPT, 4, deadline_s=30.0)
+    eng.run_until_idle()
+    assert h2.finish == "length"
+
+
+def test_deadline_validation(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    with pytest.raises(ValueError, match="deadline_s"):
+        eng.submit(PROMPT, 4, deadline_s=0.0)
+    with pytest.raises(ValueError, match="default_deadline_s"):
+        ServingEngine(fitted, num_slots=1, max_len=24,
+                      default_deadline_s=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# cancellation: in-process, wire opcode, disconnect reclamation
+# ---------------------------------------------------------------------------
+
+def test_cancel_queued_and_running(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    running = eng.submit(PROMPT, 16)
+    queued = eng.submit(OTHER, 8)
+    eng.step()  # prefill `running`
+    assert eng.cancel(queued)
+    eng.step()
+    assert queued.finish == "cancel" and queued.slot is None
+    assert eng.cancel(running)
+    eng.step()  # the reap retires it before any further decode
+    assert running.finish == "cancel"
+    assert not eng.cancel(running)  # already finished
+    assert eng.stats["requests_cancelled"] == 2
+    assert len(eng.stats["slot_reclaim_ms"]) == 2
+    _assert_slots_reclaimed(eng)
+
+
+def test_cancel_wire_opcode_and_finish_reason(fitted):
+    with ServingServer(ServingEngine(fitted, num_slots=1, max_len=24),
+                       poll_s=0.01) as srv:
+        with ServingClient(*srv.addr) as c:
+            rid = c.submit(PROMPT, 16)
+            assert c.cancel(rid) is True
+            chunks, final = [], None
+            for tokens, done in c.stream(rid):
+                chunks.append(tokens)
+                if done is not None:
+                    final = done
+            assert final["finish"] == "cancel"
+            # the padded row is still generate-shaped
+            assert final["row"].shape == (len(PROMPT) + 16,)
+            assert c.cancel(999) is False  # unknown id: not cancelled
+    assert srv.engine.stats["requests_cancelled"] == 1
+
+
+def test_midstream_cancel_same_socket(fitted):
+    """A cancel sent on the SAME socket mid-stream is consumed between
+    chunk frames (unacked); the stream's final frame carries
+    finish="cancel"."""
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    with ServingServer(eng, poll_s=0.01) as srv:
+        with ServingClient(*srv.addr) as c:
+            rid = c.submit(PROMPT, 16)
+            gen = c.stream(rid)
+            next(gen)  # stream established, first chunk read
+            c.cancel(rid, await_ack=False)  # fire-and-forget mid-stream
+            final = None
+            for tokens, done in gen:
+                if done is not None:
+                    final = done
+            assert final["finish"] in ("cancel", "length")
+    _wait_for(lambda: not eng._active.any())
+    _assert_slots_reclaimed(eng)
+
+
+def test_client_disconnect_mid_stream_reclaims_slot(fitted):
+    """A client that RSTs mid-stream has its request cancelled within one
+    poll slice — the slot is back in the pool long before the request
+    would have decoded to completion."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    with ServingServer(eng, poll_s=0.01) as srv:
+        c = ServingClient(*srv.addr)
+        rid = c.submit(PROMPT, 16)
+        gen = c.stream(rid)
+        next(gen)           # one chunk, then the client dies
+        _hard_close(c.sock)
+        assert _wait_for(lambda: eng.stats["requests_cancelled"] >= 1)
+        assert _wait_for(lambda: not eng._active.any())
+        assert srv.disconnect_cancels >= 1
+        assert _wait_for(lambda: srv.live_connections == 0)
+        # the engine keeps serving: a fresh client is bit-identical
+        with ServingClient(*srv.addr) as c2:
+            np.testing.assert_array_equal(c2.generate(OTHER, 10),
+                                          _want(fitted, OTHER, 10))
+        _assert_slots_reclaimed(eng)
+        with srv._hlock:  # no handle-table leak for the abandoned id
+            assert rid not in srv._handles and rid not in srv._owner
+
+
+def test_submit_then_die_reclaims_ownership(fitted):
+    """A connection that submitted (but never streamed) and died has its
+    owned request cancelled — a dead client pins neither slot nor handle
+    entry."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    with ServingServer(eng, poll_s=0.01) as srv:
+        doomed = ServingClient(*srv.addr)
+        doomed.submit(PROMPT, 16)
+        with ServingClient(*srv.addr) as healthy:
+            rid = healthy.submit(OTHER, 10, temperature=0.6, seed=5)
+            _hard_close(doomed.sock)
+            row = None
+            for tokens, done in healthy.stream(rid):
+                if done is not None:
+                    row = done["row"]
+            np.testing.assert_array_equal(
+                row, _want(fitted, OTHER, 10, temperature=0.6, seed=5))
+        assert _wait_for(lambda: eng.stats["requests_cancelled"] >= 1)
+        assert _wait_for(lambda: not eng._active.any())
+        _assert_slots_reclaimed(eng)
+        with srv._hlock:
+            assert not srv._handles and not srv._owner
+
+
+@pytest.mark.parametrize("codec", ["python", "native"])
+def test_half_frame_disconnect_sheds_connection(fitted, codec, monkeypatch):
+    """Half a serving request frame then RST (both codecs): the handler
+    sheds the connection silently — live bookkeeping decrements, pooled
+    buffers go with the handler — and the engine keeps serving."""
+    if codec == "python":
+        monkeypatch.setattr(networking, "_native", None)
+    elif networking._native is None:
+        pytest.skip("native codec not built")
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    with ServingServer(eng) as srv:
+        raw = networking.connect(*srv.addr)
+        frame = networking.encode_message(
+            {"prompt": PROMPT, "num_steps": 8})
+        networking.send_opcode(raw, networking.SERVING_OP_ENQUEUE)
+        raw.sendall(bytes(frame)[:len(frame) // 2])  # torn mid-frame
+        _hard_close(raw)
+        assert _wait_for(
+            lambda: srv.disconnects + srv.protocol_errors >= 1)
+        assert _wait_for(lambda: srv.live_connections == 0)
+        # nothing reached the engine; it still serves new clients
+        assert eng.stats["requests_submitted"] == 0
+        with ServingClient(*srv.addr) as c:
+            np.testing.assert_array_equal(c.generate(PROMPT, 6),
+                                          _want(fitted, PROMPT, 6))
+
+
+def test_stalled_engine_sends_typed_error_frame(fitted):
+    """Satellite: the handler's stream wait is bounded (stream_timeout_s /
+    request deadline), not a hardcoded minute — a wedged engine yields a
+    typed "stall" error frame, and the connection stays usable."""
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    release = _wedge(eng)
+    try:
+        with ServingServer(eng, poll_s=0.02, stream_timeout_s=0.3) as srv:
+            with ServingClient(*srv.addr) as c:
+                rid = c.submit(PROMPT, 8)
+                t0 = time.monotonic()
+                with pytest.raises(EngineDead, match="stall|progress"):
+                    for _ in c.stream(rid):
+                        pass
+                assert time.monotonic() - t0 < 5.0  # not 60 s
+                # same connection still answers (cancel ack round-trip)
+                assert c.cancel(rid) in (True, False)
+            release()  # unwedge BEFORE the server stops the engine
+    finally:
+        release()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------------
+
+def test_drain_finishes_inflight_then_stops(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24).start()
+    h1 = eng.submit(PROMPT, 8)
+    h2 = eng.submit(OTHER, 5)  # queued behind h1 on the lone slot
+    assert eng.drain(timeout=60.0) is True
+    assert h1.finish == "length" and h2.finish == "length"
+    np.testing.assert_array_equal(h1.result(), _want(fitted, PROMPT, 8))
+    np.testing.assert_array_equal(h2.result(), _want(fitted, OTHER, 5))
+    with pytest.raises(Draining):
+        eng.submit(PROMPT, 4)
+    assert eng._thread is None  # stopped
+    _assert_slots_reclaimed(eng)
+
+
+def test_drain_inline_engine(fitted):
+    """An engine never start()ed is driven to idle by drain itself."""
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    h = eng.submit(PROMPT, 6)
+    assert eng.drain(timeout=60.0) is True
+    assert h.finish == "length"
+
+
+def test_drain_over_the_wire_is_typed(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    with ServingServer(eng) as srv:
+        with ServingClient(*srv.addr) as c:
+            np.testing.assert_array_equal(c.generate(PROMPT, 4),
+                                          _want(fitted, PROMPT, 4))
+            assert eng.drain(timeout=60.0) is True
+            with pytest.raises(Draining):
+                c.submit(PROMPT, 4)
+
+
+def test_drain_timeout_fails_leftovers_typed(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    h = eng.submit(PROMPT, 8)
+    release = _wedge(eng)
+    try:
+        eng.start()
+        _wait_for(lambda: eng._active.any())
+        t0 = time.monotonic()
+        assert eng.drain(timeout=0.2) is False
+        assert time.monotonic() - t0 < 8.0
+        assert h.finish == "drain"
+        with pytest.raises(EngineDead, match="drain timed out"):
+            h.result()
+    finally:
+        release()
+
+
+# ---------------------------------------------------------------------------
+# crashed / wedged engine: typed failure, no silent hangs
+# ---------------------------------------------------------------------------
+
+def test_inline_crash_fails_handles_and_raises(fitted):
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    h1 = eng.submit(PROMPT, 8)
+    h2 = eng.submit(OTHER, 8)
+
+    def boom():
+        raise RuntimeError("chaos: decode crashed")
+
+    eng._decode_once = boom
+    with pytest.raises(RuntimeError, match="chaos"):
+        eng.run_until_idle()
+    for h in (h1, h2):
+        assert h.finish == "error"
+        with pytest.raises(EngineDead):
+            h.result()
+    with pytest.raises(EngineDead):
+        eng.submit(PROMPT, 2)
+    assert eng.dead is not None
+    assert eng.stats["requests_failed"] == 2
+
+
+def test_background_crash_fails_handles_within_deadline(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    h = eng.submit(PROMPT, 8)
+
+    def boom():
+        raise RuntimeError("chaos: decode crashed")
+
+    eng._decode_once = boom
+    eng.start()
+    assert h.wait(timeout=10.0), "handle must fail, not hang"
+    with pytest.raises(EngineDead, match="chaos"):
+        h.result()
+    eng.stop()
+
+
+def test_stop_join_timeout_surfaces_wedged_thread(fitted):
+    """Satellite: stop() on a wedged decode thread logs, fails in-flight
+    handles typed, and returns — instead of pretending a clean stop."""
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    h = eng.submit(PROMPT, 8)
+    release = _wedge(eng)
+    try:
+        eng.start()
+        _wait_for(lambda: eng._active.any())
+        t0 = time.monotonic()
+        eng.stop(join_timeout=0.2)
+        assert time.monotonic() - t0 < 8.0
+        assert eng.dead is not None
+        with pytest.raises(EngineDead, match="wedged"):
+            h.result(timeout=5.0)
+    finally:
+        release()
+
+
+# ---------------------------------------------------------------------------
+# EngineSupervisor: detect crash + wedge, restart, client retry
+# ---------------------------------------------------------------------------
+
+def test_supervisor_restarts_crashed_engine_and_client_retries(fitted):
+    eng = ServingEngine(fitted, num_slots=2, max_len=24).warmup()
+    want = _want(fitted, PROMPT, 6)
+    with ServingServer(eng, poll_s=0.01) as srv:
+        with EngineSupervisor(srv, heartbeat_interval=0.05,
+                              liveness_deadline=2.0) as sup:
+            with ServingClient(*srv.addr) as c:
+                np.testing.assert_array_equal(c.generate(PROMPT, 6), want)
+
+                def boom():
+                    raise RuntimeError("chaos: decode crashed")
+
+                eng._decode_once = boom
+                row = c.generate(
+                    PROMPT, 6,
+                    retry_policy=RetryPolicy(attempts=40, backoff=0.05))
+                np.testing.assert_array_equal(row, want)  # bit-identical
+            assert srv.engine is not eng
+            assert srv.engine.dead is None
+            assert len(sup.recoveries) == 1
+            rec = sup.recoveries[0]
+            assert rec["reason"] == "crashed" and rec["restarted"]
+            assert rec["recovery_ms"] is not None
+            _assert_slots_reclaimed(srv.engine)
+
+
+def test_supervisor_detects_wedged_engine_via_heartbeat(fitted):
+    eng = ServingEngine(fitted, num_slots=2, max_len=24).warmup()
+    want = _want(fitted, PROMPT, 6)
+    release = _wedge(eng)
+    try:
+        with ServingServer(eng, poll_s=0.01) as srv:
+            with EngineSupervisor(srv, heartbeat_interval=0.05,
+                                  liveness_deadline=0.5) as sup:
+                with ServingClient(*srv.addr) as c:
+                    row = c.generate(
+                        PROMPT, 6,
+                        retry_policy=RetryPolicy(attempts=60, backoff=0.05))
+                    np.testing.assert_array_equal(row, want)
+                assert len(sup.recoveries) == 1, sup.recoveries
+                assert sup.recoveries[0]["reason"] == "wedged"
+    finally:
+        release()
+
+
+def test_supervisor_without_restart_fails_typed(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24).warmup()
+    h = eng.submit(PROMPT, 8)
+
+    def boom():
+        raise RuntimeError("chaos: decode crashed")
+
+    eng._decode_once = boom
+    eng.start()
+    with EngineSupervisor(eng, heartbeat_interval=0.05,
+                          liveness_deadline=1.0, restart=False) as sup:
+        assert h.wait(timeout=10.0)
+        with pytest.raises(EngineDead):
+            h.result()
+        assert _wait_for(lambda: len(sup.recoveries) == 1)
+        assert not sup.recoveries[0]["restarted"]
+        assert sup.engine is eng  # no replacement
+    with pytest.raises(EngineDead):
+        eng.submit(PROMPT, 2)
+    eng.stop()
+
+
+def test_respawn_clone_preserves_knobs_and_numerics(fitted):
+    eng = ServingEngine(fitted, num_slots=3, max_len=24, queue_capacity=7,
+                        prefills_per_step=2, default_deadline_s=9.0)
+    clone = eng.respawn_clone().warmup()
+    assert clone.num_slots == 3 and clone.queue_capacity == 7
+    assert clone.prefills_per_step == 2
+    assert clone.default_deadline_s == 9.0
+    h = clone.submit(PROMPT, 8, temperature=0.7, top_k=5, seed=11)
+    clone.run_until_idle()
+    np.testing.assert_array_equal(
+        h.result(),
+        _want(fitted, PROMPT, 8, temperature=0.7, top_k=5, seed=11))
+
+
+def test_warmup_refuses_active_engine_and_keeps_bit_identity(fitted):
+    eng = ServingEngine(fitted, num_slots=2, max_len=24).warmup()
+    h = eng.submit(PROMPT, 8, temperature=0.7, seed=11)
+    eng.step()
+    with pytest.raises(RuntimeError, match="active"):
+        eng.warmup()
+    eng.run_until_idle()
+    np.testing.assert_array_equal(
+        h.result(), _want(fitted, PROMPT, 8, temperature=0.7, seed=11))
+
+
+# ---------------------------------------------------------------------------
+# the serving chaos matrix (ChaosProxy serving protocol)
+# ---------------------------------------------------------------------------
+
+def test_chaos_proxy_serving_clean_relay(fitted):
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    with ServingServer(eng, poll_s=0.01) as srv:
+        with ChaosProxy(*srv.addr, protocol="serving") as px:
+            with ServingClient(*px.addr) as c:
+                np.testing.assert_array_equal(
+                    c.generate(PROMPT, 8, temperature=0.6, seed=3),
+                    _want(fitted, PROMPT, 8, temperature=0.6, seed=3))
+
+
+@pytest.mark.parametrize("fault", [
+    ChaosFault(0, 0, "reset"),        # request dropped + RST at 'q'
+    ChaosFault(0, 0, "tear"),         # half the enqueue frame, then RST
+    ChaosFault(0, 1, "cut_stream", 2),  # RST mid-stream after 2 chunks
+    ChaosFault(0, 0, "delay", 0.05),  # delayed but successful
+])
+def test_chaos_matrix_slot_reclaimed_others_bit_identical(fitted, fault):
+    """For each scripted fault at an exact (conn, opcode) point: the
+    affected slot is reclaimed, no handle blocks forever, and an
+    unaffected concurrent request (direct connection) stays bit-identical
+    to offline generate."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    want_other = _want(fitted, OTHER, 10, temperature=0.6, seed=5)
+    with ServingServer(eng, poll_s=0.01) as srv:
+        with ChaosProxy(*srv.addr, protocol="serving",
+                        faults=[fault]) as px:
+            faulted = ServingClient(*px.addr)
+            healthy = ServingClient(*srv.addr)  # bypasses the proxy
+            rid_h = healthy.submit(OTHER, 10, temperature=0.6, seed=5)
+            outcome = None
+            try:
+                row = faulted.generate(PROMPT, 16)
+                outcome = "completed"
+            except (ConnectionError, OSError, ValueError, QueueFull):
+                outcome = "faulted"
+            if fault.action == "delay":
+                assert outcome == "completed"
+                np.testing.assert_array_equal(row,
+                                              _want(fitted, PROMPT, 16))
+            else:
+                assert outcome == "faulted"
+            assert px.injected == [(0, fault.op_index, fault.action)]
+            # the unaffected request is bit-identical
+            final = None
+            for tokens, done in healthy.stream(rid_h):
+                if done is not None:
+                    final = done
+            np.testing.assert_array_equal(final["row"], want_other)
+            faulted.close()
+            healthy.close()
+        # every slot reclaimed, nothing active, nothing leaked
+        assert _wait_for(lambda: not eng._active.any())
+        assert _wait_for(lambda: srv.live_connections == 0)
+        _assert_slots_reclaimed(eng)
+        with srv._hlock:
+            assert not srv._handles and not srv._owner
+
+
+def test_chaos_client_stall_reclaims_via_deadline(fitted):
+    """The "client stall" row of the matrix: a client that submits and
+    never streams (connection held open, nothing read) cannot pin a slot
+    past the request deadline."""
+    eng = ServingEngine(fitted, num_slots=1, max_len=24,
+                        default_deadline_s=0.3)
+    with ServingServer(eng, poll_s=0.01) as srv:
+        stalled = ServingClient(*srv.addr)
+        rid = stalled.submit(PROMPT, 16)  # never streams, just sits there
+        # a second client's request gets the slot after the deadline
+        with ServingClient(*srv.addr) as c:
+            np.testing.assert_array_equal(
+                c.generate(OTHER, 6, deadline_s=30.0),
+                _want(fitted, OTHER, 6))
+        assert eng.stats["requests_expired"] >= 1
+        _assert_slots_reclaimed(eng)
+        # the stalled client wakes up late: the final frame tells it WHY
+        # its request ended (retire reason "deadline" on the wire)
+        final = None
+        for tokens, done in stalled.stream(rid):
+            if done is not None:
+                final = done
+        assert final["finish"] == "deadline"
+        assert final["row"].shape == (len(PROMPT) + 16,)
+        stalled.close()
+
+
+# ---------------------------------------------------------------------------
+# hot reload under PS death (claimed in PR 6's docstring, now pinned)
+# ---------------------------------------------------------------------------
+
+def test_attach_ps_keeps_serving_when_ps_dies_mid_pull(fitted):
+    """The PS answers one pull with HALF a frame then RSTs (and is gone
+    for good) — the engine logs, keeps the current weights, and output
+    stays bit-identical to offline generate with those weights."""
+    ready = threading.Event()
+    addr = {}
+
+    def half_frame_ps():
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        addr["port"] = srv.getsockname()[1]
+        ready.set()
+        try:
+            conn, _ = srv.accept()
+            conn.recv(1)  # the 'p' pull opcode
+            frame = networking.encode_message(
+                {"weights": [np.zeros((4, 4), np.float32)]})
+            conn.sendall(bytes(frame)[:len(frame) // 2])
+            _hard_close(conn)
+        finally:
+            srv.close()
+
+    t = threading.Thread(target=half_frame_ps, daemon=True)
+    t.start()
+    assert ready.wait(timeout=5.0)
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    eng.attach_ps("127.0.0.1", addr["port"], every=1)
+    h = eng.submit(PROMPT, 8)
+    eng.run_until_idle()
+    t.join(timeout=5.0)
+    assert eng.stats["weight_reloads"] == 0  # pull failed, weights kept
+    np.testing.assert_array_equal(h.result(), _want(fitted, PROMPT, 8))
+    # the dead PS stays dead; serving continues regardless
+    h2 = eng.submit(OTHER, 5)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h2.result(), _want(fitted, OTHER, 5))
+
+
+# ---------------------------------------------------------------------------
+# slow soak: seeded client kills + one supervised engine crash
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_soak_killed_clients_and_engine_crash_zero_leaks(fitted):
+    """~10% of clients RST mid-stream, and the engine is crashed once
+    mid-run under supervision: zero slot leaks, zero lost surviving
+    requests, every surviving row bit-identical to offline generate."""
+    rng = np.random.default_rng(0)
+    reqs = []
+    for i in range(30):
+        p_len = int(rng.integers(2, 6))
+        reqs.append({
+            "prompt": rng.integers(0, VOCAB, p_len).astype(np.int32),
+            "num_steps": int(rng.integers(6, 14)),
+            "temperature": 0.7, "seed": 1000 + i,
+            "kill": bool(rng.random() < 0.1),
+        })
+    # expected rows computed OFFLINE for the survivors
+    wants = {i: _want(fitted, r["prompt"], r["num_steps"],
+                      temperature=0.7, seed=r["seed"])
+             for i, r in enumerate(reqs) if not r["kill"]}
+    eng = ServingEngine(fitted, num_slots=3, max_len=24,
+                        queue_capacity=64).warmup()
+    srv = ServingServer(eng, poll_s=0.01).start()
+    sup = EngineSupervisor(srv, heartbeat_interval=0.05,
+                           liveness_deadline=3.0, max_restarts=2).start()
+    crash_at = threading.Event()
+    results = {}
+    errors = []
+    lock = threading.Lock()
+
+    def run_request(i, req):
+        policy = RetryPolicy(attempts=80, backoff=0.05, max_backoff=0.5)
+        try:
+            with ServingClient(*srv.addr) as c:
+                if req["kill"]:
+                    rid = c.submit(req["prompt"], req["num_steps"],
+                                   temperature=req["temperature"],
+                                   seed=req["seed"])
+                    gen = c.stream(rid)
+                    try:
+                        next(gen)
+                    except (ConnectionError, OSError, ValueError,
+                            EngineDead):
+                        pass  # engine death beat us to it — still a kill
+                    _hard_close(c.sock)
+                    return
+                row = c.generate(req["prompt"], req["num_steps"],
+                                 temperature=req["temperature"],
+                                 seed=req["seed"], retry_policy=policy)
+                with lock:
+                    results[i] = row
+        except BaseException as e:  # noqa: BLE001 - asserted below
+            with lock:
+                errors.append((i, e))
+
+    def crasher():
+        crash_at.wait(timeout=60.0)
+
+        def boom():
+            raise RuntimeError("chaos: soak crash")
+
+        srv.engine._decode_once = boom
+
+    threads = [threading.Thread(target=run_request, args=(i, r))
+               for i, r in enumerate(reqs)]
+    ct = threading.Thread(target=crasher)
+    ct.start()
+    for i, t in enumerate(threads):
+        t.start()
+        if i == len(threads) // 2:
+            crash_at.set()  # crash the engine mid-flight
+    for t in threads:
+        t.join(timeout=120.0)
+    ct.join(timeout=5.0)
+    try:
+        assert not errors, errors[:3]
+        # zero lost surviving requests, all bit-identical
+        assert set(results) == set(wants)
+        for i, row in results.items():
+            np.testing.assert_array_equal(row, wants[i], err_msg=f"req {i}")
+        # exactly one supervised restart happened
+        assert len(sup.recoveries) == 1 and sup.recoveries[0]["restarted"]
+        # zero slot leaks on the live engine; the dead one failed loudly
+        final = srv.engine
+        assert _wait_for(lambda: not final._active.any())
+        _assert_slots_reclaimed(final)
+        assert eng.dead is not None
+        with srv._hlock:
+            assert not srv._handles and not srv._owner
+    finally:
+        sup.stop()
+        srv.stop()
