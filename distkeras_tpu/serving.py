@@ -172,24 +172,30 @@ class RequestHandle:
             self.tokens.append(int(token))
             self._cond.notify_all()
 
-    def _finish(self, reason: str) -> None:
+    def _finish(self, reason: str) -> bool:
+        """Returns whether THIS call made the handle terminal — the engine
+        only counts a request once, so a completion racing a concurrent
+        failure (or vice versa) must not increment both counters."""
         with self._cond:
             if self.finish is not None:  # first terminal state wins (a
-                return                   # wedge diagnosis is never undone)
+                return False             # wedge diagnosis is never undone)
             self.finish = reason
             self.finished_at = time.perf_counter()
             self._cond.notify_all()
+            return True
 
-    def _fail(self, exc: BaseException, reason: str = "error") -> None:
+    def _fail(self, exc: BaseException, reason: str = "error") -> bool:
         """Terminal failure: ``result()`` raises ``exc`` instead of
-        returning a row.  Idempotent like ``_finish``."""
+        returning a row.  Idempotent like ``_finish``; same return
+        contract."""
         with self._cond:
             if self.finish is not None:
-                return
+                return False
             self.error = exc
             self.finish = reason
             self.finished_at = time.perf_counter()
             self._cond.notify_all()
+            return True
 
     def _expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -421,6 +427,18 @@ class ServingEngine:
                     raise QueueFull(
                         f"admission queue at capacity "
                         f"({self.queue_capacity}); request {handle.id} shed")
+                # _declare_dead / drain notify _not_full while we wait —
+                # re-check on every wake or the request lands in a queue no
+                # scheduler will ever pop (result() would hang forever).
+                # Both raises count as admission sheds (requests_rejected)
+                # so the terminal accounting drain() sums stays balanced.
+                if self._dead is not None:
+                    self.stats["requests_rejected"] += 1
+                    raise EngineDead(str(self._dead)) from self._dead
+                if self._draining:
+                    self.stats["requests_rejected"] += 1
+                    raise Draining("serving engine is draining; admission "
+                                   "stopped")
             self._queue.append(handle)
             self.stats["queue_peak"] = max(self.stats["queue_peak"],
                                            len(self._queue))
@@ -480,10 +498,13 @@ class ServingEngine:
                 self._queue = keep
                 self._not_full.notify_all()
         for h in shed:
-            self._account_terminal(h, "cancel" if h.cancelled_at is not None
-                                   else "deadline", now)
-            h._finish("cancel" if h.cancelled_at is not None else "deadline")
-            self.stats["requests_completed"] += 1
+            reason = "cancel" if h.cancelled_at is not None else "deadline"
+            if h._finish(reason):
+                # held_slot=False: a queued shed never occupied a KV slot,
+                # so it must not contribute a (near-zero) sample to the
+                # slot_reclaim_ms reclamation-latency metric
+                self._account_terminal(h, reason, now, held_slot=False)
+                self.stats["requests_completed"] += 1
         did = bool(shed)
         for slot in np.flatnonzero(self._active):
             h = self._handles[slot]
@@ -496,17 +517,20 @@ class ServingEngine:
         return did
 
     def _account_terminal(self, h: RequestHandle, reason: str,
-                          now: float) -> None:
-        """Reason counters + the slot-reclaim latency sample (cancel/expiry
-        instant → reclamation) for the ``serving_slot_reclaim_ms`` bench."""
+                          now: float, held_slot: bool = True) -> None:
+        """Reason counters, plus — for requests that actually held a KV
+        slot (``held_slot``) — the slot-reclaim latency sample
+        (cancel/expiry instant → slot free) for the
+        ``serving_slot_reclaim_ms`` bench.  Queue sheds keep their
+        cancelled/expired counters but contribute no reclaim sample."""
         if reason == "cancel":
             self.stats["requests_cancelled"] += 1
-            if h.cancelled_at is not None:
+            if held_slot and h.cancelled_at is not None:
                 self.stats["slot_reclaim_ms"].append(
                     round((now - h.cancelled_at) * 1e3, 3))
         elif reason == "deadline":
             self.stats["requests_expired"] += 1
-            if h.deadline is not None:
+            if held_slot and h.deadline is not None:
                 self.stats["slot_reclaim_ms"].append(
                     round((now - h.deadline) * 1e3, 3))
 
@@ -571,9 +595,9 @@ class ServingEngine:
         self._positions[slot] = 0
         self._cur_tok[slot] = 0
         self._free.append(slot)
-        self.stats["requests_completed"] += 1
-        self._account_terminal(h, reason, time.perf_counter())
-        h._finish(reason)
+        if h._finish(reason):  # no-op when _declare_dead already failed it
+            self.stats["requests_completed"] += 1
+            self._account_terminal(h, reason, time.perf_counter())
 
     # ------------------------------------------------------------ schedule
     def step(self) -> bool:
@@ -701,15 +725,20 @@ class ServingEngine:
         call."""
         with self._qlock:
             self._draining = True
+            self._not_full.notify_all()  # blocked submitters raise Draining
         t0 = time.monotonic()
 
         def busy() -> bool:
             # terminal accounting, not queue+active snapshots: a request
             # between queue-pop and slot activation (mid-prefill) is in
-            # neither, but it has not reached a terminal state either
+            # neither, but it has not reached a terminal state either.
+            # rejected requests ARE terminal (incremented before the
+            # QueueFull/EngineDead/Draining raise) — without them a single
+            # backpressure shed would leave busy() True forever
             s = self.stats
             return (s["requests_submitted"]
-                    > s["requests_completed"] + s["requests_failed"])
+                    > s["requests_completed"] + s["requests_failed"]
+                    + s["requests_rejected"])
 
         def timed_out() -> bool:
             return (timeout is not None
@@ -766,8 +795,12 @@ class ServingEngine:
             self._have_work.notify_all()
         inflight = queued + [h for h in self._handles if h is not None]
         for h in inflight:
-            h._fail(EngineDead(str(exc)), reason=reason)
-            self.stats["requests_failed"] += 1
+            # _handles is read without the scheduler's lock: a still-running
+            # decode thread may retire a handle concurrently, making _fail a
+            # no-op — only a true transition counts (a request must never
+            # land in both requests_completed and requests_failed)
+            if h._fail(EngineDead(str(exc)), reason=reason):
+                self.stats["requests_failed"] += 1
 
     @property
     def dead(self) -> Optional[BaseException]:
@@ -1034,9 +1067,13 @@ class ServingServer:
         # releases them with the handler.
         recv_pool = networking.BufferPool()
         send_pool = networking.BufferPool()
+        pending_op = b""  # opcode the client pipelined during a stream
         try:
             while True:
-                op = networking.recv_opcode(conn)
+                if pending_op:
+                    op, pending_op = pending_op, b""
+                else:
+                    op = networking.recv_opcode(conn)
                 if op == b"":
                     return
                 if op == OP_ENQUEUE:
@@ -1093,7 +1130,9 @@ class ServingServer:
                                    "error": f"unknown id {rid}"},
                             pool=send_pool)
                         continue
-                    if not self._stream(conn, h, recv_pool, send_pool):
+                    alive, pending_op = self._stream(conn, h, recv_pool,
+                                                     send_pool)
+                    if not alive:
                         return  # client gone mid-stream (finally reclaims)
                 elif op == OP_CANCEL:
                     msg = networking.recv_data(conn, pool=recv_pool)
@@ -1139,24 +1178,35 @@ class ServingServer:
 
     def _stream(self, conn: socket.socket, h: RequestHandle,
                 recv_pool: "networking.BufferPool",
-                send_pool: "networking.BufferPool") -> bool:
+                send_pool: "networking.BufferPool"
+                ) -> Tuple[bool, bytes]:
         """Relay ``h``'s token chunks until its final frame.  Bounded
         waits: each empty ``poll_s`` slice checks the client socket for
         EOF/RST (→ cancel + reclaim) or a mid-stream ``'x'`` cancel
         opcode; a stream with no progress past the request deadline (+
         grace) or ``stream_timeout_s`` sends a typed ``"stall"`` error
-        frame.  Returns False when the connection is gone."""
+        frame.  Returns ``(alive, pending_op)``: ``alive`` is False when
+        the connection is gone; ``pending_op`` is an opcode the client
+        pipelined while the stream was relaying, for ``_handle`` to
+        process after the final frame."""
         grace = max(1.0, 4 * self.poll_s)
         waited = 0.0
+        pending = b""
         while True:
             # check the client side EVERY iteration (not just idle slices):
             # a mid-stream cancel or disconnect must land even while chunks
-            # are flowing back-to-back
-            status = self._poll_client(conn, recv_pool)
-            if status == "dead":
-                if self.cancel_on_disconnect:
-                    self.engine.cancel(h)
-                return False
+            # are flowing back-to-back.  Once the client pipelines its next
+            # opcode ('q'/'r'), STOP reading — the following bytes are that
+            # request's frame, owned by _handle after this stream's final
+            # frame (a disconnect is still caught by the send path below).
+            if not pending:
+                status = self._poll_client(conn, recv_pool)
+                if status == "dead":
+                    if self.cancel_on_disconnect:
+                        self.engine.cancel(h)
+                    return False, b""
+                if isinstance(status, bytes):
+                    pending = status
             chunk, done = h.next_chunk(timeout=self.poll_s)
             if not done and not len(chunk):
                 waited += self.poll_s
@@ -1180,8 +1230,8 @@ class ServingServer:
                                             f"{h.id} (engine stalled)"},
                             pool=send_pool)
                     except (ConnectionError, OSError):
-                        return False
-                    return True
+                        return False, b""
+                    return True, pending
                 continue
             waited = 0.0
             reply: Dict[str, Any] = {"id": h.id, "tokens": chunk,
@@ -1199,32 +1249,46 @@ class ServingServer:
             except (ConnectionError, OSError):
                 if self.cancel_on_disconnect:
                     self.engine.cancel(h)
-                return False
+                return False, b""
             if done:
                 with self._hlock:
                     self._handles.pop(h.id, None)
                     self._owner.pop(h.id, None)
-                return True
+                return True, pending
 
     def _poll_client(self, conn: socket.socket,
-                     recv_pool: "networking.BufferPool") -> str:
+                     recv_pool: "networking.BufferPool"
+                     ) -> Union[str, bytes]:
         """Non-blocking client-socket check between stream chunks:
         ``"idle"`` (nothing to read — the normal case), ``"dead"``
-        (EOF/RST — the disconnect-reclamation trigger), or ``"ok"`` after
-        consuming a mid-stream ``'x'`` cancel (any id; unacked — the
-        stream's final frame is the acknowledgement)."""
+        (EOF/RST/garbage — the disconnect-reclamation trigger), ``"ok"``
+        after consuming a mid-stream ``'x'`` cancel (any id; unacked —
+        the stream's final frame is the acknowledgement), or the opcode
+        byte itself when the client pipelined its next ``'q'``/``'r'``
+        request while this stream is still relaying (stashed by
+        ``_stream``, processed after the final frame — pipelining is not
+        a protocol violation)."""
         try:
             readable, _, _ = select.select([conn], [], [], 0)
             if not readable:
                 return "idle"
             op = conn.recv(1)
             if op == OP_CANCEL:
-                msg = networking.recv_data(conn, pool=recv_pool)
+                # the cancel payload may trail the opcode across packets:
+                # bound the recv so a torn/stalled cancel frame cannot pin
+                # the stream relay (timeout → OSError → "dead")
+                conn.settimeout(1.0)
+                try:
+                    msg = networking.recv_data(conn, pool=recv_pool)
+                finally:
+                    conn.settimeout(None)
                 with self._hlock:
                     target = self._handles.get(int(msg["id"]))
                 if target is not None:
                     self.engine.cancel(target)
                 return "ok"
+            if op in (OP_ENQUEUE, OP_STREAM):
+                return op  # pipelined next request, not a dead client
         except (ConnectionError, OSError, ValueError):
             return "dead"
         # EOF (b"") or mid-stream protocol violation: the client is gone

@@ -170,6 +170,15 @@ def run_closed_loop(engine, trace: Sequence[Dict[str, Any]],
     wall = time.perf_counter() - t0
     if errors:
         raise errors[0]
+    # killed users cancel and move on without waiting for the retirement,
+    # so the scheduler may be one iteration away from reaping the last
+    # cancel — let terminal accounting settle (bounded) before reading it
+    s = engine.stats
+    settle_deadline = time.perf_counter() + 10.0
+    while (s["requests_submitted"] > s["requests_completed"]
+           + s["requests_failed"] + s["requests_rejected"]
+           and time.perf_counter() < settle_deadline):
+        time.sleep(0.005)
     return _metrics(engine, latencies, wall,
                     engine.stats["tokens_generated"] - tokens0,
                     engine.stats["requests_completed"] - completed0,

@@ -189,7 +189,9 @@ def test_cancel_queued_and_running(fitted):
     assert running.finish == "cancel"
     assert not eng.cancel(running)  # already finished
     assert eng.stats["requests_cancelled"] == 2
-    assert len(eng.stats["slot_reclaim_ms"]) == 2
+    # only the RUNNING cancel samples slot_reclaim_ms — the queued shed
+    # never held a slot, so it must not dilute the reclamation metric
+    assert len(eng.stats["slot_reclaim_ms"]) == 1
     _assert_slots_reclaimed(eng)
 
 
@@ -380,6 +382,110 @@ def test_drain_timeout_fails_leftovers_typed(fitted):
             h.result()
     finally:
         release()
+
+
+def test_drain_after_backpressure_shed_returns_clean(fitted):
+    """Regression: a QueueFull shed must not unbalance drain()'s terminal
+    accounting — a rejected request is terminal (requests_rejected), so
+    drain after a rejection still finishes the real work and returns True
+    instead of timing out and falsely declaring the idle engine dead."""
+    eng = ServingEngine(fitted, num_slots=1, max_len=24, queue_capacity=1)
+    h1 = eng.submit(PROMPT, 4)
+    with pytest.raises(QueueFull):
+        eng.submit(OTHER, 4, block=False)
+    assert eng.drain(timeout=30.0) is True
+    assert h1.finish == "length"
+    assert eng.dead is None
+    s = eng.stats
+    assert (s["requests_submitted"]
+            == s["requests_completed"] + s["requests_failed"]
+            + s["requests_rejected"])
+
+
+def test_blocked_submit_raises_typed_on_death(fitted):
+    """Regression: a submitter blocked on a full queue is woken by
+    _declare_dead (which clears the queue) — it must raise the typed
+    EngineDead, not enqueue into an engine no scheduler will ever run
+    (a silent result() hang)."""
+    eng = ServingEngine(fitted, num_slots=1, max_len=24, queue_capacity=1)
+    eng.submit(PROMPT, 8)
+    errs = []
+
+    def blocked():
+        try:
+            eng.submit(OTHER, 4, block=True, timeout=30.0)
+        except BaseException as e:  # noqa: BLE001 — recorded for assert
+            errs.append(e)
+
+    t = threading.Thread(target=blocked, daemon=True)
+    t.start()
+    time.sleep(0.1)  # inside the capacity wait
+    eng._declare_dead(RuntimeError("chaos: killed while submitter waits"))
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert errs and isinstance(errs[0], EngineDead)
+    s = eng.stats
+    assert (s["requests_submitted"]
+            == s["requests_completed"] + s["requests_failed"]
+            + s["requests_rejected"])
+
+
+def test_blocked_submit_raises_draining_on_drain(fitted):
+    """Same contract for drain: admission stopping must reach a submitter
+    already blocked on the capacity wait."""
+    eng = ServingEngine(fitted, num_slots=1, max_len=24, queue_capacity=1)
+    h1 = eng.submit(PROMPT, 4)
+    errs = []
+
+    def blocked():
+        try:
+            eng.submit(OTHER, 4, block=True, timeout=30.0)
+        except BaseException as e:  # noqa: BLE001 — recorded for assert
+            errs.append(e)
+
+    t = threading.Thread(target=blocked, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert eng.drain(timeout=30.0) is True
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert errs and isinstance(errs[0], Draining)
+    assert h1.finish == "length"
+
+
+def test_pipelined_enqueue_mid_stream_keeps_connection(fitted):
+    """Regression: a client that pipelines its next 'q' on the same socket
+    while a stream is still relaying is NOT a dead client — the server
+    stashes the opcode, finishes the stream, then processes the enqueue,
+    instead of tearing down the connection and cancelling its work."""
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    with ServingServer(eng, poll_s=0.01) as srv:
+        with ServingClient(*srv.addr) as c:
+            rid = c.submit(PROMPT, 8)
+            networking.send_opcode(c.sock, networking.SERVING_OP_STREAM)
+            networking.send_data(c.sock, {"id": rid})
+            # pipeline the next request before reading any stream frame
+            networking.send_opcode(c.sock, networking.SERVING_OP_ENQUEUE)
+            networking.send_data(c.sock, {"prompt": OTHER, "num_steps": 4})
+            final = None
+            while final is None:
+                reply = networking.recv_data(c.sock)
+                assert not reply.get("error"), reply
+                if reply["done"]:
+                    final = reply
+            assert final["finish"] == "length"
+            np.testing.assert_array_equal(
+                np.array(final["row"], np.int32), _want(fitted, PROMPT, 8))
+            # the stashed enqueue is answered after the final frame
+            ack = networking.recv_data(c.sock)
+            assert ack.get("ok") and "id" in ack
+            chunks = []
+            for tokens, done in c.stream(int(ack["id"])):
+                chunks.append(tokens)
+                if done is not None:
+                    np.testing.assert_array_equal(done["row"],
+                                                  _want(fitted, OTHER, 4))
+        assert eng.stats["requests_cancelled"] == 0
 
 
 # ---------------------------------------------------------------------------
