@@ -476,8 +476,18 @@ def serving_bench(budget_s: float = 90.0):
     ``serving_slot_reclaim_ms`` (mean cancel/expiry → slot-free latency
     under the seeded ~10% client-kill chaos schedule), and
     ``serving_deadline_miss_rate`` (fraction retired ``"deadline"`` under
-    a tight per-request deadline).  Returns Nones on overrun/failure —
-    never fatal to the north-star artifact.
+    a tight per-request deadline).
+
+    Prefill fast-path observables: ``serving_ttft_p50_ms``/
+    ``serving_ttft_p99_ms`` (time to first token under the main closed
+    loop) and ``serving_prefill_tokens_per_sec`` (prompt tokens through
+    the compiled prefill path), plus a LONG-PROMPT leg running one trace
+    whose prompts exceed ``prefill_chunk`` through both prefill modes:
+    ``serving_longprompt_ttft_p99_ms`` (bucketed + chunked, the fast
+    path) vs ``serving_longprompt_ttft_eager_p99_ms`` (the eager
+    reference) — the chunked-prefill TTFT win, recorded alongside
+    throughput.  Returns Nones on overrun/failure — never fatal to the
+    north-star artifact.
     """
     sys.path.insert(0, os.path.join(_REPO, "examples"))
     import loadgen
@@ -486,7 +496,11 @@ def serving_bench(budget_s: float = 90.0):
             "serving_p99_ms": None, "serving_slot_occupancy": None,
             "serving_sequential_tokens_per_sec": None,
             "serving_shed_rate": None, "serving_slot_reclaim_ms": None,
-            "serving_deadline_miss_rate": None}
+            "serving_deadline_miss_rate": None,
+            "serving_ttft_p50_ms": None, "serving_ttft_p99_ms": None,
+            "serving_prefill_tokens_per_sec": None,
+            "serving_longprompt_ttft_p99_ms": None,
+            "serving_longprompt_ttft_eager_p99_ms": None}
     if budget_s < 5.0:  # not enough budget to even warm the engine up
         return none
     t0 = time.perf_counter()
@@ -500,15 +514,37 @@ def serving_bench(budget_s: float = 90.0):
     if time.perf_counter() - t0 > budget_s:
         return none
     seq = loadgen.sequential_baseline(fitted, trace, max_len=engine.max_len)
-    out = {
+    out = dict(none)
+    out.update({
         "serving_tokens_per_sec": closed["tokens_per_sec"],
         "serving_p50_ms": closed["p50_ms"],
         "serving_p99_ms": closed["p99_ms"],
         "serving_slot_occupancy": closed["slot_occupancy"],
         "serving_sequential_tokens_per_sec": seq["tokens_per_sec"],
-        "serving_shed_rate": None, "serving_slot_reclaim_ms": None,
-        "serving_deadline_miss_rate": None,
-    }
+        "serving_ttft_p50_ms": closed["ttft_p50_ms"],
+        "serving_ttft_p99_ms": closed["ttft_p99_ms"],
+        "serving_prefill_tokens_per_sec": closed["prefill_tokens_per_sec"],
+    })
+    if time.perf_counter() - t0 > budget_s * 0.55:
+        return out
+    # long-prompt TTFT leg: prompts past prefill_chunk, same trace through
+    # the bucketed+chunked fast path and the eager reference — admissions
+    # must no longer stall the running batch for a whole prompt
+    lp_trace = loadgen.make_trace(12, num_steps=6, temperature=0.7,
+                                  prompt_lengths=(20, 28, 40))
+    for mode, field in (("bucketed", "serving_longprompt_ttft_p99_ms"),
+                        ("eager", "serving_longprompt_ttft_eager_p99_ms")):
+        _, lp_engine = loadgen.build_engine(
+            num_slots=4, max_len=64, prefill_mode=mode, prefill_chunk=8,
+            prefills_per_step=2)
+        try:
+            lp = loadgen.run_closed_loop(lp_engine, lp_trace,
+                                         concurrency=8, timeout_s=budget_s)
+            out[field] = lp["ttft_p99_ms"]
+        finally:
+            lp_engine.stop()
+        if time.perf_counter() - t0 > budget_s * 0.7:
+            return out
     if time.perf_counter() - t0 > budget_s * 0.7:
         return out
     # chaos leg: ~10% seeded client kills + a deadline tight enough that
@@ -795,7 +831,12 @@ def main():
                       "serving_sequential_tokens_per_sec": None,
                       "serving_shed_rate": None,
                       "serving_slot_reclaim_ms": None,
-                      "serving_deadline_miss_rate": None}
+                      "serving_deadline_miss_rate": None,
+                      "serving_ttft_p50_ms": None,
+                      "serving_ttft_p99_ms": None,
+                      "serving_prefill_tokens_per_sec": None,
+                      "serving_longprompt_ttft_p99_ms": None,
+                      "serving_longprompt_ttft_eager_p99_ms": None}
     serving_remaining = budget - (time.perf_counter() - t_start)
     if serving_remaining > 45:
         try:

@@ -129,7 +129,18 @@ def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype,
     through ``ops.attention.dot_product_attention`` (same numerics as the
     training forward).  ``pos`` may be a (B,) vector (single-token steps
     only): each row writes its k/v at — and attends from — its own
-    position."""
+    position.
+
+    Right-padded batches (the serving engine's bucketed prefill pads a
+    mixed-length prompt batch to one bucket length) need no extra
+    masking here: pad tokens sit at positions >= every real query, so the
+    causal mask already keeps their keys out of every real row's softmax,
+    and their (finite) junk cache entries stay behind each row's decode
+    ``kv_length`` frontier until real writes overwrite them.  (An explicit
+    per-row kv_length mask would be WRONG for windowed models: a pad
+    query whose window has slid past the real prompt would mask every
+    key, and the resulting empty-softmax NaN row poisons real outputs
+    through the next layer's ``0 * NaN`` value products.)"""
     from ..ops.attention import dot_product_attention
     b, length = h.shape[0], h.shape[1]
     dh = mha.key_dim
@@ -224,7 +235,10 @@ def _forward(model, params, caches, toks, pos, rolling: bool = False):
     ``pos``; returns ((B, L, V) f32 logits, new caches).  L == 1 is a
     decode step, L == P is the batched prompt prefill.  ``pos`` may be a
     (B,) per-row position vector (L == 1 only): every row advances at its
-    own position — the serving engine's mixed-length slot batch."""
+    own position — the serving engine's mixed-length slot batch.  L > 1
+    batches may be right-padded to a shared length (the serving engine's
+    bucketed prefill) — see ``_mha_forward`` for why the causal mask
+    alone keeps pad tokens out of every real position's numerics."""
     cdtype = model._cdtype
     x = None
     new_caches: List[Any] = []
@@ -434,6 +448,28 @@ def _to_ring(full_cache, p_len: int, window: int):
                           + full_cache.shape[2:], full_cache.dtype)
         return jnp.concatenate([full_cache, zeros], axis=1)
     return full_cache[:, :window]
+
+
+def ring_from_prefill(full_cache, p_lens, window: int):
+    """Traced, per-row ``_to_ring``: (B, S, H, D) full prefill cache rows →
+    (B, W, H, D) rings where slot ``p % W`` holds position ``p``, keeping
+    each row's last ``window`` prompt positions.  ``p_lens`` is a (B,)
+    TRACED vector of true prompt lengths (the serving engine's bucketed
+    prefill converts a whole mixed-length batch in one jitted program);
+    slots a short row never wrote come out zero, exactly like
+    ``_to_ring``'s zero tail (they self-mask through ``kv_positions`` at
+    decode time).  Row-for-row this gathers the same entries ``_to_ring``
+    copies — it is a pure relayout, bit-identical by construction."""
+    w = int(window)
+    j = jnp.arange(w)
+    p = jnp.reshape(jnp.asarray(p_lens, jnp.int32), (-1, 1))      # (B, 1)
+    # ring slot j holds the newest prompt position congruent to j mod W;
+    # rows shorter than W leave their tail slots negative (= never written)
+    q = (p - 1) - jnp.mod(p - 1 - j[None, :], w)                  # (B, W)
+    src = jnp.clip(q, 0, full_cache.shape[1] - 1)
+    rows = jnp.take_along_axis(full_cache, src[:, :, None, None], axis=1)
+    return jnp.where((q >= 0)[:, :, None, None], rows,
+                     jnp.zeros((), full_cache.dtype))
 
 
 def generate(model, params, prompt, num_steps: int,

@@ -71,7 +71,17 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     shape (B,), ``kv_positions`` of shape (B, Sk) — so one batched decode
     step can advance every row at its own position (the serving engine's
     slot pool, where slots hold requests of different lengths).  The scalar
-    form takes the exact code path it always did.
+    form takes the exact code path it always did.  Together the two hooks
+    carry the serving engine's BUCKETED PREFILL masking: at prefill time a
+    batch of prompts right-padded to one bucket length needs only the
+    causal mask — pad keys sit at positions >= every real query, so no
+    real row's softmax ever sees them — and at decode time the per-row
+    ``kv_length`` frontier keeps the padded cache tail masked until real
+    writes overwrite it.  (Masking pad QUERIES' keys explicitly would be
+    wrong under ``window``: a pad position past the real prompt can end up
+    with an all-masked — empty — softmax row, and the resulting NaN
+    output poisons real rows through the next layer's 0·NaN value
+    products.  The causal mask always leaves a query its own key.)
 
     ``segment_ids`` (B, S) int: sequence-packing isolation — query and key
     attend only within equal segment ids (on top of causal/window), so

@@ -16,10 +16,29 @@ iteration-level (Orca-style) scheduling:
    ``QueueFull`` with ``block=False`` — the wire server turns that into a
    backpressure reply instead of buffering unboundedly).
  - **Prefill/decode interleave** — each engine iteration admits up to
-   ``prefills_per_step`` queued requests into free slots (one batched
-   prompt forward each, scattered into the slot's cache row), then runs one
+   ``prefills_per_step`` queued requests into free slots, then runs one
    decode step for every running request.  New work never stalls the
-   running batch for more than a bounded number of prefills.
+   running batch for more than a bounded number of prefill work units.
+ - **Compiled bucketed prefill** (``prefill_mode="bucketed"``, the
+   default) — admitted prompts are right-padded to a small power-of-two
+   length-bucket ladder and prefilled TOGETHER, one jitted batched forward
+   per bucket (jit cache keyed on the bucket length; per-row
+   ``kv_length`` masking keeps pad tokens out of every softmax), replacing
+   the per-request eager ``_forward`` of the original engine — which is
+   retained, bit-identical, behind ``prefill_mode="eager"`` as the
+   reference path.
+ - **Chunked prefill** — a prompt longer than ``prefill_chunk`` splits
+   into chunks advanced one per scheduler iteration, interleaved with
+   decode steps (Sarathi-style stall-free prefill): a 1024-token prompt
+   no longer freezes every running request for its full length.  The slot
+   sits in a *prefilling* state until its final chunk samples the first
+   token.
+ - **Device-resident decode state** — current tokens, positions, active
+   mask, and per-slot sampling params live on device and are advanced
+   INSIDE the jitted decode step; only the sampled token row is read back
+   each iteration, and step t+1 is dispatched before the host finishes
+   emitting step t's tokens (one-step lookahead, the serving twin of the
+   host-PS ``comm_overlap`` idiom).
  - **Retirement + slot reuse** — a request leaves its slot the moment it
    emits ``eos_id`` or its ``num_steps``-th token; the slot is immediately
    reusable by the next queued request *mid-run* (continuous batching —
@@ -71,6 +90,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import networking
+from .core import decode as _dec
 from .core.decode import (_check_supported, _context_limit, _forward,
                           _to_ring, _validate_rolling, _validate_sampling,
                           _validate_stopping, _vocab_size, decode_step,
@@ -124,9 +144,9 @@ class RequestHandle:
 
     __slots__ = ("id", "prompt", "num_steps", "temperature", "top_k",
                  "top_p", "eos_id", "pad_id", "key", "tokens", "finish",
-                 "slot", "submitted_at", "started_at", "finished_at",
-                 "deadline", "error", "cancelled_at", "_cond",
-                 "_chunk_read")
+                 "slot", "submitted_at", "started_at", "first_token_at",
+                 "finished_at", "deadline", "error", "cancelled_at",
+                 "_cond", "_chunk_read")
 
     def __init__(self, rid: int, prompt: np.ndarray, num_steps: int,
                  temperature: float, top_k: Optional[int],
@@ -147,6 +167,7 @@ class RequestHandle:
         self.slot: Optional[int] = None
         self.submitted_at = time.perf_counter()
         self.started_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.deadline = (None if deadline_s is None
                          else self.submitted_at + float(deadline_s))
@@ -169,6 +190,8 @@ class RequestHandle:
         with self._cond:
             if self.finish is not None:  # a wedged loop emitting past its
                 return                   # declared death: drop, don't grow
+            if self.first_token_at is None:
+                self.first_token_at = time.perf_counter()
             self.tokens.append(int(token))
             self._cond.notify_all()
 
@@ -238,6 +261,46 @@ class RequestHandle:
         return (None if self.finished_at is None
                 else self.finished_at - self.submitted_at)
 
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Time to first token — submit instant → first emitted token
+        (queueing AND prefill included), the latency a streaming client
+        actually feels.  None until the first token exists."""
+        return (None if self.first_token_at is None
+                else self.first_token_at - self.submitted_at)
+
+
+def _pow2_buckets(cap: int) -> List[int]:
+    """The prefill length-bucket ladder: powers of two from 8 up, capped
+    (and terminated) at ``cap`` — a SMALL set, so each bucket's jitted
+    batched-prefill program compiles once and is reused for every prompt
+    that rounds up to it."""
+    cap = int(cap)
+    out: List[int] = []
+    n = 8
+    while n < cap:
+        out.append(n)
+        n *= 2
+    out.append(cap)
+    return out
+
+
+class _PrefillJob:
+    """Scheduler-side state of one chunked prefill in flight: the slot is
+    claimed (``engine._handles``) but not yet decoding; ``written`` prompt
+    tokens are staged so far.  ``staging`` is a full-length one-row cache
+    the chunks accumulate into — private to the job, so the decode
+    batch's junk writes into free pool rows can't race it — which the
+    final chunk commits to the slot's pool row in one atomic program
+    (ring-collapsed for rolling engines)."""
+
+    __slots__ = ("handle", "staging", "written")
+
+    def __init__(self, handle: RequestHandle, staging=None):
+        self.handle = handle
+        self.staging = staging
+        self.written = 0
+
 
 class ServingEngine:
     """Iteration-level continuous-batching engine over a slot-pooled KV
@@ -250,6 +313,16 @@ class ServingEngine:
     model's positional range).  ``rolling=True`` (sliding-window models
     only) makes each slot an O(W) ring instead of ``max_len`` slots.
 
+    ``prefill_mode``: ``"bucketed"`` (default) runs the compiled fast
+    path — batched bucket prefill, chunked long-prompt prefill, and
+    device-resident decode state with one-step lookahead; ``"eager"`` is
+    the original per-request eager-``_forward`` engine, retained as the
+    bit-identical reference.  ``prefill_chunk`` bounds how many prompt
+    tokens one scheduler iteration may prefill for a single request
+    (bucketed mode): longer prompts split into chunks interleaved with
+    decode steps, so admissions never stall the running batch for more
+    than one chunk per iteration.
+
     Threading: ``submit`` is thread-safe (any number of producers);
     the scheduler itself — ``step`` / ``run_until_idle`` / the ``start``
     background thread — must be driven from ONE thread at a time.
@@ -259,7 +332,8 @@ class ServingEngine:
                  num_slots: int = 4, max_len: Optional[int] = None,
                  queue_capacity: int = 64, prefills_per_step: int = 1,
                  rolling: bool = False,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 prefill_mode: str = "bucketed", prefill_chunk: int = 128):
         if isinstance(model, FittedModel):
             self.model, self.params = model.model, model.params
         else:
@@ -283,6 +357,14 @@ class ServingEngine:
         self.rolling = bool(rolling)
         self.queue_capacity = int(queue_capacity)
         self.prefills_per_step = max(int(prefills_per_step), 1)
+        if prefill_mode not in ("bucketed", "eager"):
+            raise ValueError(f"prefill_mode must be 'bucketed' or 'eager', "
+                             f"got {prefill_mode!r}")
+        self.prefill_mode = prefill_mode
+        if int(prefill_chunk) < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
+        self.prefill_chunk = int(prefill_chunk)
         if default_deadline_s is not None and default_deadline_s <= 0:
             raise ValueError(f"default_deadline_s must be > 0, got "
                              f"{default_deadline_s}")
@@ -317,6 +399,32 @@ class ServingEngine:
                     B, r, (s, 0, 0, 0)), big, row),
             donate_argnums=(0,))
 
+        # -- compiled prefill fast path + device-resident decode state
+        #    (bucketed mode; the eager reference keeps the host arrays
+        #    above authoritative and uploads them every step)
+        self._chunk_width = min(self.prefill_chunk, self.max_len)
+        self._buckets = _pow2_buckets(self._chunk_width)
+        self._pending: "collections.deque" = collections.deque()
+        self._prefilling: Dict[int, _PrefillJob] = {}
+        self._lookahead = 1 if self.prefill_mode == "bucketed" else 0
+        if self.prefill_mode == "bucketed":
+            # params live on device once: the decode loop must not re-ship
+            # the weights (or anything else) host→device per iteration
+            self.params = jax.device_put(self.params)
+            self._dev_tok = jnp.zeros((self.num_slots,), jnp.int32)
+            self._dev_pos = jnp.zeros((self.num_slots,), jnp.int32)
+            self._dev_act = jnp.zeros((self.num_slots,), bool)
+            self._dev_temp = jnp.zeros((self.num_slots,), jnp.float32)
+            self._dev_topk = jnp.zeros((self.num_slots,), jnp.int32)
+            self._dev_topp = jnp.zeros((self.num_slots,), jnp.float32)
+            self._dev_keys = jnp.zeros((self.num_slots, 2), jnp.uint32)
+            self._decode_fn = self._build_device_step_fn()
+            self._deact_fn = jax.jit(
+                lambda act, slot: act.at[slot].set(False))
+            self._bucket_fns: Dict[int, Any] = {}
+            self._stage_fns: Dict[int, Any] = {}
+            self._final_fns: Dict[int, Any] = {}
+
         # -- hot weight reload (stretch; off unless attach_ps is called)
         self._ps_addr: Optional[Tuple[str, int]] = None
         self._reload_every = 0
@@ -345,6 +453,15 @@ class ServingEngine:
             # (cancel/expiry instant → slot free)
             "requests_cancelled": 0, "requests_expired": 0,
             "requests_failed": 0, "slot_reclaim_ms": [],
+            # prefill fast-path observables: chunk-program invocations,
+            # batched-prefill width (mean admitted requests per bucket
+            # program call), prompt tokens prefilled, and the decode
+            # loop's transfer discipline (decode-only iterations perform
+            # zero h2d and exactly one d2h — the sampled token row)
+            "prefill_chunks": 0, "prefill_batches": 0,
+            "prefill_batched_requests": 0, "prefill_batch_size_mean": None,
+            "prefill_tokens": 0,
+            "h2d_transfers": 0, "d2h_transfers": 0,
         }
 
     # ------------------------------------------------------------------ jit
@@ -363,6 +480,205 @@ class ServingEngine:
             return jnp.where(active, nxt, tok), caches
 
         return jax.jit(step, donate_argnums=(1,))
+
+    # ------------------------------------------- compiled prefill programs
+    #
+    # The fast path's whole compute surface is a handful of jitted
+    # programs, cached per shape key so live traffic never re-traces:
+    #
+    #  - ``_bucket_fn(L)`` — ONE batched forward prefills up to
+    #    ``prefills_per_step`` admitted prompts right-padded to bucket
+    #    length L, samples each row's first token, scatters the cache rows
+    #    into the pool (ring-converted per row for rolling engines) and
+    #    the per-slot decode state in the same program.  Unused batch rows
+    #    carry slot index ``num_slots``: every one of their writes drops
+    #    (``mode="drop"``), which is also what makes ``warmup()``'s
+    #    precompilation side-effect free.
+    #  - ``_stage_fn(C)`` / ``_final_fn(C)`` — chunked prefill: chunks
+    #    accumulate into a full-length one-row STAGING cache (``q_offset``
+    #    = the chunk offset, exactly the scalar decode-walker path); the
+    #    final chunk samples the first token and commits the whole row to
+    #    the pool in one program (ring-collapsed via ``ring_from_prefill``
+    #    for rolling engines, a full-row overwrite otherwise).  Staging is
+    #    NOT optional: the per-row decode step writes junk k/v into every
+    #    pool row at its stale position — free and prefilling slots
+    #    included — which an atomic full-row commit overwrites but an
+    #    in-place chunk accumulation would race (a junk write at a stale
+    #    position below the chunk frontier corrupts already-written
+    #    prompt positions).
+    #
+    # Every traced call goes through ``_dec`` (the decode MODULE) so a
+    # trace is observable/countable; the module-level ``_forward`` import
+    # is the EAGER path's — the bucketed hot path never calls it.
+
+    def _bucket_fn(self, width: int):
+        fn = self._bucket_fns.get(width)
+        if fn is None:
+            fn = self._bucket_fns[width] = self._build_bucket_fn(width)
+        return fn
+
+    def _stage_fn(self, width: int):
+        fn = self._stage_fns.get(width)
+        if fn is None:
+            fn = self._stage_fns[width] = self._build_stage_fn(width)
+        return fn
+
+    def _final_fn(self, width: int):
+        fn = self._final_fns.get(width)
+        if fn is None:
+            fn = self._final_fns[width] = self._build_final_fn(width)
+        return fn
+
+    def _build_device_step_fn(self):
+        """The bucketed-mode decode step: state advances ON DEVICE (donated
+        caches, new positions), so a steady-state iteration uploads nothing
+        and reads back only the sampled token row."""
+        model, rolling = self.model, self.rolling
+
+        def step(params, caches, tok, positions, active, temp, topk, topp,
+                 keys):
+            logits, caches = _dec.decode_step(model, params, caches, tok,
+                                              positions, rolling)
+            nxt = _dec.sample_logits_batched(logits, positions, temp, keys,
+                                             topk, topp)
+            out = jnp.where(active, nxt, tok)
+            positions = jnp.where(active, positions + 1, positions)
+            return out, caches, positions
+
+        return jax.jit(step, donate_argnums=(1, 3))
+
+    def _build_bucket_fn(self, width: int):
+        model, rolling = self.model, self.rolling
+
+        def run(params, pool, tok, pos, act, temp, topk, topp, keys,
+                prompts, p_lens, slots, r_temp, r_topk, r_topp, r_keys):
+            rows = init_cache(model, prompts.shape[0], width)
+            # right-padded batch: the causal mask alone keeps pad keys out
+            # of every real row (see _mha_forward), and the pad slots each
+            # row's prefill writes stay behind its decode kv_length
+            # frontier until overwritten
+            logits, rows = _dec._forward(model, params, rows, prompts, 0)
+            idx = jnp.clip(p_lens - 1, 0, width - 1)
+            last = jnp.take_along_axis(logits, idx[:, None, None],
+                                       axis=1)[:, 0]
+            first = _dec.sample_logits_batched(last, p_lens - 1, r_temp,
+                                               r_keys, r_topk, r_topp)
+            new_pool = []
+            for big, row in zip(pool, rows):
+                if big is None:
+                    new_pool.append(None)
+                    continue
+                if rolling:
+                    w = big["k"].shape[1]
+                    ring = {n: _dec.ring_from_prefill(row[n], p_lens, w)
+                            for n in ("k", "v")}
+                    new_pool.append(
+                        {n: big[n].at[slots].set(ring[n], mode="drop")
+                         for n in ("k", "v")})
+                else:
+                    new_pool.append(
+                        {n: big[n].at[slots, :width].set(row[n],
+                                                         mode="drop")
+                         for n in ("k", "v")})
+            return (first, new_pool,
+                    tok.at[slots].set(first, mode="drop"),
+                    pos.at[slots].set(p_lens, mode="drop"),
+                    act.at[slots].set(True, mode="drop"),
+                    temp.at[slots].set(r_temp, mode="drop"),
+                    topk.at[slots].set(r_topk, mode="drop"),
+                    topp.at[slots].set(r_topp, mode="drop"),
+                    keys.at[slots].set(r_keys, mode="drop"))
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    def _build_stage_fn(self, width: int):
+        model = self.model
+
+        def run(params, staging, toks, offset):
+            # mid chunk: cache writes only — the logits (and the whole
+            # LM-head matmul) dead-code-eliminate
+            _, staging = _dec._forward(model, params, staging, toks, offset)
+            return staging
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    def _build_final_fn(self, width: int):
+        model, rolling = self.model, self.rolling
+
+        def run(params, pool, tok, pos, act, temp, topk, topp, keys,
+                staging, toks, slot, offset, last_idx, p_len,
+                r_temp, r_topk, r_topp, r_key):
+            logits, staging = _dec._forward(model, params, staging, toks,
+                                            offset)
+            first = _dec.sample_logits_batched(
+                logits[0, last_idx][None], jnp.asarray(p_len - 1)[None],
+                r_temp, r_key, r_topk, r_topp)
+            p_row = jnp.asarray(p_len)[None]
+            new_pool = []
+            for big, row in zip(pool, staging):
+                if big is None:
+                    new_pool.append(None)
+                    continue
+                if rolling:
+                    w = big["k"].shape[1]
+                    row = {n: _dec.ring_from_prefill(row[n], p_row, w)
+                           for n in ("k", "v")}
+                # full-row commit: atomically replaces whatever junk the
+                # free slot's decode passes wrote while chunks staged
+                new_pool.append(
+                    {n: big[n].at[slot].set(row[n][0], mode="drop")
+                     for n in ("k", "v")})
+            return (first, new_pool,
+                    tok.at[slot].set(first[0], mode="drop"),
+                    pos.at[slot].set(p_len, mode="drop"),
+                    act.at[slot].set(True, mode="drop"),
+                    temp.at[slot].set(r_temp[0], mode="drop"),
+                    topk.at[slot].set(r_topk[0], mode="drop"),
+                    topp.at[slot].set(r_topp[0], mode="drop"),
+                    keys.at[slot].set(r_key[0], mode="drop"))
+
+        # staging is NOT donated: the ring relayout is a gather whose
+        # output shape differs from the staging buffer, so XLA could not
+        # reuse it anyway (it dies with the program instead)
+        return jax.jit(run, donate_argnums=(1,))
+
+    # ----------------------------------------------------- device traffic
+    def _put(self, x):
+        """Host→device upload (admission inputs only).  Counted so the
+        transfer discipline is assertable: a decode-only iteration
+        performs ZERO uploads."""
+        self.stats["h2d_transfers"] += 1
+        return jnp.asarray(x)
+
+    def _fetch(self, arr) -> np.ndarray:
+        """Device→host readback — the ONE transfer per drained step (the
+        sampled token row, or a prefill batch's first tokens)."""
+        self.stats["d2h_transfers"] += 1
+        return np.asarray(arr)
+
+    def _state_args(self):
+        return (self.caches, self._dev_tok, self._dev_pos, self._dev_act,
+                self._dev_temp, self._dev_topk, self._dev_topp,
+                self._dev_keys)
+
+    def _apply_state(self, res):
+        """Unpack a prefill program's ``(first, pool, *state)`` result,
+        installing the new device arrays; returns ``first``."""
+        (first, self.caches, self._dev_tok, self._dev_pos, self._dev_act,
+         self._dev_temp, self._dev_topk, self._dev_topp,
+         self._dev_keys) = res
+        return first
+
+    def _sampling_row(self, h: RequestHandle):
+        """One request's sampling params as (1,)-shaped device rows for
+        the chunk/final programs."""
+        return (self._put(np.asarray([h.temperature], np.float32)),
+                self._put(np.asarray(
+                    [0 if h.top_k is None else int(h.top_k)], np.int32)),
+                self._put(np.asarray(
+                    [0.0 if h.top_p is None else float(h.top_p)],
+                    np.float32)),
+                self._put(np.asarray(h.key, np.uint32)[None]))
 
     # ------------------------------------------------------------ admission
     def submit(self, prompt, num_steps: int, temperature: float = 0.0,
@@ -514,7 +830,27 @@ class ServingEngine:
             elif h._expired(now):
                 self._retire(int(slot), "deadline")
                 did = True
+        for slot in list(self._prefilling):
+            h = self._prefilling[slot].handle
+            if h.cancelled_at is not None:
+                self._abort_prefill(slot, "cancel")
+                did = True
+            elif h._expired(now):
+                self._abort_prefill(slot, "deadline")
+                did = True
         return did
+
+    def _abort_prefill(self, slot: int, reason: str) -> None:
+        """Retire a request MID-chunked-prefill (cancel / deadline /
+        client disconnect): the slot goes straight back to the pool — the
+        chunks already written are junk the next occupant's prefill
+        overwrites, exactly like a retired decode slot's cache row."""
+        h = self._prefilling.pop(slot).handle
+        self._handles[slot] = None
+        self._free.append(slot)
+        if h._finish(reason):
+            self.stats["requests_completed"] += 1
+            self._account_terminal(h, reason, time.perf_counter())
 
     def _account_terminal(self, h: RequestHandle, reason: str,
                           now: float, held_slot: bool = True) -> None:
@@ -536,9 +872,10 @@ class ServingEngine:
 
     # ------------------------------------------------------------- prefill
     def _prefill(self, slot: int, h: RequestHandle) -> None:
-        """Admit ``h`` into ``slot``: one batched prompt forward (the same
-        eager ``_forward`` offline ``generate`` prefills with — identical
-        numerics), first token sampled at ``p_len - 1`` through the shared
+        """EAGER-mode admission (``prefill_mode="eager"``, the reference
+        path): one per-request prompt forward through the same eager
+        ``_forward`` offline ``generate`` prefills with — identical
+        numerics — first token sampled at ``p_len - 1`` through the shared
         ``sample_logits``, cache row scattered into the pool."""
         p_len = len(h.prompt)
         prompt = jnp.asarray(h.prompt[None], jnp.int32)
@@ -571,7 +908,152 @@ class ServingEngine:
         self._keys[slot] = np.asarray(h.key, np.uint32)
         self.stats["prefills"] += 1
         self.stats["slot_requests"][slot] += 1
+        self.stats["prefill_tokens"] += p_len
         self._emit(slot, int(first[0]))
+
+    def _bucket_of(self, n: int) -> int:
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    def _schedule_prefills(self) -> bool:
+        """Spend up to ``prefills_per_step`` prefill work units this
+        iteration: first advance chunked prefills already holding slots
+        (one chunk each — finishing started work bounds every occupant's
+        TTFT), then admit queued requests.  Bucketed mode gathers short
+        prompts into per-bucket batches (one jitted forward each) and
+        routes prompts longer than ``prefill_chunk`` to the chunked path;
+        eager mode prefills per request, as it always did."""
+        did = False
+        budget = self.prefills_per_step
+        for slot in list(self._prefilling):
+            if budget <= 0:
+                break
+            self._advance_chunk(slot)
+            budget -= 1
+            did = True
+        batch: List[RequestHandle] = []
+        while budget > 0 and len(self._free) > len(batch):
+            h = self._pop_queued()
+            if h is None:
+                break
+            budget -= 1
+            did = True
+            if self.prefill_mode == "eager":
+                self._prefill(self._free.pop(), h)
+            elif len(h.prompt) > self.prefill_chunk:
+                self._start_chunked(self._free.pop(), h)
+            else:
+                batch.append(h)
+        if batch:
+            self._batch_prefill(batch)
+        return did
+
+    def _batch_prefill(self, batch: List[RequestHandle]) -> None:
+        """Admit up to ``prefills_per_step`` short prompts in ONE jitted
+        batched forward per length bucket.  The program batch is always
+        ``prefills_per_step`` rows (one compiled shape per bucket);
+        unfilled rows target slot ``num_slots``, so every write they
+        produce is dropped on device."""
+        groups: Dict[int, List[RequestHandle]] = {}
+        for h in batch:
+            groups.setdefault(self._bucket_of(len(h.prompt)), []).append(h)
+        for width, group in groups.items():
+            nb = self.prefills_per_step
+            prompts = np.zeros((nb, width), np.int32)
+            p_lens = np.ones((nb,), np.int32)
+            slots = np.full((nb,), self.num_slots, np.int32)
+            r_temp = np.zeros((nb,), np.float32)
+            r_topk = np.zeros((nb,), np.int32)
+            r_topp = np.zeros((nb,), np.float32)
+            r_keys = np.zeros((nb, 2), np.uint32)
+            entries: List[Tuple[int, RequestHandle]] = []
+            for i, h in enumerate(group):
+                slot = self._free.pop()
+                p = len(h.prompt)
+                prompts[i, :p] = h.prompt
+                p_lens[i] = p
+                slots[i] = slot
+                r_temp[i] = h.temperature
+                r_topk[i] = 0 if h.top_k is None else int(h.top_k)
+                r_topp[i] = 0.0 if h.top_p is None else float(h.top_p)
+                r_keys[i] = np.asarray(h.key, np.uint32)
+                h.slot = slot
+                h.started_at = time.perf_counter()
+                self._handles[slot] = h
+                self._mirror_admit(slot, h)
+                self.stats["prefills"] += 1
+                self.stats["slot_requests"][slot] += 1
+                self.stats["prefill_tokens"] += p
+                entries.append((slot, h))
+            first = self._apply_state(self._bucket_fn(width)(
+                self.params, *self._state_args(), self._put(prompts),
+                self._put(p_lens), self._put(slots), self._put(r_temp),
+                self._put(r_topk), self._put(r_topp), self._put(r_keys)))
+            self.stats["prefill_batches"] += 1
+            self.stats["prefill_batched_requests"] += len(group)
+            self.stats["prefill_batch_size_mean"] = round(
+                self.stats["prefill_batched_requests"]
+                / self.stats["prefill_batches"], 3)
+            self._pending.append(("prefill", first, entries))
+
+    def _start_chunked(self, slot: int, h: RequestHandle) -> None:
+        """Claim ``slot`` for a long prompt and run its first chunk; the
+        scheduler advances one more chunk per iteration (``_reap`` can
+        retire it mid-prefill)."""
+        h.slot = slot
+        h.started_at = time.perf_counter()
+        self._handles[slot] = h
+        staging = init_cache(self.model, 1, self.max_len)
+        self._prefilling[slot] = _PrefillJob(h, staging)
+        self.stats["prefills"] += 1
+        self.stats["slot_requests"][slot] += 1
+        self._advance_chunk(slot)
+
+    def _advance_chunk(self, slot: int) -> None:
+        """One chunk of one prefilling slot: write ``prefill_chunk`` more
+        prompt tokens into the cache (the final chunk rounds up to a
+        length bucket instead, samples the first token, and activates the
+        slot for decode)."""
+        job = self._prefilling[slot]
+        h = job.handle
+        p_len = len(h.prompt)
+        remaining = p_len - job.written
+        offset = job.written
+        if remaining > self._chunk_width:
+            width, real, final = self._chunk_width, self._chunk_width, False
+        else:
+            width, real, final = self._bucket_of(remaining), remaining, True
+        toks = np.zeros((1, width), np.int32)
+        toks[0, :real] = h.prompt[offset:offset + real]
+        toks_d = self._put(toks)
+        self.stats["prefill_chunks"] += 1
+        self.stats["prefill_tokens"] += real
+        if not final:
+            job.staging = self._stage_fn(width)(
+                self.params, job.staging, toks_d, offset)
+        else:
+            first = self._apply_state(self._final_fn(width)(
+                self.params, *self._state_args(), job.staging, toks_d,
+                slot, offset, real - 1, p_len, *self._sampling_row(h)))
+            job.staging = None
+        job.written += real
+        if final:
+            del self._prefilling[slot]
+            self._mirror_admit(slot, h)
+            self._pending.append(("prefill", first, [(slot, h)]))
+
+    def _mirror_admit(self, slot: int, h: RequestHandle) -> None:
+        """Host mirrors of the per-slot state the prefill program just set
+        on device — the scheduler's bookkeeping view (``_cur_tok`` lands
+        when the first token is drained)."""
+        self._active[slot] = True
+        self._positions[slot] = len(h.prompt)
+        self._temp[slot] = h.temperature
+        self._topk[slot] = 0 if h.top_k is None else int(h.top_k)
+        self._topp[slot] = 0.0 if h.top_p is None else float(h.top_p)
+        self._keys[slot] = np.asarray(h.key, np.uint32)
 
     # ---------------------------------------------------------- retirement
     def _emit(self, slot: int, token: int) -> None:
@@ -595,6 +1077,12 @@ class ServingEngine:
         self._positions[slot] = 0
         self._cur_tok[slot] = 0
         self._free.append(slot)
+        if self.prefill_mode == "bucketed":
+            # deactivate the device row too: an in-flight lookahead step
+            # may compute one junk token for it (drained entries skip
+            # finished handles), but from the next dispatch on the slot is
+            # inert until a prefill program rewrites it
+            self._dev_act = self._deact_fn(self._dev_act, slot)
         if h._finish(reason):  # no-op when _declare_dead already failed it
             self.stats["requests_completed"] += 1
             self._account_terminal(h, reason, time.perf_counter())
@@ -602,42 +1090,82 @@ class ServingEngine:
     # ------------------------------------------------------------ schedule
     def step(self) -> bool:
         """One engine iteration: retire cancelled/expired requests
-        (``_reap`` — queued ones shed before prefill, running ones freeing
-        their slot mid-run), admit up to ``prefills_per_step`` queued
-        requests into free slots (prefill), then advance every running
-        request by one token (one batched per-row decode step).  Returns
-        whether any work happened."""
+        (``_reap`` — queued ones shed before prefill, running AND
+        mid-chunked-prefill ones freeing their slot mid-run), spend up to
+        ``prefills_per_step`` prefill work units (chunk advances + new
+        admissions), dispatch one decode step for every running request,
+        then drain the pipeline's oldest in-flight step (one-step
+        lookahead: the device computes step t+1 while the host emits step
+        t's tokens).  Returns whether any work happened.
+
+        Hot weight reload fires only when ``decode_steps`` actually
+        ADVANCED onto a multiple of the reload cadence — a reap- or
+        prefill-only iteration leaves the counter parked and must not
+        re-pull on every pass."""
         self.last_beat = time.monotonic()
+        steps_before = self.stats["decode_steps"]
         did = self._reap()
-        for _ in range(self.prefills_per_step):
-            if not self._free:
-                break
-            h = self._pop_queued()
-            if h is None:
-                break
-            self._prefill(self._free.pop(), h)
-            did = True
+        did = self._schedule_prefills() or did
         if self._active.any():
             self._decode_once()
             did = True
-        if did and self._reload_every:
-            if self.stats["decode_steps"] % self._reload_every == 0:
-                self._pull_weights()
+        if self._pending:
+            did = self._drain_pending(flush=not self._active.any()) or did
+        if (self._reload_every
+                and self.stats["decode_steps"] > steps_before
+                and self.stats["decode_steps"] % self._reload_every == 0):
+            self._pull_weights()
         return did
 
     def _decode_once(self) -> None:
-        nxt, self.caches = self._step_fn(
-            self.params, self.caches, jnp.asarray(self._cur_tok),
-            jnp.asarray(self._positions), jnp.asarray(self._active),
-            jnp.asarray(self._temp), jnp.asarray(self._topk),
-            jnp.asarray(self._topp), jnp.asarray(self._keys))
-        nxt = np.asarray(nxt)
+        if self.prefill_mode == "eager":
+            nxt, self.caches = self._step_fn(
+                self.params, self.caches, jnp.asarray(self._cur_tok),
+                jnp.asarray(self._positions), jnp.asarray(self._active),
+                jnp.asarray(self._temp), jnp.asarray(self._topk),
+                jnp.asarray(self._topp), jnp.asarray(self._keys))
+            nxt = np.asarray(nxt)
+            self.stats["decode_steps"] += 1
+            self.stats["active_slot_steps"] += int(self._active.sum())
+            for slot in np.flatnonzero(self._active):
+                self._positions[slot] += 1
+                self._cur_tok[slot] = nxt[slot]
+                self._emit(int(slot), int(nxt[slot]))
+            return
+        # bucketed: dispatch only — every argument is already a device
+        # array (zero uploads), and the sampled row is fetched one
+        # iteration later by _drain_pending (one-step lookahead)
+        entries = [(int(s), self._handles[s])
+                   for s in np.flatnonzero(self._active)]
+        out, self.caches, self._dev_pos = self._decode_fn(
+            self.params, *self._state_args())
+        self._dev_tok = out
         self.stats["decode_steps"] += 1
-        self.stats["active_slot_steps"] += int(self._active.sum())
-        for slot in np.flatnonzero(self._active):
-            self._positions[slot] += 1
-            self._cur_tok[slot] = nxt[slot]
-            self._emit(int(slot), int(nxt[slot]))
+        self.stats["active_slot_steps"] += len(entries)
+        self._pending.append(("decode", out, entries))
+
+    def _drain_pending(self, flush: bool = False) -> bool:
+        """Emit the tokens of in-flight steps older than the lookahead
+        window (``flush=True`` empties the pipeline — the no-decode-work
+        tail).  Each drained entry costs exactly one device→host fetch.
+        A slot whose request retired (or was recycled) after dispatch is
+        skipped: the lookahead step computed one junk token for it, which
+        dies here."""
+        did = False
+        keep = 0 if flush else self._lookahead
+        while len(self._pending) > keep:
+            kind, arr, entries = self._pending.popleft()
+            vals = self._fetch(arr)
+            for i, (slot, h) in enumerate(entries):
+                if h.finish is not None or self._handles[slot] is not h:
+                    continue
+                token = int(vals[slot] if kind == "decode" else vals[i])
+                if kind == "decode":
+                    self._positions[slot] += 1
+                self._cur_tok[slot] = token
+                self._emit(slot, token)
+            did = True
+        return did
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> None:
         """Drive the scheduler inline until queue and slots are empty (the
@@ -815,37 +1343,76 @@ class ServingEngine:
             (self.model, self.params), num_slots=self.num_slots,
             max_len=self.max_len, queue_capacity=self.queue_capacity,
             prefills_per_step=self.prefills_per_step, rolling=self.rolling,
-            default_deadline_s=self.default_deadline_s)
+            default_deadline_s=self.default_deadline_s,
+            prefill_mode=self.prefill_mode,
+            prefill_chunk=self.prefill_chunk)
         if self._ps_addr is not None:
             eng.attach_ps(*self._ps_addr, every=self._reload_every)
         return eng
 
     def warmup(self) -> "ServingEngine":
-        """Compile the engine's jitted programs (one throwaway
-        all-slots-inactive decode step + one self-identical slot write)
-        before serving traffic.  A fresh engine otherwise pays its jit
-        trace/compile inside the FIRST real decode step — under an
-        ``EngineSupervisor`` whose ``liveness_deadline`` is shorter than
-        that compile, a cold engine is indistinguishable from a wedged
-        one, so the supervisor warms every respawned clone before it goes
-        live (and callers who supervise a fresh engine tightly should
-        too).  Idempotent; fresh/idle engines only."""
-        if self._active.any():
+        """Compile the engine's jitted programs before serving traffic: the
+        decode step plus — in bucketed mode — EVERY bucket's batched
+        prefill program and (when long prompts can chunk) the chunk-step
+        programs.  A fresh engine otherwise pays each program's jit
+        trace/compile inside the first real iteration that needs it —
+        under an ``EngineSupervisor`` whose ``liveness_deadline`` is
+        shorter than that compile, a cold engine is indistinguishable
+        from a wedged one, so the supervisor warms every respawned clone
+        before it goes live (cold jit must never read as a wedge under
+        live traffic).  The prefill warmups target slot ``num_slots``, so
+        every write drops on device — state is untouched.  Idempotent;
+        fresh/idle engines only."""
+        if self._active.any() or self._prefilling:
             raise RuntimeError("warmup() on an engine with active slots "
                                "would consume a real decode step")
-        nxt, self.caches = self._step_fn(
-            self.params, self.caches, jnp.asarray(self._cur_tok),
-            jnp.asarray(self._positions), jnp.asarray(self._active),
-            jnp.asarray(self._temp), jnp.asarray(self._topk),
-            jnp.asarray(self._topp), jnp.asarray(self._keys))
-        jax.block_until_ready(nxt)
-        # slot-write program: rewrite row 0 with a copy of itself (a copy —
-        # the pool is donated, and XLA rejects donating a buffer aliased
-        # by another argument; inactive slots hold junk a prefill fully
-        # overwrites, so this is a no-op in the same sense as the
-        # free-slot decode rows)
-        row = tmap(lambda B: jnp.copy(B[0:1]), self.caches)
-        self.caches = self._write_slot_fn(self.caches, row, jnp.int32(0))
+        if self.prefill_mode == "eager":
+            nxt, self.caches = self._step_fn(
+                self.params, self.caches, jnp.asarray(self._cur_tok),
+                jnp.asarray(self._positions), jnp.asarray(self._active),
+                jnp.asarray(self._temp), jnp.asarray(self._topk),
+                jnp.asarray(self._topp), jnp.asarray(self._keys))
+            jax.block_until_ready(nxt)
+            # slot-write program: rewrite row 0 with a copy of itself (a
+            # copy — the pool is donated, and XLA rejects donating a
+            # buffer aliased by another argument; inactive slots hold junk
+            # a prefill fully overwrites, so this is a no-op in the same
+            # sense as the free-slot decode rows)
+            row = tmap(lambda B: jnp.copy(B[0:1]), self.caches)
+            self.caches = self._write_slot_fn(self.caches, row,
+                                              jnp.int32(0))
+            jax.block_until_ready(jax.tree_util.tree_leaves(self.caches)[0])
+            return self
+        # bucketed: one all-slots-inactive decode step...
+        out, self.caches, self._dev_pos = self._decode_fn(
+            self.params, *self._state_args())
+        self._dev_tok = out
+        jax.block_until_ready(out)
+        # ...every bucket's batched prefill program (all rows dropped)...
+        nb = self.prefills_per_step
+        drop = jnp.full((nb,), self.num_slots, jnp.int32)
+        for width in self._buckets:
+            self._apply_state(self._bucket_fn(width)(
+                self.params, *self._state_args(),
+                jnp.zeros((nb, width), jnp.int32),
+                jnp.ones((nb,), jnp.int32), drop,
+                jnp.zeros((nb,), jnp.float32), jnp.zeros((nb,), jnp.int32),
+                jnp.zeros((nb,), jnp.float32),
+                jnp.zeros((nb, 2), jnp.uint32)))
+        # ...and the chunk-step programs, when a prompt can be long enough
+        # to take the chunked path at all
+        if self.max_len > self.prefill_chunk:
+            one = (jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
+                   jnp.zeros((1,), jnp.float32),
+                   jnp.zeros((1, 2), jnp.uint32))
+            for width in sorted({self._chunk_width, *self._buckets}):
+                toks = jnp.zeros((1, width), jnp.int32)
+                staging = init_cache(self.model, 1, self.max_len)
+                staging = self._stage_fn(width)(self.params, staging,
+                                                toks, 0)
+                self._apply_state(self._final_fn(width)(
+                    self.params, *self._state_args(), staging, toks,
+                    self.num_slots, 0, 0, 1, *one))
         jax.block_until_ready(jax.tree_util.tree_leaves(self.caches)[0])
         return self
 
@@ -892,6 +1459,10 @@ class ServingEngine:
                                        pool=self._reload_pool)
             self.params = self.model.set_weights(self.params,
                                                  msg["weights"])
+            if self.prefill_mode == "bucketed":
+                # keep the weights device-resident: the decode loop's
+                # zero-upload contract must survive a reload
+                self.params = jax.device_put(self.params)
             self.stats["weight_reloads"] += 1
         except (ConnectionError, OSError, ValueError) as e:
             logger.warning("serving hot-reload pull failed (%s); keeping "

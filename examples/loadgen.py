@@ -47,15 +47,19 @@ PROMPT_LENGTHS = (4, 6, 8)
 
 def make_trace(num_requests: int, seed: int = 0, vocab: int = 16,
                num_steps: int = 16, temperature: float = 0.0,
-               sampled_fraction: float = 0.5) -> List[Dict[str, Any]]:
+               sampled_fraction: float = 0.5,
+               prompt_lengths: Sequence[int] = PROMPT_LENGTHS
+               ) -> List[Dict[str, Any]]:
     """A deterministic request trace: seeded prompt contents + lengths, a
     ``sampled_fraction`` of requests sampling at ``temperature`` (per-
     request seeds), the rest greedy — so the slot batch always mixes
-    sampling configs, exercising the per-slot sampler."""
+    sampling configs, exercising the per-slot sampler.  ``prompt_lengths``
+    overrides the drawn length set (the long-prompt TTFT legs use lengths
+    past the engine's ``prefill_chunk`` to exercise chunked prefill)."""
     rng = np.random.default_rng(seed)
     trace = []
     for i in range(int(num_requests)):
-        p_len = int(PROMPT_LENGTHS[rng.integers(0, len(PROMPT_LENGTHS))])
+        p_len = int(prompt_lengths[rng.integers(0, len(prompt_lengths))])
         req: Dict[str, Any] = {
             "prompt": rng.integers(0, vocab, p_len).astype(np.int32),
             "num_steps": int(num_steps),
@@ -75,7 +79,8 @@ def _percentile_ms(latencies_s: Sequence[float], q: float) -> Optional[float]:
 
 def _metrics(engine, latencies: List[float], wall_s: float,
              tokens: int, completed: int, shed: int = 0,
-             killed: int = 0) -> Dict[str, Any]:
+             killed: int = 0, ttfts: Optional[List[float]] = None,
+             prefill_tokens: int = 0) -> Dict[str, Any]:
     s = engine.stats
     submitted = max(s["requests_submitted"], 1)
     return {
@@ -87,6 +92,12 @@ def _metrics(engine, latencies: List[float], wall_s: float,
         "tokens_per_sec": round(tokens / wall_s, 1) if wall_s > 0 else None,
         "p50_ms": _percentile_ms(latencies, 50),
         "p99_ms": _percentile_ms(latencies, 99),
+        # time-to-first-token, separately from end-to-end latency: the
+        # prefill path's own observable (queueing + prefill, no decode)
+        "ttft_p50_ms": _percentile_ms(ttfts or [], 50),
+        "ttft_p99_ms": _percentile_ms(ttfts or [], 99),
+        "prefill_tokens_per_sec": (round(prefill_tokens / wall_s, 1)
+                                   if wall_s > 0 else None),
         "slot_occupancy": (round(engine.slot_occupancy, 3)
                            if engine.slot_occupancy is not None else None),
         # failure-semantics observables (engine-lifetime rates: loadgen
@@ -118,6 +129,7 @@ def run_closed_loop(engine, trace: Sequence[Dict[str, Any]],
     it = iter(enumerate(trace))
     lock = threading.Lock()
     latencies: List[float] = []
+    ttfts: List[float] = []
     errors: List[BaseException] = []
     killed: List[Any] = []
     kill_rng = np.random.default_rng(int(chaos_seed) + (1 << 20))
@@ -126,6 +138,7 @@ def run_closed_loop(engine, trace: Sequence[Dict[str, Any]],
                  for i in range(len(trace))} if chaos_kill > 0 else {}
     tokens0 = engine.stats["tokens_generated"]
     completed0 = engine.stats["requests_completed"]
+    prefill0 = engine.stats["prefill_tokens"]
 
     def user():
         while True:
@@ -158,6 +171,8 @@ def run_closed_loop(engine, trace: Sequence[Dict[str, Any]],
             with lock:
                 if h.finish in ("eos", "length", "empty"):
                     latencies.append(h.latency_s)
+                    if h.ttft_s is not None:
+                        ttfts.append(h.ttft_s)
 
     engine.start()
     threads = [threading.Thread(target=user, name=f"loadgen-user-{i}")
@@ -182,7 +197,8 @@ def run_closed_loop(engine, trace: Sequence[Dict[str, Any]],
     return _metrics(engine, latencies, wall,
                     engine.stats["tokens_generated"] - tokens0,
                     engine.stats["requests_completed"] - completed0,
-                    killed=len(killed))
+                    killed=len(killed), ttfts=ttfts,
+                    prefill_tokens=engine.stats["prefill_tokens"] - prefill0)
 
 
 def run_open_loop(engine, trace: Sequence[Dict[str, Any]], qps: float,
@@ -198,6 +214,7 @@ def run_open_loop(engine, trace: Sequence[Dict[str, Any]], qps: float,
     shed = 0
     tokens0 = engine.stats["tokens_generated"]
     completed0 = engine.stats["requests_completed"]
+    prefill0 = engine.stats["prefill_tokens"]
     t0 = time.perf_counter()
     for i, req in enumerate(trace):
         due = t0 + i / float(qps)
@@ -209,15 +226,19 @@ def run_open_loop(engine, trace: Sequence[Dict[str, Any]], qps: float,
         except QueueFull:
             shed += 1
     latencies = []
+    ttfts = []
     for h in handles:
         if not h.wait(timeout=timeout_s):
             raise TimeoutError(f"request {h.id} incomplete")
         latencies.append(h.latency_s)
+        if h.ttft_s is not None:
+            ttfts.append(h.ttft_s)
     wall = time.perf_counter() - t0
     out = _metrics(engine, latencies, wall,
                    engine.stats["tokens_generated"] - tokens0,
                    engine.stats["requests_completed"] - completed0,
-                   shed=shed)
+                   shed=shed, ttfts=ttfts,
+                   prefill_tokens=engine.stats["prefill_tokens"] - prefill0)
     out["offered_qps"] = float(qps)
     return out
 
@@ -255,10 +276,16 @@ def sequential_baseline(fitted, trace: Sequence[Dict[str, Any]],
 
 
 def build_engine(num_slots: int = 4, max_len: int = 32, vocab: int = 16,
-                 queue_capacity: int = 64, seed: int = 0):
+                 queue_capacity: int = 64, seed: int = 0,
+                 prefill_mode: str = "bucketed",
+                 prefill_chunk: Optional[int] = None,
+                 prefills_per_step: Optional[int] = None):
     """A small random-weight LM + engine (throughput benches measure
     scheduling and batching, not model quality) — one place so bench,
-    tests, and the CLI agree on the workload shape."""
+    tests, and the CLI agree on the workload shape.  ``prefill_mode``/
+    ``prefill_chunk``/``prefills_per_step`` pass through to the engine
+    (the TTFT comparison legs run the same trace through ``"bucketed"``
+    and ``"eager"``)."""
     import jax
 
     from distkeras_tpu.core.model import FittedModel
@@ -270,8 +297,13 @@ def build_engine(num_slots: int = 4, max_len: int = 32, vocab: int = 16,
                            compute_dtype="float32")
     params = model.init(jax.random.PRNGKey(seed), (max_len,))
     fitted = FittedModel(model, params)
+    kw: Dict[str, Any] = {"prefill_mode": prefill_mode}
+    if prefill_chunk is not None:
+        kw["prefill_chunk"] = int(prefill_chunk)
+    if prefills_per_step is not None:
+        kw["prefills_per_step"] = int(prefills_per_step)
     engine = ServingEngine(fitted, num_slots=num_slots, max_len=max_len,
-                           queue_capacity=queue_capacity)
+                           queue_capacity=queue_capacity, **kw)
     return fitted, engine
 
 
@@ -295,9 +327,22 @@ def main():
     ap.add_argument("--chaos-seed", type=int, default=0)
     ap.add_argument("--deadline", type=float, default=None,
                     help="per-request deadline_s stamped on every request")
+    ap.add_argument("--prefill-mode", choices=("bucketed", "eager"),
+                    default="bucketed",
+                    help="engine prefill path: the compiled bucketed fast "
+                         "path (default) or the eager reference")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked-prefill threshold/size (tokens); prompts "
+                         "longer than this interleave with decode steps")
+    ap.add_argument("--ttft", action="store_true",
+                    help="print a dedicated time-to-first-token percentile "
+                         "line (p50/p99 + prefill counters) for the "
+                         "closed loop")
     args = ap.parse_args()
 
-    fitted, engine = build_engine(num_slots=args.slots)
+    fitted, engine = build_engine(num_slots=args.slots,
+                                  prefill_mode=args.prefill_mode,
+                                  prefill_chunk=args.prefill_chunk)
     trace = make_trace(args.requests, num_steps=args.steps,
                        temperature=args.temperature)
     try:
@@ -308,6 +353,16 @@ def main():
                                  deadline_s=args.deadline)
         print(json.dumps({"mode": "closed_loop",
                           "concurrency": args.concurrency, **closed}))
+        if args.ttft:
+            print(json.dumps({
+                "mode": "ttft", "prefill_mode": args.prefill_mode,
+                "p50_ms": closed["ttft_p50_ms"],
+                "p99_ms": closed["ttft_p99_ms"],
+                "prefill_tokens_per_sec":
+                    closed["prefill_tokens_per_sec"],
+                "prefill_chunks": engine.stats["prefill_chunks"],
+                "prefill_batch_size_mean":
+                    engine.stats["prefill_batch_size_mean"]}))
         seq = sequential_baseline(fitted, trace, max_len=engine.max_len)
         print(json.dumps({"mode": "sequential", **seq}))
         if closed["tokens_per_sec"] and seq["tokens_per_sec"]:
@@ -315,7 +370,9 @@ def main():
                               round(closed["tokens_per_sec"]
                                     / seq["tokens_per_sec"], 2)}))
         for qps in filter(None, args.qps_sweep.split(",")):
-            _, engine = build_engine(num_slots=args.slots)
+            _, engine = build_engine(num_slots=args.slots,
+                                     prefill_mode=args.prefill_mode,
+                                     prefill_chunk=args.prefill_chunk)
             point = run_open_loop(engine, trace, qps=float(qps))
             engine.stop()
             print(json.dumps({"mode": "open_loop", **point}))

@@ -51,6 +51,14 @@ def test_closed_loop_fast_trace_lossless():
     assert m["p50_ms"] is not None and m["p99_ms"] >= m["p50_ms"]
     assert 0.0 < m["slot_occupancy"] <= 1.0
     assert all(n >= 1 for n in engine.stats["slot_requests"])
+    # the TTFT observables ride the same run: first token precedes the
+    # end of its request, and prompt tokens flowed through the compiled
+    # prefill path
+    assert m["ttft_p50_ms"] is not None
+    assert m["ttft_p50_ms"] <= m["p50_ms"]
+    assert m["prefill_tokens_per_sec"] > 0
+    assert engine.stats["prefill_batches"] >= 1
+    assert engine.stats["prefill_batch_size_mean"] >= 1.0
 
 
 def test_closed_loop_outputs_match_offline_generate():
@@ -87,15 +95,23 @@ def test_bench_serving_fields_shape():
                         "serving_p99_ms", "serving_slot_occupancy",
                         "serving_sequential_tokens_per_sec",
                         "serving_shed_rate", "serving_slot_reclaim_ms",
-                        "serving_deadline_miss_rate"}
+                        "serving_deadline_miss_rate",
+                        "serving_ttft_p50_ms", "serving_ttft_p99_ms",
+                        "serving_prefill_tokens_per_sec",
+                        "serving_longprompt_ttft_p99_ms",
+                        "serving_longprompt_ttft_eager_p99_ms"}
 
 
 def test_closed_loop_chaos_kill_schedule_no_leaks():
     """The --chaos client-kill schedule: seeded kills cancel mid-run, the
     engine reclaims every slot (zero leaks), survivors complete, and the
     new failure-semantics metrics are recorded."""
+    # 24-step requests: the fast-path engine streams short requests so
+    # quickly that a killer waiting for its seeded token count could lose
+    # the race and cancel an already-finished request (a no-op) — the
+    # longer run keeps every seeded kill landing mid-run
     _, engine = loadgen.build_engine(num_slots=2, queue_capacity=16)
-    trace = loadgen.make_trace(8, num_steps=8, temperature=0.5)
+    trace = loadgen.make_trace(8, num_steps=24, temperature=0.5)
     try:
         m = loadgen.run_closed_loop(engine, trace, concurrency=4,
                                     timeout_s=120.0, chaos_kill=0.4,

@@ -1,0 +1,381 @@
+"""Serving fast path (distkeras_tpu/serving.py, ``prefill_mode="bucketed"``).
+
+PR 9 rebuilt the engine's compute path in three layers: compiled bucketed
+batch prefill, chunked long-prompt prefill interleaved with decode, and
+device-resident decode state with one-step lookahead.  The contract
+pinned here:
+
+ - bucketed AND chunked prefill emit tokens BIT-IDENTICAL to the eager
+   reference (``prefill_mode="eager"``) and to offline ``generate``,
+   across greedy + sampled × rolling + full-cache × mixed prompt lengths
+   sharing one bucketed batch — the fast path is an execution strategy,
+   never a numerics change;
+ - the bucketed hot path never calls the eager ``_forward`` (compiled by
+   construction, the acceptance criterion);
+ - a decode-only iteration performs ZERO host→device uploads and exactly
+   ONE device→host readback (the sampled token row) — asserted with a
+   transfer-counting double wrapped around the jitted step;
+ - a long-prompt admission stalls the running batch by at most one
+   ``prefill_chunk`` chunk per iteration (deterministic counter
+   assertion — the Sarathi-style stall-free property);
+ - ``warmup()`` precompiles every bucket/chunk/decode program, so live
+   traffic after a supervisor respawn re-traces NOTHING;
+ - hot weight reload fires only when ``decode_steps`` actually advances
+   (a reap-only iteration parked on a reload multiple must not re-pull).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distkeras_tpu.serving as serving
+from distkeras_tpu.core import decode
+from distkeras_tpu.core.model import FittedModel
+from distkeras_tpu.models import transformer_lm
+from distkeras_tpu.serving import ServingEngine, _pow2_buckets
+
+VOCAB = 17
+PROMPT = np.array([3, 4, 5, 6], np.int32)
+
+
+def _fitted(seed=0, **kw):
+    model = transformer_lm(vocab_size=VOCAB, seq_len=32, d_model=16,
+                           num_heads=2, num_layers=2, mlp_dim=32,
+                           compute_dtype="float32", **kw)
+    params = model.init(jax.random.PRNGKey(seed), (32,))
+    return FittedModel(model, params)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    return _fitted()
+
+
+@pytest.fixture(scope="module")
+def windowed():
+    return _fitted(seed=1, attention_window=6)
+
+
+def _want(fitted, h, **kw):
+    return np.asarray(fitted.generate(
+        h.prompt[None], h.num_steps, max_len=kw.pop("max_len"),
+        temperature=h.temperature,
+        rng=h.key if h.temperature > 0 else None,
+        top_k=h.top_k, top_p=h.top_p, **kw))[0]
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: bucketed / chunked / rolling vs eager reference + generate
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kw", [
+    {},                                                       # greedy
+    {"temperature": 0.7, "seed": 11},                         # plain sample
+    {"temperature": 0.7, "top_k": 5, "top_p": 0.9, "seed": 11},
+])
+def test_bucketed_lone_request_matches_eager_and_generate(fitted, kw):
+    rows = {}
+    for mode in ("bucketed", "eager"):
+        eng = ServingEngine(fitted, num_slots=3, max_len=24,
+                            prefill_mode=mode)
+        h = eng.submit(PROMPT, 8, **kw)
+        eng.run_until_idle()
+        rows[mode] = h.result()
+    want = _want(fitted, h, max_len=24)
+    np.testing.assert_array_equal(rows["bucketed"], want)
+    np.testing.assert_array_equal(rows["eager"], want)
+
+
+def test_mixed_prompt_lengths_share_one_bucketed_batch(fitted):
+    """Four requests of four different lengths admitted in the same
+    iteration land in ONE batched bucket prefill (their lengths all round
+    up to the same bucket), and every output still matches generate."""
+    eng = ServingEngine(fitted, num_slots=4, max_len=24,
+                        prefills_per_step=4)
+    hs = [eng.submit(np.arange(1, 1 + p, dtype=np.int32) % VOCAB, 6,
+                     temperature=0.5, seed=40 + p)
+          for p in (2, 3, 5, 7)]
+    eng.run_until_idle()
+    assert eng.stats["prefill_batches"] == 1
+    assert eng.stats["prefill_batch_size_mean"] == 4.0
+    for h in hs:
+        np.testing.assert_array_equal(h.result(),
+                                      _want(fitted, h, max_len=24))
+
+
+def test_chunked_prefill_bit_identical_and_counted(fitted):
+    """A prompt past ``prefill_chunk`` splits into ceil(P/chunk) chunks
+    (the final one bucket-rounded) and still reproduces generate exactly,
+    greedy and sampled, while a short concurrent request rides along."""
+    long_p = (np.arange(1, 14, dtype=np.int32) * 3) % VOCAB  # 13 tokens
+    for kw in ({}, {"temperature": 0.6, "seed": 5}):
+        eng = ServingEngine(fitted, num_slots=2, max_len=32,
+                            prefill_chunk=4)
+        h = eng.submit(long_p, 8, **kw)
+        h2 = eng.submit(PROMPT, 4)
+        eng.run_until_idle()
+        assert eng.stats["prefill_chunks"] == 4  # 4+4+4 + final 1
+        np.testing.assert_array_equal(h.result(),
+                                      _want(fitted, h, max_len=32))
+        np.testing.assert_array_equal(h2.result(),
+                                      _want(fitted, h2, max_len=32))
+
+
+def test_rolling_bucketed_and_chunked_bit_identical(windowed):
+    """Rolling engines: the bucket program ring-converts per-row traced
+    lengths; the chunked path stages a full cache and collapses it on the
+    final chunk — both must match offline rolling generate."""
+    eng = ServingEngine(windowed, num_slots=2, max_len=24, rolling=True)
+    h1 = eng.submit(np.arange(1, 8, dtype=np.int32) % VOCAB, 10,
+                    temperature=0.6, seed=9)
+    h2 = eng.submit(np.array([1, 2], np.int32), 6)
+    eng.run_until_idle()
+    for h in (h1, h2):
+        np.testing.assert_array_equal(
+            h.result(), _want(windowed, h, max_len=24, rolling=True))
+    assert eng.caches[2]["k"].shape[1] == 6  # the pool really is a ring
+
+    eng = ServingEngine(windowed, num_slots=2, max_len=28, rolling=True,
+                        prefill_chunk=4)
+    lp = (np.arange(1, 14, dtype=np.int32) * 5) % VOCAB
+    h = eng.submit(lp, 8, temperature=0.8, seed=3)
+    eng.run_until_idle()
+    assert eng.stats["prefill_chunks"] == 4
+    np.testing.assert_array_equal(
+        h.result(), _want(windowed, h, max_len=28, rolling=True))
+
+
+def test_ring_from_prefill_matches_to_ring():
+    """The traced per-row ring conversion is a relayout: bit-equal to the
+    host-side _to_ring for every p_len/window relation, including a
+    mixed-length batch in one call."""
+    rng = np.random.default_rng(0)
+    c = jnp.asarray(rng.standard_normal((3, 12, 2, 3)), jnp.float32)
+    for lens, w in (([9, 3, 4], 4), ([1, 12, 6], 6)):
+        got = np.asarray(decode.ring_from_prefill(c, jnp.array(lens), w))
+        for r, p in enumerate(lens):
+            want = np.asarray(decode._to_ring(c[r:r + 1, :p], p, w))
+            np.testing.assert_array_equal(got[r:r + 1], want)
+
+
+def test_eos_retirement_on_fast_path(fitted):
+    greedy = np.asarray(fitted.generate(PROMPT[None], 8, max_len=24))[0]
+    eos = int(greedy[len(PROMPT) + 2])
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    h = eng.submit(PROMPT, 8, eos_id=eos, pad_id=1)
+    eng.run_until_idle()
+    want = np.asarray(fitted.generate(PROMPT[None], 8, eos_id=eos,
+                                      pad_id=1, max_len=24))[0]
+    np.testing.assert_array_equal(h.result(), want)
+    assert h.finish == "eos"
+
+
+# ---------------------------------------------------------------------------
+# hot-path discipline: no eager forward, one transfer each way
+# ---------------------------------------------------------------------------
+
+def test_no_eager_forward_in_bucketed_hot_path(fitted, monkeypatch):
+    """Acceptance criterion: with prefill_mode="bucketed" (the default)
+    the engine never calls the module-level eager ``_forward`` — only the
+    eager reference mode does."""
+    def bomb(*a, **k):
+        raise AssertionError("eager _forward reached the bucketed hot "
+                             "path")
+
+    monkeypatch.setattr(serving, "_forward", bomb)
+    eng = ServingEngine(fitted, num_slots=2, max_len=24, prefill_chunk=4)
+    h = eng.submit(PROMPT, 4)
+    hl = eng.submit((np.arange(1, 12, dtype=np.int32) * 7) % VOCAB, 4)
+    eng.run_until_idle()  # both the batch and the chunked path: no bomb
+    assert h.done and hl.done
+    eager = ServingEngine(fitted, num_slots=1, max_len=24,
+                          prefill_mode="eager")
+    eager.submit(PROMPT, 2)
+    with pytest.raises(AssertionError, match="hot path"):
+        eager.run_until_idle()
+
+
+def test_decode_iteration_transfer_discipline(fitted):
+    """Steady-state decode: zero host→device uploads, exactly one
+    device→host readback per iteration, and every jitted-step argument is
+    already a device array (the test double wraps the step)."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=24).warmup()
+    h = eng.submit(PROMPT, 14)
+    eng.step()  # admission iteration (uploads happen here, counted apart)
+    orig = eng._decode_fn
+
+    def checked(*args):
+        leaves = jax.tree_util.tree_leaves(args)
+        assert all(isinstance(a, jax.Array) for a in leaves), \
+            "decode step received a host array (implicit h2d transfer)"
+        return orig(*args)
+
+    eng._decode_fn = checked
+    h0, d0 = eng.stats["h2d_transfers"], eng.stats["d2h_transfers"]
+    for _ in range(6):
+        eng.step()
+    assert eng.stats["h2d_transfers"] - h0 == 0
+    assert eng.stats["d2h_transfers"] - d0 == 6
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h.result(),
+                                  _want(fitted, h, max_len=24))
+
+
+def test_lookahead_flushes_at_idle(fitted):
+    """One-step lookahead leaves the pipeline drained when work runs out:
+    every token is delivered, nothing pends, and the engine reports idle."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    h = eng.submit(PROMPT, 5)
+    eng.run_until_idle()
+    assert h.done and len(h.tokens) == 5
+    assert not eng._pending and not eng._prefilling
+    assert not eng.step()  # truly idle
+
+
+# ---------------------------------------------------------------------------
+# stall-free chunked admission (deterministic counters, tier-1)
+# ---------------------------------------------------------------------------
+
+def test_long_prompt_admission_does_not_stall_decode(fitted):
+    """While a 12-token prompt chunk-prefills at prefill_chunk=4, the
+    running request keeps decoding EVERY iteration: the admission costs
+    the running batch at most one chunk of prefill per step, never the
+    whole prompt (the counter twin of the wall-clock TTFT bench)."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=32, prefill_chunk=4)
+    a = eng.submit(PROMPT, 20)
+    while not a.tokens:
+        eng.step()
+    steps0 = eng.stats["decode_steps"]
+    a0 = len(a.tokens)
+    b = eng.submit((np.arange(1, 13, dtype=np.int32) * 3) % VOCAB, 4)
+    iters = 0
+    while not b.tokens and iters < 20:
+        eng.step()
+        iters += 1
+    assert eng.stats["prefill_chunks"] == 3        # 4 + 4 + final 4
+    # every chunk iteration also ran a decode step for the running batch
+    decoded = eng.stats["decode_steps"] - steps0
+    assert decoded >= 3 and decoded == iters
+    assert len(a.tokens) - a0 >= 3
+    # and B's first token arrived within chunks + pipeline slack
+    assert iters <= 5
+    eng.run_until_idle()
+    np.testing.assert_array_equal(a.result(), _want(fitted, a, max_len=32))
+    np.testing.assert_array_equal(b.result(), _want(fitted, b, max_len=32))
+
+
+# ---------------------------------------------------------------------------
+# warmup precompilation + reload gate
+# ---------------------------------------------------------------------------
+
+def test_warmup_precompiles_every_program(fitted, monkeypatch):
+    """After warmup(), traffic through every bucket AND the chunked path
+    triggers zero new jit traces (counted via decode._forward, which every
+    program traces through) — a supervisor respawn must not pay per-bucket
+    compiles under live traffic."""
+    calls = []
+    orig = decode._forward
+
+    def counting(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(decode, "_forward", counting)
+    eng = ServingEngine(fitted, num_slots=2, max_len=24, prefill_chunk=4,
+                        prefills_per_step=2).warmup()
+    traced = len(calls)
+    assert traced > 0
+    h1 = eng.submit(np.array([2, 3, 4], np.int32), 3)       # bucket batch
+    h2 = eng.submit((np.arange(1, 12, dtype=np.int32)) % VOCAB, 3)  # chunks
+    eng.run_until_idle()
+    assert h1.done and h2.done
+    assert len(calls) == traced, "live traffic re-traced a program"
+
+
+def test_warmup_refuses_mid_prefill_engine(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=32, prefill_chunk=4)
+    eng.submit((np.arange(1, 13, dtype=np.int32)) % VOCAB, 4)
+    eng.step()
+    assert eng._prefilling
+    with pytest.raises(RuntimeError, match="active"):
+        eng.warmup()
+
+
+def test_pow2_bucket_ladder():
+    assert _pow2_buckets(32) == [8, 16, 32]
+    assert _pow2_buckets(100) == [8, 16, 32, 64, 100]
+    assert _pow2_buckets(8) == [8]
+    assert _pow2_buckets(5) == [5]
+
+
+def test_prefill_knob_validation(fitted):
+    with pytest.raises(ValueError, match="prefill_mode"):
+        ServingEngine(fitted, num_slots=1, max_len=24,
+                      prefill_mode="turbo")
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ServingEngine(fitted, num_slots=1, max_len=24, prefill_chunk=0)
+
+
+def test_respawn_clone_carries_prefill_knobs(fitted):
+    eng = ServingEngine(fitted, num_slots=2, max_len=24,
+                        prefill_mode="eager", prefill_chunk=16)
+    clone = eng.respawn_clone()
+    assert clone.prefill_mode == "eager"
+    assert clone.prefill_chunk == 16
+
+
+def test_reload_gate_requires_decode_progress(fitted):
+    """The hot-reload satellite: _pull_weights fires only when
+    decode_steps ADVANCES onto a reload multiple — a reap-only iteration
+    parked on a multiple must not re-pull every pass."""
+    eng = ServingEngine(fitted, num_slots=1, max_len=24)
+    pulls = []
+    eng._pull_weights = lambda: pulls.append(1)
+    eng._reload_every = 1
+    eng.submit(PROMPT, 3)
+    eng.run_until_idle()
+    base = len(pulls)
+    assert base >= 1  # decode progress pulled as expected
+    # park the counter on a multiple, then run a reap-only iteration
+    h2 = eng.submit(PROMPT, 3)
+    eng.cancel(h2)
+    assert eng.step()  # reap does work, decode_steps does not advance
+    assert len(pulls) == base
+
+
+# ---------------------------------------------------------------------------
+# perf smoke (slow): compiled batched prefill beats sequential eager
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_batched_prefill_beats_sequential_eager_prefill(fitted):
+    """≥ 4 queued prompts: one warmed bucketed engine (batched compiled
+    prefill) finishes the admission burst faster than the eager engine's
+    per-request uncompiled prefills — the wall-clock half of the fast-path
+    acceptance (the counter half is tier-1 above)."""
+    prompts = [((np.arange(8) * (i + 2)) % VOCAB).astype(np.int32)
+               for i in range(8)]
+
+    def run(mode):
+        eng = ServingEngine(fitted, num_slots=8, max_len=24,
+                            prefills_per_step=8, prefill_mode=mode)
+        if mode == "bucketed":
+            eng.warmup()
+        # throwaway round so BOTH modes have their decode/prefill
+        # programs compiled before the timed burst
+        eng.submit(prompts[0], 1)
+        eng.run_until_idle()
+        t0 = time.perf_counter()
+        hs = [eng.submit(p, 1) for p in prompts]
+        eng.run_until_idle()
+        dt = time.perf_counter() - t0
+        assert all(h.done for h in hs)
+        return dt
+
+    eager = run("eager")
+    fast = run("bucketed")
+    assert fast < eager, (fast, eager)

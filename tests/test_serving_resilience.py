@@ -149,6 +149,71 @@ def test_deadline_expires_mid_run_frees_slot(fitted):
     _assert_slots_reclaimed(eng)
 
 
+LONG_PROMPT = (np.arange(1, 13, dtype=np.int32) * 3) % VOCAB  # 12 tokens
+
+
+def test_cancel_mid_chunked_prefill_frees_slot(fitted):
+    """PR 9's chunked prefill adds a new retirement window: a slot that is
+    claimed but still PREFILLING chunk-by-chunk.  Cancel must free it
+    before its first token, and the next occupant is unpolluted."""
+    eng = ServingEngine(fitted, num_slots=1, max_len=24, prefill_chunk=4)
+    h = eng.submit(LONG_PROMPT, 4)
+    eng.step()  # admission + first chunk: claimed, not yet decoding
+    assert eng._prefilling and not eng._active.any() and not h.done
+    eng.cancel(h)
+    eng.step()
+    assert h.finish == "cancel" and not h.tokens
+    assert eng.stats["requests_cancelled"] == 1
+    assert len(eng.stats["slot_reclaim_ms"]) == 1  # it held a KV slot
+    _assert_slots_reclaimed(eng)
+    h2 = eng.submit(PROMPT, 3)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h2.result(), _want(fitted, PROMPT, 3))
+
+
+def test_deadline_mid_chunked_prefill_frees_slot(fitted):
+    eng = ServingEngine(fitted, num_slots=1, max_len=24, prefill_chunk=4)
+    h = eng.submit(LONG_PROMPT, 4, deadline_s=0.05)
+    eng.step()
+    assert eng._prefilling
+    time.sleep(0.06)
+    eng.run_until_idle()
+    assert h.finish == "deadline" and not h.tokens
+    assert eng.stats["requests_expired"] == 1
+    _assert_slots_reclaimed(eng)
+
+
+def test_disconnect_mid_chunked_prefill_reclaims(fitted):
+    """A client that dies while its request is mid-chunked-prefill: the
+    server's disconnect reclamation cancels it, and the scheduler aborts
+    the prefill and frees the slot — no handle or slot leaks."""
+    eng = ServingEngine(fitted, num_slots=1, max_len=24, prefill_chunk=4)
+    started, release = threading.Event(), threading.Event()
+    orig = eng._advance_chunk
+
+    def gated(slot):
+        started.set()
+        release.wait(10.0)  # hold the prefill mid-flight
+        orig(slot)
+
+    eng._advance_chunk = gated
+    try:
+        with ServingServer(eng) as srv:
+            c = ServingClient(*srv.addr)
+            c.submit(LONG_PROMPT, 4)
+            assert started.wait(10.0)
+            _hard_close(c.sock)  # RST while the prefill is gated
+            assert _wait_for(lambda: srv.disconnect_cancels >= 1)
+            release.set()
+            assert _wait_for(lambda: eng.stats["requests_cancelled"] >= 1)
+            assert _wait_for(lambda: not eng._prefilling
+                             and sorted(eng._free) == [0])
+    finally:
+        release.set()
+    assert all(h is None for h in eng._handles)
+    assert not srv._handles and not srv._owner  # no handle-table leaks
+
+
 def test_engine_wide_default_deadline(fitted):
     eng = ServingEngine(fitted, num_slots=1, max_len=24,
                         default_deadline_s=0.02)
