@@ -362,6 +362,83 @@ def host_ps_wire_bytes_bench():
             "host_ps_commit_compression_ratio": ratios}
 
 
+def host_ps_embedding_commit_bytes_bench():
+    """Encoded commit bytes for an embedding-heavy window under the dense
+    wire vs the EXACT row-sparse profile (``row_sparse=`` —
+    ``networking.RowSparseDelta``; docs/host_ps.md "Streaming + row-sparse
+    embeddings").  A recommender-scale delta — a (20000, 32) embedding
+    table of which one window touched 1% of rows, plus a small dense head
+    — is pushed through the exact encoder the workers use and the full
+    frame length counted.  Pure CPU, deterministic, sub-second.
+
+    Returns ``{"host_ps_embedding_commit_bytes_per_window":
+    {"dense": bytes, "row_sparse": bytes, "touched_rows": k,
+    "table_rows": V, "compression_ratio": dense/row_sparse}}``.
+    """
+    import numpy as np
+
+    from distkeras_tpu import networking
+
+    rng = np.random.default_rng(0)
+    vocab, dim = 20000, 32
+    touched = np.sort(rng.choice(vocab, size=vocab // 100,
+                                 replace=False)).astype(np.int32)
+    table_delta = np.zeros((vocab, dim), np.float32)
+    table_delta[touched] = 0.01 * rng.standard_normal(
+        (len(touched), dim)).astype(np.float32)
+    head = [0.01 * rng.standard_normal((dim, 4)).astype(np.float32),
+            0.01 * rng.standard_normal((4,)).astype(np.float32)]
+    base = {"worker_id": 0, "clock": 0}
+    dense = len(networking.encode_message(
+        {"delta": [table_delta] + head, **base}))
+    sparse = len(networking.encode_message(
+        {"delta": [networking.RowSparseDelta(
+            touched, table_delta[touched], vocab)] + head, **base}))
+    return {"host_ps_embedding_commit_bytes_per_window": {
+        "dense": dense, "row_sparse": sparse,
+        "touched_rows": int(len(touched)), "table_rows": vocab,
+        "compression_ratio": round(dense / sparse, 2)}}
+
+
+def host_ps_stream_bench(budget_s: float = 90.0):
+    """Streaming-ingestion throughput: a small online DOWNPOUR run over a
+    generator-backed ``StreamSource`` (deterministic seeds) — rows
+    ingested and trained per second through the horizon-leased PS fabric
+    with row-sparse embedding commits.  Returns
+    ``{"host_ps_stream_examples_per_sec": float|None}`` — None on
+    overrun/failure, never fatal to the north-star artifact.
+    """
+    import numpy as np
+
+    from distkeras_tpu import DOWNPOUR, Sequential
+    from distkeras_tpu.core.layers import Dense, Embedding, Flatten
+    from distkeras_tpu.streaming import StreamSource
+
+    vocab, dim, classes = 2048, 16, 4
+    rng = np.random.default_rng(0)
+    mapping = rng.integers(0, classes, vocab)
+
+    def gen():
+        for _ in range(32):
+            items = rng.integers(0, vocab, 256).astype(
+                np.int32).reshape(-1, 1)
+            yield items, np.eye(classes, dtype=np.float32)[
+                mapping[items[:, 0]]]
+
+    model = Sequential([Embedding(vocab, dim), Flatten(),
+                        Dense(classes, activation="softmax")],
+                       input_shape=(1,), compute_dtype="float32")
+    t = DOWNPOUR(model, num_workers=1, parallelism_factor=2, batch_size=32,
+                 num_epoch=1, communication_window=4, learning_rate=0.5,
+                 execution="host_ps", stream=True, row_sparse=True)
+    t0 = time.perf_counter()
+    t.train(StreamSource(generator=gen()))
+    if time.perf_counter() - t0 > budget_s:
+        return {"host_ps_stream_examples_per_sec": None}
+    return {"host_ps_stream_examples_per_sec":
+            t.stream_stats.get("examples_per_sec")}
+
+
 def host_ps_recovery_bench(budget_s: float = 60.0):
     """Client-observed shard recovery latency: a 2-shard group under a
     ``ShardSupervisor``; one shard is crash-killed and the measured number
@@ -793,6 +870,29 @@ def main():
         print(f"[bench] host_ps wire bytes bench failed: {e}",
               file=sys.stderr)
     result.update(wire_fields)
+    # row-sparse embedding commit bytes (the exact sparse profile):
+    # deterministic and sub-second, so no budget gate — the byte win is
+    # tracked in every BENCH_* artifact next to the flat top-k one
+    stage("host_ps embedding commit bytes")
+    emb_fields = {"host_ps_embedding_commit_bytes_per_window": None}
+    try:
+        emb_fields = host_ps_embedding_commit_bytes_bench()
+    except Exception as e:
+        print(f"[bench] host_ps embedding commit bytes bench failed: {e}",
+              file=sys.stderr)
+    result.update(emb_fields)
+    # streaming-ingestion throughput (streaming.py): a generator-backed
+    # online run through the horizon-leased PS fabric
+    stage("host_ps stream")
+    stream_fields = {"host_ps_stream_examples_per_sec": None}
+    stream_remaining = budget - (time.perf_counter() - t_start)
+    if stream_remaining > 60:
+        try:
+            stream_fields = host_ps_stream_bench(budget_s=stream_remaining)
+        except Exception as e:
+            print(f"[bench] host_ps stream bench failed: {e}",
+                  file=sys.stderr)
+    result.update(stream_fields)
     # PS recovery latency (resilience.py): kill one shard under the
     # supervisor, measure client-observed time back to a successful pull
     stage("host_ps recovery")

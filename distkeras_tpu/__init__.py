@@ -28,6 +28,8 @@ from .evaluators import (Evaluator, AccuracyEvaluator, AUCEvaluator,
                          F1Evaluator, LossEvaluator, TopKAccuracyEvaluator)
 from . import utils
 from . import networking
+from . import streaming
+from .streaming import StreamBuffer, StreamSource
 from . import workers
 from . import ps_sharding
 from . import parameter_servers

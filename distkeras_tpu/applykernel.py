@@ -76,3 +76,15 @@ def scatter_add(kernel, dst: np.ndarray, idx: np.ndarray,
                                np.ascontiguousarray(vals, np.float32))
     else:
         np.add.at(dst, idx, vals)
+
+
+def row_scatter_add(kernel, dst2d: np.ndarray, rows: np.ndarray,
+                    vals2d: np.ndarray, scale: float = 1.0) -> None:
+    """``dst2d[rows[i]] += scale * vals2d[i]`` row by row, in array order —
+    the row-sparse embedding apply (``networking.RowSparseDelta``).  Each
+    touched row is one contiguous ``axpy``, so the native and NumPy paths
+    share per-row arithmetic and stay bit-identical; duplicated rows (never
+    emitted by the wire contract, tolerated for direct callers) accumulate
+    sequentially, the ``np.add.at`` semantics."""
+    for i, r in enumerate(rows):
+        axpy(kernel, dst2d[int(r)], vals2d[i], scale)

@@ -56,6 +56,19 @@ except ImportError:  # pragma: no cover - depends on build environment
 # structure encoding
 # ---------------------------------------------------------------------------
 
+class ProtocolError(ValueError):
+    """A frame that decodes structurally but violates the wire CONTRACT —
+    duplicate/negative/out-of-range sparse indices, mis-shaped row blocks.
+
+    Distinct from the codec's own ``ValueError``s (bad magic, truncated
+    buffers) only in type: both mean the peer is corrupt or hostile, and
+    every server handler already drops the connection on ``ValueError``.
+    The typed subclass exists so the PS can validate a sparse commit at the
+    transport boundary and reject it *before* any scatter-add could write
+    through a bad index into the center (or a neighbouring tensor).
+    """
+
+
 class SparseDelta:
     """A k-sparse view of a flat float32 vector of dense length ``length``.
 
@@ -111,6 +124,126 @@ class SparseDelta:
         np.add.at(out, self.indices.astype(np.int64), self.f32_values())
         return out
 
+    def validate(self) -> "SparseDelta":
+        """Enforce the wire contract on a DECODED commit: integer indices,
+        sorted strictly ascending (unique), all within ``[0, length)``.
+        Raises ``ProtocolError`` — the PS calls this at the transport
+        boundary so a corrupt or hostile frame is rejected (connection
+        dropped) instead of scatter-adding through a bad index into the
+        center.  Every legitimate encoder (device/host top-k selection,
+        the shard splitter) emits sorted unique indices, so this is a
+        pure guard, not a normalization."""
+        idx = self.indices
+        if not np.issubdtype(idx.dtype, np.integer):
+            raise ProtocolError(
+                f"sparse commit indices must be integers, got {idx.dtype}")
+        if idx.size:
+            d = np.diff(idx.astype(np.int64, copy=False))
+            if np.any(d < 0):
+                raise ProtocolError("sparse commit indices are unsorted")
+            if np.any(d == 0):
+                raise ProtocolError("sparse commit carries duplicate indices")
+            if int(idx[0]) < 0 or int(idx[-1]) >= self.length:
+                raise ProtocolError(
+                    f"sparse commit index out of range for dense length "
+                    f"{self.length}")
+        return self
+
+
+class RowSparseDelta:
+    """A row-sparse view of ONE tensor with ``num_rows`` leading rows.
+
+    The wire form of an embedding-table commit (``row_sparse=`` on the
+    async PS trainers): ``rows`` (int32, sorted ascending, unique) name the
+    touched leading-axis rows and ``values`` is the ``(k,) + row_shape``
+    block of their deltas.  Unlike the flat top-k ``SparseDelta`` this
+    profile is **exact, not lossy**: the untouched rows of an embedding
+    delta are exactly zero (only gathered rows move), so shipping the
+    touched rows ships the whole delta — no selection, no error-feedback
+    residual.  A commit costs O(k·dim) bytes and O(k·dim) apply work
+    instead of O(V·dim).
+
+    On the wire this is a dedicated payload node (two tensor buffers +
+    the dense row count in the header), carried unchanged by both the
+    native and the pure-Python codec — the codecs frame buffers, the tree
+    layer interprets them.  The PS applies it with a per-row scatter-add
+    (``parameter_servers._row_scatter_add``); shard splits are by row
+    range (``slice_rows``).
+    """
+
+    __slots__ = ("rows", "values", "num_rows")
+
+    def __init__(self, rows, values, num_rows: int):
+        self.rows = np.asarray(rows)
+        self.values = np.asarray(values)
+        self.num_rows = int(num_rows)
+        if self.rows.ndim != 1:
+            raise ValueError("RowSparseDelta rows must be 1-D")
+        if self.values.ndim < 2:
+            raise ValueError(
+                "RowSparseDelta values must be a (k, ...) row block")
+        if self.values.shape[0] != self.rows.size:
+            raise ValueError(
+                f"RowSparseDelta carries {self.rows.size} rows but "
+                f"{self.values.shape[0]} value rows")
+
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.size)
+
+    @property
+    def row_shape(self) -> tuple:
+        return tuple(self.values.shape[1:])
+
+    def f32_values(self) -> np.ndarray:
+        return self.values.astype(np.float32, copy=False)
+
+    def decoded(self) -> "RowSparseDelta":
+        """A defensively-copied f32 twin (safe across pooled receives)."""
+        return RowSparseDelta(
+            np.array(self.rows, np.int32, copy=True),
+            np.array(self.f32_values(), np.float32, copy=True),
+            self.num_rows)
+
+    def to_dense(self) -> np.ndarray:
+        """The dense ``(num_rows,) + row_shape`` f32 delta (tests)."""
+        out = np.zeros((self.num_rows,) + self.row_shape, np.float32)
+        np.add.at(out, self.rows.astype(np.int64), self.f32_values())
+        return out
+
+    def validate(self) -> "RowSparseDelta":
+        """The wire contract (see ``SparseDelta.validate``): integer rows,
+        sorted strictly ascending, within ``[0, num_rows)``.  Raises
+        ``ProtocolError`` so the PS rejects the frame at the transport
+        boundary instead of writing through a bad row index."""
+        rows = self.rows
+        if not np.issubdtype(rows.dtype, np.integer):
+            raise ProtocolError(
+                f"row-sparse commit rows must be integers, got {rows.dtype}")
+        if rows.size:
+            d = np.diff(rows.astype(np.int64, copy=False))
+            if np.any(d < 0):
+                raise ProtocolError("row-sparse commit rows are unsorted")
+            if np.any(d == 0):
+                raise ProtocolError(
+                    "row-sparse commit carries duplicate rows")
+            if int(rows[0]) < 0 or int(rows[-1]) >= self.num_rows:
+                raise ProtocolError(
+                    f"row-sparse commit row out of range for {self.num_rows} "
+                    "rows")
+        return self
+
+    def slice_rows(self, start: int, stop: int) -> "RowSparseDelta":
+        """The sub-commit owned by leading-axis range ``[start, stop)`` in
+        that range's LOCAL row coordinates (the shard splitter — rows are
+        sorted, so one bisection selects the run)."""
+        rows64 = self.rows.astype(np.int64, copy=False)
+        lo = int(np.searchsorted(rows64, start, side="left"))
+        hi = int(np.searchsorted(rows64, stop, side="left"))
+        return RowSparseDelta(
+            (rows64[lo:hi] - start).astype(self.rows.dtype, copy=False),
+            self.values[lo:hi], stop - start)
+
 
 def _dtype_str(dt: np.dtype) -> str:
     """Wire name for a dtype.  ml_dtypes types (bfloat16 & friends) print as
@@ -135,6 +268,11 @@ def _encode_node(obj: Any, buffers: List[np.ndarray]):
         if obj.scale is not None:
             node["s"] = float(obj.scale)
         return {"__sp__": node}
+    if isinstance(obj, RowSparseDelta):
+        return {"__rsp__": {
+            "r": _encode_node(np.ascontiguousarray(obj.rows), buffers),
+            "v": _encode_node(np.ascontiguousarray(obj.values), buffers),
+            "n": int(obj.num_rows)}}
     if isinstance(obj, np.ndarray):
         idx = len(buffers)
         buffers.append(np.ascontiguousarray(obj))
@@ -170,6 +308,11 @@ def _decode_node(node: Any, buffers: List[bytes], copy: bool = True):
             return SparseDelta(_decode_node(sp["i"], buffers, copy),
                                _decode_node(sp["v"], buffers, copy),
                                int(sp["n"]), sp.get("s"))
+        if "__rsp__" in node:
+            rsp = node["__rsp__"]
+            return RowSparseDelta(_decode_node(rsp["r"], buffers, copy),
+                                  _decode_node(rsp["v"], buffers, copy),
+                                  int(rsp["n"]))
         if "__dict__" in node:
             return {k: _decode_node(v, buffers, copy)
                     for k, v in node["__dict__"].items()}
@@ -241,6 +384,9 @@ def _expected_buffer_sizes(tree: Any, out: dict):
         elif "__sp__" in tree:
             _expected_buffer_sizes(tree["__sp__"]["i"], out)
             _expected_buffer_sizes(tree["__sp__"]["v"], out)
+        elif "__rsp__" in tree:
+            _expected_buffer_sizes(tree["__rsp__"]["r"], out)
+            _expected_buffer_sizes(tree["__rsp__"]["v"], out)
         elif "__dict__" in tree:
             for v in tree["__dict__"].values():
                 _expected_buffer_sizes(v, out)

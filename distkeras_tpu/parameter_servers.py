@@ -59,12 +59,6 @@ from .workers import WORKER_CLASSES, share_compiled_state
 logger = logging.getLogger("distkeras_tpu.parameter_servers")
 
 
-def _as_f32(delta):
-    """Upcast wire deltas (possibly bf16-compressed by the worker's
-    ``wire_dtype`` — see ``workers.PSWorker.commit``) to the center's f32."""
-    return [np.asarray(d).astype(np.float32, copy=False) for d in delta]
-
-
 def _flat_offsets(center: List[np.ndarray]):
     """(per-tensor flat offsets, total elements) of the concatenated list."""
     sizes = np.array([int(c.size) for c in center], np.int64)
@@ -113,6 +107,39 @@ def _scatter_flat(center: List[np.ndarray], offsets: np.ndarray,
                                 vals[lo:hi])
 
 
+def _row_scatter_add(tensor: np.ndarray, rsp: "networking.RowSparseDelta",
+                     scale: float = 1.0, kernel=None) -> None:
+    """Apply a row-sparse delta to ONE tensor: O(k·dim) per-row scatter-add.
+
+    ``rsp`` names touched leading-axis rows of ``tensor``; shapes and row
+    range are validated so a hostile or mis-split commit raises instead of
+    writing into neighbouring rows.  ``kernel`` routes the per-row axpy
+    through the native apply kernel — bit-identical results.
+    """
+    if tensor.ndim < 2:
+        raise ValueError(
+            f"row-sparse commit targets a {tensor.ndim}-D tensor; row "
+            "sparsity needs a leading row axis")
+    if rsp.num_rows != tensor.shape[0]:
+        raise ValueError(
+            f"row-sparse commit declares {rsp.num_rows} rows, tensor has "
+            f"{tensor.shape[0]}")
+    if rsp.row_shape != tuple(tensor.shape[1:]):
+        raise ValueError(
+            f"row-sparse commit rows are shaped {rsp.row_shape}, tensor "
+            f"rows are {tuple(tensor.shape[1:])}")
+    rows = rsp.rows.astype(np.int64, copy=False)
+    if rows.size == 0:
+        return
+    if int(rows.min()) < 0 or int(rows.max()) >= rsp.num_rows:
+        raise ValueError(
+            f"row-sparse commit row out of range for {rsp.num_rows} rows")
+    vals = np.ascontiguousarray(rsp.f32_values())
+    applykernel.row_scatter_add(
+        kernel, tensor.reshape(tensor.shape[0], -1), rows,
+        vals.reshape(vals.shape[0], -1), scale)
+
+
 def _scatter_add(center: List[np.ndarray], sp: "networking.SparseDelta",
                  scale: float = 1.0, kernel=None) -> None:
     """Apply a k-sparse flat delta to a tensor list: O(k) scatter-add.
@@ -129,6 +156,33 @@ def _scatter_add(center: List[np.ndarray], sp: "networking.SparseDelta",
     if idx.size == 0:
         return
     _scatter_flat(center, offsets, idx, vals, kernel)
+
+
+def _decode_commit_msg(msg):
+    """Transport-boundary decompression + wire-contract validation, shared
+    by BOTH server cores: int8 codes × per-tensor scales → f32 deltas;
+    sparse top-k and row-sparse nodes VALIDATED (sorted unique in-range
+    indices — ``networking.ProtocolError`` on violation, which the caller
+    treats exactly like a torn frame: drop the connection, center
+    untouched) and dequantized/detached to f32 copies, so every PS rule
+    sees ordinary floats that outlive the receive buffer."""
+    if not isinstance(msg, dict):
+        return msg
+    if "scales" in msg:
+        msg["delta"] = [
+            np.asarray(q, np.float32) * s
+            for q, s in zip(msg["delta"], msg.pop("scales"))]
+        return msg
+    delta = msg.get("delta")
+    if isinstance(delta, networking.SparseDelta):
+        msg["delta"] = delta.validate().decoded()
+    elif isinstance(delta, list) and any(
+            isinstance(d, networking.RowSparseDelta) for d in delta):
+        msg["delta"] = [
+            d.validate().decoded()
+            if isinstance(d, networking.RowSparseDelta) else d
+            for d in delta]
+    return msg
 
 
 class ParameterServer:
@@ -191,9 +245,19 @@ class ParameterServer:
         if isinstance(delta, networking.SparseDelta):
             _scatter_add(self.center, delta, scale, self._kernel)
         else:
-            for c, d in zip(self.center, _as_f32(delta)):
-                applykernel.axpy(self._kernel, c.reshape(-1),
-                                 d.reshape(-1), scale)
+            # a delta LIST may mix dense tensors with row-sparse embedding
+            # blocks (``row_sparse=`` commits): dense entries apply as one
+            # axpy each, row-sparse entries as an O(k·dim) row scatter-add
+            # — same scalar ``scale``, so every rule composes unchanged
+            for c, d in zip(self.center, delta):
+                if isinstance(d, networking.RowSparseDelta):
+                    _row_scatter_add(c, d, scale, self._kernel)
+                else:
+                    applykernel.axpy(
+                        self._kernel, c.reshape(-1),
+                        np.asarray(d).astype(np.float32,
+                                             copy=False).reshape(-1),
+                        scale)
         self.next_update()
 
     # -- coalesced drains (the event-driven core's batch apply) --------------
@@ -532,25 +596,16 @@ class ThreadedSocketParameterServer:
                     networking.send_data(conn, reply, pool=send_pool)
                 elif op in (b"c", b"u"):
                     try:
-                        msg = networking.recv_data(conn)
+                        # decode + the shared transport-boundary pass
+                        # (_decode_commit_msg): int8 dequantization, sparse
+                        # top-k / row-sparse validation (ProtocolError ⊂
+                        # ValueError) — a contract-violating commit drops
+                        # the connection exactly like a torn frame, before
+                        # any apply could corrupt the center
+                        msg = _decode_commit_msg(
+                            networking.recv_data(conn))
                     except ValueError:
-                        return  # torn/corrupt frame: drop the connection
-                    if isinstance(msg, dict) and "scales" in msg:
-                        # int8 wire compression (workers.PSWorker.commit):
-                        # codes x per-tensor scale -> f32 delta, decoded at
-                        # the transport boundary so every PS rule sees
-                        # ordinary float deltas
-                        msg["delta"] = [
-                            np.asarray(q, np.float32) * s
-                            for q, s in zip(msg["delta"], msg.pop("scales"))]
-                    elif (isinstance(msg, dict) and
-                          isinstance(msg.get("delta"),
-                                     networking.SparseDelta)):
-                        # sparse top-k commit: dequantize the (possibly
-                        # bf16/int8-coded) values to f32 at the same
-                        # transport boundary — apply rules see f32 values
-                        # and scatter-add in O(k)
-                        msg["delta"] = msg["delta"].decoded()
+                        return  # torn/corrupt/hostile frame: drop it
                     # generation handshake: a commit stamped with an older
                     # generation was computed against a center a restart
                     # rolled back — drop it (bounded loss, same class as
@@ -989,17 +1044,12 @@ class SocketParameterServer:
 
     @staticmethod
     def _decode_commit(msg):
-        """Transport-boundary decompression, identical to the threaded
-        core: int8 codes × per-tensor scales → f32 deltas; sparse top-k
-        values dequantized to f32 — every PS rule sees ordinary floats."""
-        if isinstance(msg, dict) and "scales" in msg:
-            msg["delta"] = [
-                np.asarray(q, np.float32) * s
-                for q, s in zip(msg["delta"], msg.pop("scales"))]
-        elif (isinstance(msg, dict)
-              and isinstance(msg.get("delta"), networking.SparseDelta)):
-            msg["delta"] = msg["delta"].decoded()
-        return msg
+        """Transport-boundary decompression + validation, identical to the
+        threaded core (``_decode_commit_msg``): int8 dequantization, sparse
+        top-k / row-sparse index validation — a ``ProtocolError`` propagates
+        as ``ValueError`` to ``_read_ready``'s handler, which drops the
+        connection exactly as on a torn frame."""
+        return _decode_commit_msg(msg)
 
     # -- drain processing ----------------------------------------------------
     def _process_drain(self, entries: List[tuple]):
@@ -1337,6 +1387,14 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     kw.update(worker_optimizer=trainer.worker_optimizer,
               ps_host="127.0.0.1",
               ps_port=(server.ports[0] if sharded else server.port))
+    rs = getattr(trainer, "row_sparse", None)
+    if rs:
+        # row-sparse embedding commits (streaming.py): resolve the knob
+        # (True = every Embedding table in the model spec, or explicit
+        # weight indices) against this run's params template
+        from .streaming import resolve_row_sparse_tables
+        kw.update(row_sparse_tables=resolve_row_sparse_tables(
+            rs, trainer.master_model, params))
     if sharded:
         # workers scatter-commit / gather-pull through a ShardedPSClient
         # (one socket + one receive-buffer pool per shard).  _shard_addr_hook

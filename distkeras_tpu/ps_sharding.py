@@ -536,10 +536,21 @@ class ShardedPSClient:
                 out.append(m)
             return out
         scales = msg.get("scales")
+
+        def take_piece(s: ShardSlice):
+            d = deltas[s.tensor]
+            if isinstance(d, networking.RowSparseDelta):
+                # row-sparse embedding block: each shard gets the touched
+                # rows its leading-axis range owns, re-indexed into the
+                # slice's LOCAL row coordinates — the row twin of
+                # split_sparse's flat-index bisection (rows are sorted, so
+                # slicing preserves the wire contract per shard)
+                return d.slice_rows(s.start, s.stop)
+            return self.plan.take(d, s)
+
         for j, pieces in enumerate(self.plan.assignments):
             m = {
-                "delta": [self.plan.take(deltas[s.tensor], s)
-                          for s in pieces],
+                "delta": [take_piece(s) for s in pieces],
                 "worker_id": msg.get("worker_id"),
                 "clock": self._clocks[j]}
             if self._gens[j] is not None:

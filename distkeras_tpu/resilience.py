@@ -666,6 +666,7 @@ class LeaseLedger:
                                  else float(default_window_s))
         self._clock = clock
         self._lock = threading.Lock()
+        self._next_rows: Optional[int] = None  # resize(), applied at begin
         self.epoch: Optional[int] = None
         self.leases: List[Lease] = []
         self._state: Dict[int, Dict[str, Any]] = {}
@@ -680,9 +681,21 @@ class LeaseLedger:
         self.windows_by_worker: Dict[int, int] = {}
 
     # -- epoch lifecycle -----------------------------------------------------
+    def resize(self, num_rows: int) -> None:
+        """Set the row count the NEXT ``begin_epoch`` tiles (the streaming
+        horizon loop: each horizon re-leases however many rows the stream
+        delivered — the tail horizon is smaller, nothing else changes).
+        Takes effect at the next ``begin_epoch``; the running epoch's
+        leases and its ``assert_epoch_complete`` target are untouched."""
+        with self._lock:
+            self._next_rows = int(num_rows)
+
     def begin_epoch(self, epoch: int) -> List[Lease]:
         """(Re)tile the row range into pending leases for ``epoch``."""
         with self._lock:
+            if self._next_rows is not None:
+                self.num_rows = self._next_rows
+                self._next_rows = None
             self.epoch = int(epoch)
             rows_per_lease = self.rows_per_window * self.lease_windows
             self.leases = []

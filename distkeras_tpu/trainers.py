@@ -419,6 +419,16 @@ class DistributedTrainer(Trainer):
                     "engine only — the PS workers don't thread segment "
                     "ids; use execution='spmd'")
             _require_masked_loss(self.loss)
+        if getattr(self, "stream", False):
+            # streaming online learning: dataset is a StreamSource; the
+            # horizon loop owns shuffling (per-horizon, deterministic) and
+            # there are no epoch waves to resume between
+            if resume:
+                raise ValueError(
+                    "resume does not apply to stream=True (no epoch waves; "
+                    "the PS center is the live state)")
+            from .streaming import run_stream_training
+            return run_stream_training(self, dataset)
         if self.execution == "host_ps":
             from .parameter_servers import run_host_ps_training
             return run_host_ps_training(self, dataset, shuffle, resume=resume)
@@ -686,6 +696,10 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                  lease_timeout: float = 5.0,
                  ps_core: str = "event", coalesce: bool = True,
                  apply_kernel: Optional[str] = None,
+                 stream: bool = False,
+                 horizon_windows: Optional[int] = None,
+                 max_horizons: Optional[int] = None,
+                 row_sparse=None,
                  **kw):
         super().__init__(keras_model, **kw)
         self.parallelism_factor = int(parallelism_factor)
@@ -754,12 +768,71 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                 "ps_core/coalesce/apply_kernel apply to the PS server "
                 "(execution='host_ps'/'process_ps'); the SPMD engine has "
                 "no socket server to configure")
+        # streaming online learning (streaming.py): stream=True trains
+        # from an unbounded streaming.StreamSource passed to train() — a
+        # HORIZON loop re-leases horizon_windows communication windows at
+        # a time through the elastic lease machinery (exactly-once
+        # completion per horizon; elastic membership and straggler steal
+        # carry over verbatim).  max_horizons bounds an unbounded source;
+        # on_horizon(h, model) observes the live center per horizon.
+        self.stream = bool(stream)
+        if self.stream and self.execution != "host_ps":
+            raise ValueError(
+                "stream=True requires execution='host_ps' (the horizon "
+                "loop drives the live socket PS; the SPMD engine shapes "
+                "finite epochs, and process_ps ships finite shards)")
+        self.horizon_windows = (None if horizon_windows is None
+                                else int(horizon_windows))
+        if self.horizon_windows is not None and self.horizon_windows < 1:
+            raise ValueError("horizon_windows must be >= 1")
+        if self.horizon_windows is not None and not self.stream:
+            raise ValueError("horizon_windows applies to stream=True")
+        self.max_horizons = (None if max_horizons is None
+                             else int(max_horizons))
+        if self.max_horizons is not None and self.max_horizons < 1:
+            raise ValueError("max_horizons must be >= 1")
+        if self.max_horizons is not None and not self.stream:
+            raise ValueError("max_horizons applies to stream=True")
+        self.on_horizon = None
+        # row-sparse embedding commits (streaming.py / workers.py): True
+        # auto-detects every Embedding table from the model spec, or pass
+        # explicit weight-list indices.  Each table's window delta ships
+        # as an EXACT networking.RowSparseDelta (touched rows only) in
+        # the same 1-RTT 'u' window as the dense rest — commit bytes
+        # scale with rows touched, not table size.  Delta family only;
+        # exact, so it does not compose with the lossy wire codings.
+        self.row_sparse = row_sparse if row_sparse else None
+        if self.row_sparse is not None:
+            if self.execution != "host_ps":
+                raise ValueError(
+                    "row_sparse requires execution='host_ps' (the SPMD "
+                    "engine exchanges deltas over ICI; process_ps ships "
+                    "config as JSON and keeps dense commits)")
+            if self.ALGORITHM not in ("downpour", "adag", "dynsgd"):
+                raise ValueError(
+                    "row_sparse applies to the delta family "
+                    "(DOWNPOUR/ADAG/DynSGD); the elastic family's force "
+                    "term is dense by construction")
+            if self.wire_dtype is not None:
+                raise ValueError(
+                    "row_sparse is the exact sparse profile and does not "
+                    "compose with lossy wire_dtype codings — use "
+                    "wire_dtype=None")
+        #: per-run streaming observability: horizons, rows ingested,
+        #: examples/sec, buffer counters (run_stream_training)
+        self.stream_stats: dict = {}
         #: elastic-run observability (resilience events): respawns, lease
         #: reassignments, per-worker windows, per-epoch exactly-once reports
         self.elastic_stats: dict = {}
 
     @property
     def comm_overlap(self) -> bool:
+        if getattr(self, "row_sparse", None) is not None:
+            # the row-sparse window step is itself ONE blocking 'u' round
+            # trip (commit + fresh center, atomically) — the double-
+            # buffered overlap loop has nothing to hide and doesn't carry
+            # the mixed-delta rebase, so row_sparse pins the serial loop
+            return False
         if self._comm_overlap is not None:
             return bool(self._comm_overlap)
         return self.ALGORITHM in self._OVERLAP_DEFAULT_ON

@@ -291,7 +291,8 @@ class PSWorker(Worker):
                  fault_injection: Optional[dict] = None,
                  shard_plan=None, shard_addrs=None,
                  recovery: bool = False,
-                 retry_policy: Optional[RetryPolicy] = None, **kw):
+                 retry_policy: Optional[RetryPolicy] = None,
+                 row_sparse_tables=None, **kw):
         super().__init__(model_blob, worker_optimizer, loss, **kw)
         self.ps_host = ps_host
         self.ps_port = ps_port
@@ -346,6 +347,48 @@ class PSWorker(Worker):
         self.wire_dtype = (networking._dtype_of(wire_dtype)
                            if wire_dtype is not None and not self._quantize
                            else None)
+        # row-sparse embedding commits (row_sparse= on the async trainers —
+        # streaming.py resolves the knob to weight-list indices): each
+        # listed table's window delta ships as an EXACT
+        # networking.RowSparseDelta (touched rows only — support detected
+        # on device from the delta itself, so it is exact for any
+        # optimizer), alongside dense deltas for the rest of the model in
+        # the SAME 1-RTT 'u' window.  Delta family only (the elastic
+        # force is dense by construction), incompatible with the lossy
+        # wire codings (exact is the point) and with comm_overlap (the
+        # row-sparse step is itself one blocking 'u' round trip).
+        self.row_sparse_tables: Tuple[int, ...] = ()
+        self._rs_shapes: Dict[int, tuple] = {}
+        self._rs_window_fn = None
+        if row_sparse_tables:
+            tables = sorted({int(t) for t in row_sparse_tables})
+            if not self._ROW_SPARSE_OK:
+                raise ValueError(
+                    "row_sparse_tables applies to the delta family "
+                    "(DOWNPOUR/ADAG/DynSGD); the elastic family's force "
+                    f"term is dense by construction ({type(self).__name__})")
+            if (self._topk_density is not None or self._quantize
+                    or self.wire_dtype is not None):
+                raise ValueError(
+                    "row_sparse_tables is the exact sparse profile and does "
+                    "not compose with lossy wire_dtype codings "
+                    "(bfloat16/int8/topk) — use wire_dtype=None")
+            if self.comm_overlap:
+                raise ValueError(
+                    "row_sparse_tables uses the serial 1-RTT 'u' window "
+                    "loop; comm_overlap must be off")
+            shapes = [tuple(np.shape(w)) for w in self.model_blob["weights"]]
+            for t in tables:
+                if not 0 <= t < len(shapes):
+                    raise ValueError(
+                        f"row_sparse_tables names weight {t}; model has "
+                        f"{len(shapes)} weights")
+                if len(shapes[t]) < 2:
+                    raise ValueError(
+                        f"row_sparse_tables weight {t} is {shapes[t]} — row "
+                        "sparsity needs a (rows, dim...) table")
+            self.row_sparse_tables = tuple(tables)
+            self._rs_shapes = {t: shapes[t] for t in tables}
         self._residual: Optional[List[np.ndarray]] = None
         # top-k error-feedback state: exactly one of the two residuals is
         # live per worker — the DEVICE flat residual (delta family: selection
@@ -536,6 +579,96 @@ class PSWorker(Worker):
     #: them; the elastic workers sparsify WITHOUT a residual instead (the
     #: spring stays stretched until its components are selected).
     _TOPK_EF = True
+    #: row-sparse embedding commits need the window delta itself to be the
+    #: committed quantity (delta family); the elastic force is dense
+    _ROW_SPARSE_OK = False
+
+    # -- row-sparse embedding commits (row_sparse_tables) --------------------
+    def _build_rowsparse_window_fn(self):
+        """Row-sparse variant of the window fn: runs the same window scan,
+        then computes each listed table's full window delta and its
+        touched-row mask ON DEVICE (``any(delta != 0)`` per row).  Support
+        detection from the delta itself makes the profile EXACT for any
+        optimizer — untouched rows are exactly zero by inspection, not by
+        assumption about the update rule — and only the mask (num_rows
+        bools per table) plus the touched rows' O(k·dim) delta block ever
+        reach the host; the full table is never fetched.
+
+        jitted (params, opt_state, xw, yw, mw, rng) -> (params, opt_state,
+        loss, [table deltas], [row masks]); donates params/opt_state as
+        the plain window fn.
+        """
+        if self._rs_window_fn is not None:
+            return self._rs_window_fn
+        tables = self.row_sparse_tables
+        window = self._make_window_body()
+
+        def rs_window(params, opt_state, xw, yw, mw, rng):
+            leaves = jax.tree_util.tree_leaves(params)
+            bases = [leaves[t] for t in tables]
+            params, opt_state, loss = window(params, opt_state, xw, yw, mw,
+                                             rng)
+            new_leaves = jax.tree_util.tree_leaves(params)
+            deltas = [new_leaves[t].astype(jnp.float32)
+                      - b.astype(jnp.float32)
+                      for t, b in zip(tables, bases)]
+            masks = [jnp.any(d != 0.0, axis=tuple(range(1, d.ndim)))
+                     for d in deltas]
+            return params, opt_state, loss, deltas, masks
+
+        self._rs_window_fn = jax.jit(rs_window, donate_argnums=(0, 1))
+        return self._rs_window_fn
+
+    def _fetch_dense_weights(self, params) -> List[Optional[np.ndarray]]:
+        """ONE bulk device→host fetch of every NON-table leaf: a list in
+        weight order with None at table positions — the big embedding
+        tables never ride the per-window fetch."""
+        skip = set(self.row_sparse_tables)
+        leaves = jax.tree_util.tree_leaves(params)
+        fetched = iter(jax.device_get(
+            [l for i, l in enumerate(leaves) if i not in skip]))
+        return [None if i in skip else next(fetched)
+                for i in range(len(leaves))]
+
+    def _rowsparse_window_step(self, params, opt_state, xw, yw, mw, rng,
+                               index: int):
+        """One serial window under row-sparse commits: dense non-table
+        deltas + exact row-sparse table deltas, committed in ONE combined
+        'u' round trip whose reply (the fresh center) re-bases the next
+        window — the serial loop's commit + re-pull, atomically."""
+        fn = self._build_rowsparse_window_fn()
+        skip = set(self.row_sparse_tables)
+        before = self._fetch_dense_weights(params)
+        params, opt_state, loss, rs_deltas, rs_masks = fn(
+            params, opt_state, jnp.asarray(xw), jnp.asarray(yw),
+            jnp.asarray(mw), rng)
+        # one bulk fetch for the dense after-weights AND the per-table row
+        # masks; the touched rows' values follow as one O(k·dim) gather
+        # per table
+        leaves = jax.tree_util.tree_leaves(params)
+        dense_after, masks = jax.device_get(
+            ([l for i, l in enumerate(leaves) if i not in skip], rs_masks))
+        after = iter(dense_after)
+        delta: List[Any] = []
+        ti = 0
+        for i in range(len(leaves)):
+            if i in skip:
+                rows = np.flatnonzero(masks[ti]).astype(np.int32)
+                if rows.size:
+                    vals = np.asarray(
+                        jax.device_get(rs_deltas[ti][jnp.asarray(rows)]),
+                        np.float32)
+                else:
+                    vals = np.zeros((0,) + self._rs_shapes[i][1:],
+                                    np.float32)
+                delta.append(networking.RowSparseDelta(
+                    rows, vals, self._rs_shapes[i][0]))
+                ti += 1
+            else:
+                delta.append(np.asarray(next(after), np.float32)
+                             - before[i])
+        _applied, center = self.update(delta, index)
+        return self._weights_to_params(center), opt_state, loss
 
     def _ensure_topk(self) -> int:
         """Resolve k and the flat layout (density · total elements, at
@@ -779,7 +912,10 @@ class PSWorker(Worker):
             # rejects this commit instead of applying it to the restored
             # center (the rolled-back windows are the bounded loss)
             msg["gen"] = self._gen
-        return (msg, [np.asarray(d, dtype=np.float32) for d in delta])
+        # row-sparse entries ARE their as-applied form (the profile is
+        # exact); dense entries normalize to f32
+        return (msg, [d if isinstance(d, networking.RowSparseDelta)
+                      else np.asarray(d, dtype=np.float32) for d in delta])
 
     def commit(self, delta: List[np.ndarray], worker_id: int):
         """'c': push a weight-shaped delta (reference: Worker.commit).
@@ -1025,6 +1161,9 @@ class PSWorker(Worker):
             fn = self._build_topk_window_fn()
             residual = jnp.zeros((self._wire_total,), jnp.float32)
             out = fn(params, opt_state, residual, xw, yw, mw, rng)
+        elif self.row_sparse_tables:
+            out = self._build_rowsparse_window_fn()(params, opt_state, xw,
+                                                    yw, mw, rng)
         else:
             out = self._build_window_fn()(params, opt_state, xw, yw, mw, rng)
         jax.block_until_ready(out)
@@ -1183,9 +1322,15 @@ class DOWNPOURWorker(PSWorker):
     commit the raw accumulated window delta, then re-pull the center."""
     ALGORITHM = "downpour"
     _DEVICE_TOPK = True  # delta = after − base: selectable inside the jit
+    _ROW_SPARSE_OK = True  # the committed quantity IS the window delta
 
     def _window_step(self, window_fn, params, opt_state, xw, yw, mw, rng,
                      index):
+        if self.row_sparse_tables:
+            # row-sparse embedding commit: one combined 'u' round trip,
+            # table deltas shipped as exact touched-row blocks
+            return self._rowsparse_window_step(params, opt_state, xw, yw,
+                                               mw, rng, index)
         if self._topk_density is not None:
             # device-side selection: the full delta never reaches the host
             params, opt_state, loss, codes, idxs, scale = \
@@ -1288,6 +1433,9 @@ def share_compiled_state(workers: List["Worker"]) -> None:
                   and getattr(head, "_DEVICE_TOPK", False))
     if share_topk:
         head._build_topk_window_fn()  # compile the top-k variant once too
+    share_rs = bool(getattr(head, "row_sparse_tables", ()))
+    if share_rs:
+        head._build_rowsparse_window_fn()  # and the row-sparse variant
     for w in workers[1:]:
         w._model = head._model
         w._params0 = head._params0
@@ -1298,6 +1446,8 @@ def share_compiled_state(workers: List["Worker"]) -> None:
             w._wire_k = head._wire_k
             w._wire_total = head._wire_total
             w._wire_shapes = head._wire_shapes
+        if share_rs:
+            w._rs_window_fn = head._rs_window_fn
 
 
 WORKER_CLASSES = {

@@ -48,3 +48,8 @@ def pytest_configure(config):
         "markers",
         "slow: multi-process end-to-end tests (worker subprocesses each "
         "import jax and compile)")
+    config.addinivalue_line(
+        "markers",
+        "stream: streaming-ingestion / online-learning contract tests "
+        "(tier-1 ones are generator-backed — no live sockets or sleeps on "
+        "the fast path; socket-feed coverage uses socketpair only)")

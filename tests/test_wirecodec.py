@@ -270,6 +270,167 @@ def test_sparse_dense_equivalence_fuzz(codec):
             np.testing.assert_allclose(got, want, atol=1e-5)
 
 
+ROW_SPARSE_MESSAGE = {
+    "delta": [np.ones((3,), np.float32),
+              networking.RowSparseDelta(
+                  np.array([0, 4, 9], np.int32),
+                  np.arange(12, dtype=np.float32).reshape(3, 4), 16)],
+    "worker_id": 2,
+    "clock": 5,
+}
+
+
+def test_row_sparse_node_roundtrip_either_codec(codec):
+    """The row-sparse payload node (rows + (k, dim) value block + dense row
+    count) survives both codec implementations bit for bit, embedded in a
+    mixed dense+row-sparse delta list (the wire form of a row_sparse
+    commit)."""
+    out = networking.decode_message(
+        networking.encode_message(ROW_SPARSE_MESSAGE))
+    dense, rsp = out["delta"]
+    np.testing.assert_array_equal(dense, ROW_SPARSE_MESSAGE["delta"][0])
+    assert isinstance(rsp, networking.RowSparseDelta)
+    want = ROW_SPARSE_MESSAGE["delta"][1]
+    np.testing.assert_array_equal(rsp.rows, want.rows)
+    np.testing.assert_array_equal(rsp.values, want.values)
+    assert rsp.num_rows == 16 and rsp.row_shape == (4,)
+    np.testing.assert_array_equal(rsp.to_dense()[want.rows], want.values)
+
+
+def test_row_sparse_node_pooled_recv_either_codec(codec):
+    """A row-sparse commit through the zero-copy pooled path decodes to
+    views over the pool; .decoded() detaches them."""
+    pool = networking.BufferPool()
+    a, b = socket.socketpair()
+    try:
+        for _ in range(2):
+            t = threading.Thread(target=networking.send_data,
+                                 args=(a, ROW_SPARSE_MESSAGE))
+            t.start()
+            out = networking.recv_data(b, pool=pool)
+            t.join()
+            rsp = out["delta"][1]
+            assert not rsp.values.flags["OWNDATA"]  # view into the pool
+            detached = rsp.decoded()
+            assert detached.values.flags["OWNDATA"]
+            np.testing.assert_array_equal(
+                detached.values, ROW_SPARSE_MESSAGE["delta"][1].values)
+        assert pool.misses == 1 and pool.hits == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_row_sparse_slice_rows():
+    """Shard splitting by row range: local re-indexing, empty middles,
+    boundary rows land exactly once."""
+    rsp = networking.RowSparseDelta(
+        np.array([0, 4, 9, 10], np.int32),
+        np.arange(8, dtype=np.float32).reshape(4, 2), 12)
+    lo = rsp.slice_rows(0, 5)
+    np.testing.assert_array_equal(lo.rows, [0, 4])
+    hi = rsp.slice_rows(5, 12)
+    np.testing.assert_array_equal(hi.rows, [4, 5])
+    assert lo.num_rows == 5 and hi.num_rows == 7
+    full = np.zeros((12, 2), np.float32)
+    full[:5] += lo.to_dense()
+    full[5:] += hi.to_dense()
+    np.testing.assert_array_equal(full, rsp.to_dense())
+    empty = rsp.slice_rows(5, 9)
+    assert empty.nnz == 0 and empty.num_rows == 4
+
+
+# --- decode guards: duplicate/negative/out-of-range/unsorted indices must
+# --- reject with the typed ProtocolError, never corrupt the center
+
+def _sp(idx, length=16):
+    return networking.SparseDelta(np.asarray(idx, np.int32),
+                                  np.ones(len(idx), np.float32), length)
+
+
+def _rsp(rows, num_rows=16):
+    return networking.RowSparseDelta(
+        np.asarray(rows, np.int32),
+        np.ones((len(rows), 3), np.float32), num_rows)
+
+
+@pytest.mark.parametrize("make,label", [
+    (lambda: _sp([3, 3, 7]), "duplicate"),
+    (lambda: _sp([-1, 2, 7]), "negative"),
+    (lambda: _sp([2, 7, 16]), "out-of-range"),
+    (lambda: _sp([7, 2, 3]), "unsorted"),
+    (lambda: _rsp([3, 3, 7]), "row-duplicate"),
+    (lambda: _rsp([-1, 2, 7]), "row-negative"),
+    (lambda: _rsp([2, 7, 16]), "row-out-of-range"),
+    (lambda: _rsp([7, 2, 3]), "row-unsorted"),
+])
+def test_sparse_guard_rejects_bad_indices_either_codec(make, label, codec):
+    """Hostile/corrupt index vectors survive the codec (the codec frames
+    buffers, it doesn't interpret them) but validate() rejects them with
+    the typed ProtocolError — a ValueError subclass, so every server
+    handler's torn-frame path drops the connection."""
+    node = make()
+    out = networking.decode_message(
+        networking.encode_message({"delta": node}))["delta"]
+    with pytest.raises(networking.ProtocolError):
+        out.validate()
+    assert isinstance(networking.ProtocolError("x"), ValueError)
+
+
+def test_sparse_guard_fuzz_valid_commits_pass(codec):
+    """Randomized valid commits (sorted unique in-range indices) always
+    pass validation after a codec round trip — the guard rejects only
+    contract violations."""
+    rng = np.random.default_rng(7)
+    for _ in range(20):
+        length = int(rng.integers(4, 200))
+        k = int(rng.integers(0, min(length, 32) + 1))
+        idx = np.sort(rng.choice(length, size=k, replace=False)).astype(
+            np.int32)
+        sp = networking.SparseDelta(idx, rng.standard_normal(k).astype(
+            np.float32), length)
+        networking.decode_message(networking.encode_message(
+            {"d": sp}))["d"].validate()
+        rows = int(rng.integers(2, 50))
+        kk = int(rng.integers(0, rows + 1))
+        rr = np.sort(rng.choice(rows, size=kk, replace=False)).astype(
+            np.int32)
+        rsp = networking.RowSparseDelta(
+            rr, rng.standard_normal((kk, 3)).astype(np.float32), rows)
+        networking.decode_message(networking.encode_message(
+            {"d": rsp}))["d"].validate()
+
+
+def test_sparse_guard_fuzz_corrupted_commits_reject(codec):
+    """Fuzz: valid commits corrupted at a random index position (dup /
+    negate / overflow) must reject after the round trip."""
+    rng = np.random.default_rng(13)
+    for trial in range(30):
+        length = int(rng.integers(8, 100))
+        k = int(rng.integers(2, min(length, 16) + 1))
+        idx = np.sort(rng.choice(length, size=k, replace=False)).astype(
+            np.int64)
+        pos = int(rng.integers(0, k))
+        kind = trial % 3
+        if kind == 0:
+            idx[pos] = idx[(pos + 1) % k]  # duplicate
+        elif kind == 1:
+            idx[pos] = -1 - idx[pos]  # negative
+        else:
+            idx[pos] = length + int(rng.integers(0, 5))  # out of range
+        row_form = trial % 2 == 0
+        if row_form:
+            node = networking.RowSparseDelta(
+                idx, np.ones((k, 2), np.float32), length)
+        else:
+            node = networking.SparseDelta(
+                idx, np.ones(k, np.float32), length)
+        out = networking.decode_message(
+            networking.encode_message({"d": node}))["d"]
+        with pytest.raises(networking.ProtocolError):
+            out.validate()
+
+
 # serving-protocol messages ('q' enqueue / 'r' stream reply —
 # networking.SERVING_OP_ENQUEUE / SERVING_OP_STREAM): the request, ack,
 # backpressure, chunk, and final frames the serving server exchanges must
