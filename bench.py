@@ -563,8 +563,16 @@ def serving_bench(budget_s: float = 90.0):
     ``serving_longprompt_ttft_p99_ms`` (bucketed + chunked, the fast
     path) vs ``serving_longprompt_ttft_eager_p99_ms`` (the eager
     reference) — the chunked-prefill TTFT win, recorded alongside
-    throughput.  Returns Nones on overrun/failure — never fatal to the
-    north-star artifact.
+    throughput.
+
+    Speculation + quantization observables (PR 11):
+    ``serving_spec_tokens_per_sec`` (the same trace through a self-draft
+    speculative engine — one jitted draft+verify round per iteration)
+    with ``serving_spec_accept_rate`` (accepted/drafted), and
+    ``serving_quant_capacity_slots`` — the byte-accounted slot count an
+    int8 KV pool sustains inside the full-precision pool's HBM budget
+    (>= 1.5× ``num_slots`` is the acceptance bar).  Returns Nones on
+    overrun/failure — never fatal to the north-star artifact.
     """
     sys.path.insert(0, os.path.join(_REPO, "examples"))
     import loadgen
@@ -577,7 +585,10 @@ def serving_bench(budget_s: float = 90.0):
             "serving_ttft_p50_ms": None, "serving_ttft_p99_ms": None,
             "serving_prefill_tokens_per_sec": None,
             "serving_longprompt_ttft_p99_ms": None,
-            "serving_longprompt_ttft_eager_p99_ms": None}
+            "serving_longprompt_ttft_eager_p99_ms": None,
+            "serving_spec_tokens_per_sec": None,
+            "serving_spec_accept_rate": None,
+            "serving_quant_capacity_slots": None}
     if budget_s < 5.0:  # not enough budget to even warm the engine up
         return none
     t0 = time.perf_counter()
@@ -602,6 +613,32 @@ def serving_bench(budget_s: float = 90.0):
         "serving_ttft_p99_ms": closed["ttft_p99_ms"],
         "serving_prefill_tokens_per_sec": closed["prefill_tokens_per_sec"],
     })
+    # quantized-capacity accounting (pure byte math, no run): slots an
+    # int8 KV pool sustains inside the f32/bf16 pool's byte budget
+    _, fp_eng = loadgen.build_engine(num_slots=4)
+    _, q8_eng = loadgen.build_engine(num_slots=4, kv_dtype="int8")
+    out["serving_quant_capacity_slots"] = int(
+        fp_eng.kv_pool_bytes // (q8_eng.kv_pool_bytes // q8_eng.num_slots))
+    fp_eng.stop()
+    q8_eng.stop()
+    if time.perf_counter() - t0 > budget_s * 0.45:
+        return out
+    # speculative leg: a TRAINED (2-layer target, 1-layer draft) pair on
+    # the x+1 task serving an in-distribution greedy trace — accept rate
+    # ~0.8, the way production prompts are in-distribution for a real
+    # draft (speculation's win is a property of the traffic).  Each
+    # engine iteration is ONE jitted draft+verify round committing
+    # 1..spec_len+1 tokens per row
+    _, _, spec_eng = loadgen.build_spec_engine(num_slots=4, spec_len=3)
+    spec_trace = loadgen.make_trace(24, num_steps=16, pattern="arith")
+    try:
+        spec_eng.warmup()
+        spec = loadgen.run_closed_loop(spec_eng, spec_trace, concurrency=8,
+                                       timeout_s=budget_s)
+        out["serving_spec_tokens_per_sec"] = spec["tokens_per_sec"]
+        out["serving_spec_accept_rate"] = spec["spec_accept_rate"]
+    finally:
+        spec_eng.stop()
     if time.perf_counter() - t0 > budget_s * 0.55:
         return out
     # long-prompt TTFT leg: prompts past prefill_chunk, same trace through
@@ -936,7 +973,10 @@ def main():
                       "serving_ttft_p99_ms": None,
                       "serving_prefill_tokens_per_sec": None,
                       "serving_longprompt_ttft_p99_ms": None,
-                      "serving_longprompt_ttft_eager_p99_ms": None}
+                      "serving_longprompt_ttft_eager_p99_ms": None,
+                      "serving_spec_tokens_per_sec": None,
+                      "serving_spec_accept_rate": None,
+                      "serving_quant_capacity_slots": None}
     serving_remaining = budget - (time.perf_counter() - t_start)
     if serving_remaining > 45:
         try:

@@ -80,7 +80,8 @@ def _validate_rolling(model) -> None:
 
 
 def init_cache(model, batch: int, max_len: int,
-               rolling: bool = False) -> List[Any]:
+               rolling: bool = False, kv_dtype: Optional[str] = None,
+               ring_slack: int = 0) -> List[Any]:
     """One cache slot per layer: ``{"k", "v"}`` of shape
     (batch, max_len, num_kv_heads, key_dim) for TransformerBlocks, None
     elsewhere.  Cache dtype = the model's compute dtype (bf16 on TPU).
@@ -89,10 +90,27 @@ def init_cache(model, batch: int, max_len: int,
     ring buffer of its ``attention_window`` slots instead of ``max_len`` —
     slot ``p % W`` holds position ``p``, old entries are overwritten as
     generation advances, and memory stays O(W) however long the
-    continuation runs (the point of windowed attention at decode time)."""
+    continuation runs (the point of windowed attention at decode time).
+    ``ring_slack`` widens each ring by that many EXTRA slots (modulus
+    W + slack): entries survive ``slack`` positions past the window, which
+    is what makes multi-token per-row steps (the serving engine's
+    speculative verify, L = spec_len + 1) exact on rolling pools — a
+    query at the oldest position in the write window still finds its
+    full attention window un-overwritten.
+
+    ``kv_dtype="int8"``: entries are stored as int8 codes plus a
+    per-(row, slot, head) f32 scale (``{"k", "v", "ks", "vs"}``),
+    quantized at write time and dequantized inside the attention read
+    (``core.quant.quantize_kv``) — roughly half the slot bytes of a bf16
+    pool, 4× down from f32.  Written through the per-row (serving)
+    decode paths only; offline scalar-position walkers keep their
+    full-precision caches."""
     _check_supported(model)
     if rolling:
         _validate_rolling(model)
+    if kv_dtype not in (None, "int8"):
+        raise ValueError(f"kv_dtype must be None or 'int8', got "
+                         f"{kv_dtype!r}")
     limit = _context_limit(model)
     if limit is not None and max_len > limit:
         raise ValueError(
@@ -106,13 +124,52 @@ def init_cache(model, batch: int, max_len: int,
             mha = layer._mha()
             slots = max_len
             if rolling:
-                slots = min(mha.attention_window, max_len)
+                slots = min(mha.attention_window + int(ring_slack), max_len)
             shape = (batch, slots, mha._kv_heads(), mha.key_dim)
-            caches.append({"k": jnp.zeros(shape, dtype),
-                           "v": jnp.zeros(shape, dtype)})
+            if kv_dtype == "int8":
+                caches.append({"k": jnp.zeros(shape, jnp.int8),
+                               "v": jnp.zeros(shape, jnp.int8),
+                               "ks": jnp.zeros(shape[:3], jnp.float32),
+                               "vs": jnp.zeros(shape[:3], jnp.float32)})
+            else:
+                caches.append({"k": jnp.zeros(shape, dtype),
+                               "v": jnp.zeros(shape, dtype)})
         else:
             caches.append(None)
     return caches
+
+
+def _kv_quantized(cache) -> bool:
+    """True for an int8 KV cache dict (codes + per-entry scales)."""
+    return isinstance(cache, dict) and "ks" in cache
+
+
+def _kv_write(cache, idx, k_t, v_t):
+    """Scatter a (B, L, Hkv, Dh) k/v write into ``cache`` at ``idx`` (a
+    tuple of broadcastable row/slot index arrays); int8 caches quantize on
+    write, storing codes and per-entry scales side by side.  Out-of-bounds
+    indices drop (jit scatter semantics) — the serving engine's
+    speculative verify leans on that at the end-of-request boundary."""
+    if _kv_quantized(cache):
+        from .quant import quantize_kv
+        kq, ks = quantize_kv(k_t)
+        vq, vs = quantize_kv(v_t)
+        return {"k": cache["k"].at[idx].set(kq),
+                "v": cache["v"].at[idx].set(vq),
+                "ks": cache["ks"].at[idx].set(ks),
+                "vs": cache["vs"].at[idx].set(vs)}
+    return {"k": cache["k"].at[idx].set(k_t),
+            "v": cache["v"].at[idx].set(v_t)}
+
+
+def _kv_read(cache, dtype):
+    """The attention-side view of a cache: dense (codes × scales for int8
+    caches — fused into the consuming matmuls under jit)."""
+    if _kv_quantized(cache):
+        from .quant import dequantize_kv
+        return (dequantize_kv(cache["k"], cache["ks"], dtype),
+                dequantize_kv(cache["v"], cache["vs"], dtype))
+    return cache["k"], cache["v"]
 
 
 def _per_row(pos) -> bool:
@@ -127,9 +184,13 @@ def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype,
     """Cached attention over (B, L, D) queries starting at position
     ``pos``; writes k/v for those L positions into the cache and attends
     through ``ops.attention.dot_product_attention`` (same numerics as the
-    training forward).  ``pos`` may be a (B,) vector (single-token steps
-    only): each row writes its k/v at — and attends from — its own
-    position.
+    training forward).  ``pos`` may be a (B,) vector: each row writes its
+    k/v at — and attends from — its own position, and per-row positions
+    compose with L > 1 (the serving engine's speculative verify: L =
+    spec_len + 1 entries written at each row's own offsets, all L queries
+    scored in this one forward).  Rolling caches additionally need a ring
+    of >= window + L - 1 slots for L > 1 (``init_cache(ring_slack=...)``)
+    so the oldest query's attention window survives the newest write.
 
     Right-padded batches (the serving engine's bucketed prefill pads a
     mixed-length prompt batch to one bucket length) need no extra
@@ -162,26 +223,38 @@ def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype,
                      else pos + jnp.arange(length))
         q = apply_rope(q, positions, mha.rope_theta, mha.rope_scale)
         k_t = apply_rope(k_t, positions, mha.rope_theta, mha.rope_scale)
+    new_cache = None
     if per_row:
-        if length != 1:
-            raise ValueError("per-row positions are single-token steps "
-                             "(prefill each request at scalar pos, then "
-                             "batch the decode steps)")
+        # L >= 1: every row writes its L entries at its own offsets (the
+        # serving engine's decode step at L == 1, its speculative verify
+        # at L == spec_len + 1) and the per-row masks score all L queries
+        # in this one forward
         rows = jnp.arange(b)
+        idx = pos[:, None] + jnp.arange(length)[None, :]          # (B, L)
         if rolling:
             w = cache["k"].shape[1]
-            slot = pos % w
-            k = cache["k"].at[rows, slot].set(k_t[:, 0])
-            v = cache["v"].at[rows, slot].set(v_t[:, 0])
+            if length > 1 and w < mha.attention_window + length - 1:
+                raise ValueError(
+                    f"multi-token per-row steps on a rolling cache need a "
+                    f"ring of >= window + L - 1 = "
+                    f"{mha.attention_window + length - 1} slots, got {w} "
+                    f"(init_cache(ring_slack=...)) — the oldest query's "
+                    f"window would be overwritten by the newest write")
+            new_cache = _kv_write(cache, (rows[:, None], idx % w), k_t, v_t)
+            # slot j holds the newest position <= each row's write
+            # frontier congruent to j mod w (negative = never written);
+            # queries older than the frontier hide the just-written
+            # future entries through the causal kv_positions comparison
+            front = pos[:, None] + (length - 1)
             j = jnp.arange(w)
-            kv_positions = pos[:, None] - jnp.mod(pos[:, None] - j[None, :],
-                                                  w)
+            kv_positions = front - jnp.mod(front - j[None, :], w)
+            k, v = _kv_read(new_cache, cdtype)
             out = dot_product_attention(q, k, v, causal=True, q_offset=pos,
                                         window=mha.attention_window,
                                         kv_positions=kv_positions)
         else:
-            k = cache["k"].at[rows, pos].set(k_t[:, 0])
-            v = cache["v"].at[rows, pos].set(v_t[:, 0])
+            new_cache = _kv_write(cache, (rows[:, None], idx), k_t, v_t)
+            k, v = _kv_read(new_cache, cdtype)
             out = dot_product_attention(q, k, v, causal=True, q_offset=pos,
                                         kv_length=pos + length,
                                         window=mha.attention_window)
@@ -212,7 +285,7 @@ def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype,
     out = out.reshape(b, length, mha.num_heads * dh)
     bias_o = params.get("bo") if mha.use_bias else None
     y = _project(out, params["wo"], bias_o, cdtype)
-    return y, {"k": k, "v": v}
+    return y, (new_cache if new_cache is not None else {"k": k, "v": v})
 
 
 def _block_forward(block: TransformerBlock, params, x, cache, pos, cdtype,
@@ -234,8 +307,10 @@ def _forward(model, params, caches, toks, pos, rolling: bool = False):
     """Walk the layer stack over (B, L) tokens starting at position
     ``pos``; returns ((B, L, V) f32 logits, new caches).  L == 1 is a
     decode step, L == P is the batched prompt prefill.  ``pos`` may be a
-    (B,) per-row position vector (L == 1 only): every row advances at its
-    own position — the serving engine's mixed-length slot batch.  L > 1
+    (B,) per-row position vector: every row advances at its own position —
+    the serving engine's mixed-length slot batch (L == 1), or its batched
+    speculative verify (L == spec_len + 1, each row scoring its own L
+    continuation positions in one forward).  L > 1
     batches may be right-padded to a shared length (the serving engine's
     bucketed prefill) — see ``_mha_forward`` for why the causal mask
     alone keeps pad tokens out of every real position's numerics."""
@@ -248,9 +323,17 @@ def _forward(model, params, caches, toks, pos, rolling: bool = False):
             # (FittedModel), which tracer-indexing rejects
             x = jnp.asarray(p["embedding"]).astype(cdtype)[toks]
         elif isinstance(layer, PositionalEmbedding):
-            if _per_row(pos):
+            if _per_row(pos) and toks.shape[1] == 1:
                 pe = jnp.asarray(p["embedding"])[pos]          # (B, D)
                 x = x + pe.astype(x.dtype)[:, None]
+            elif _per_row(pos):
+                # per-row multi-token (the speculative verify): row r's
+                # token i sits at absolute position pos[r] + i.  OOB rows
+                # (a request at its very end) clamp — their logits are
+                # junk the engine never commits
+                idx = pos[:, None] + jnp.arange(toks.shape[1])[None, :]
+                pe = jnp.asarray(p["embedding"])[idx]          # (B, L, D)
+                x = x + pe.astype(x.dtype)
             else:
                 pe = jax.lax.dynamic_slice_in_dim(
                     jnp.asarray(p["embedding"]), pos, toks.shape[1])

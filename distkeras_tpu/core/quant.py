@@ -127,3 +127,45 @@ def quantized_bytes(params: Any) -> int:
     for leaf in jax.tree_util.tree_leaves(params):
         total += leaf.size * jnp.dtype(leaf.dtype).itemsize
     return total
+
+
+# ---------------------------------------------------------------------------
+# KV-cache quantization (the serving engine's int8 slot pool)
+# ---------------------------------------------------------------------------
+#
+# Decode at high concurrency is HBM-bound on the KV pool the same way it is
+# on weights: every step streams every slot's cached k/v.  Storing entries
+# int8 with a per-(row, slot, head) f32 scale roughly halves slot bytes vs
+# bf16 (4× vs f32), which at fixed pool HBM doubles ``num_slots`` — the
+# concurrent-user capacity lever.  Quantization happens at WRITE time (one
+# scale per cache entry, reduced over head_dim); the dequant multiply sits
+# inside the jitted attention read, where XLA fuses it into the score/value
+# matmuls — nothing dequantized is ever materialized in HBM.
+
+def quantize_kv(x):
+    """(…, head_dim) k/v entries → ``(int8 codes, f32 scales)`` with one
+    symmetric scale per entry (amax over the trailing head_dim axis).
+    Zero entries (never-written cache slots) keep scale 0, so they
+    dequantize back to exact zeros."""
+    xf = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 0.0)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(xf / safe[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype):
+    """Codes + per-entry scales back to a dense array in ``dtype`` (the
+    attention read; fused into the consuming matmul under jit)."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def kv_cache_bytes(caches: Any) -> int:
+    """On-device bytes of a KV cache/pool pytree (codes + scales for int8
+    pools, itemsize-true otherwise) — the byte-accounting behind the
+    ``serving_quant_capacity_slots`` bench field."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(caches):
+        total += leaf.size * jnp.dtype(leaf.dtype).itemsize
+    return total

@@ -70,8 +70,14 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     Each hook also accepts a PER-ROW form — ``q_offset``/``kv_length`` of
     shape (B,), ``kv_positions`` of shape (B, Sk) — so one batched decode
     step can advance every row at its own position (the serving engine's
-    slot pool, where slots hold requests of different lengths).  The scalar
-    form takes the exact code path it always did.  Together the two hooks
+    slot pool, where slots hold requests of different lengths).  The
+    per-row forms compose with Sq > 1: the serving engine's speculative
+    verify scores L = spec_len + 1 continuation tokens per row in one
+    forward, each row's causal mask anchored at its own ``q_offset`` and
+    its ``kv_length`` frontier at ``q_offset + L`` (ring caches pass a
+    ``kv_positions`` built from each row's write FRONTIER, which also
+    hides the round's just-written future entries from its earlier
+    queries).  The scalar form takes the exact code path it always did.  Together the two hooks
     carry the serving engine's BUCKETED PREFILL masking: at prefill time a
     batch of prompts right-padded to one bucket length needs only the
     causal mask — pad keys sit at positions >= every real query, so no

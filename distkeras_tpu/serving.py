@@ -43,6 +43,19 @@ iteration-level (Orca-style) scheduling:
    emits ``eos_id`` or its ``num_steps``-th token; the slot is immediately
    reusable by the next queued request *mid-run* (continuous batching —
    the point of the whole engine).
+ - **Batched per-slot speculative decoding** (``spec_draft=``, off by
+   default) — a draft model rides the same slot layout in its OWN KV
+   pool; each scheduler iteration drafts ``spec_len`` tokens per active
+   row, verifies them all in ONE batched target forward, and commits
+   heterogeneous per-row accept lengths (rows advance 1..spec_len+1
+   positions per round) — all inside one jitted program, so a round
+   costs one dispatch and one d2h like a plain step.  Greedy speculation
+   is token-identical to non-speculative greedy.
+ - **Quantization** (``quantize=``, ``kv_dtype=``, off by default) —
+   int8/bf16 weight-only quantization applied at construction and on
+   every hot-reload pull, and an int8 KV slot pool (codes + per-entry
+   scales, dequantized inside the attention read) at roughly half the
+   bf16 slot bytes — the ``num_slots``-doubling lever at fixed HBM.
  - **Hot weight reload** (stretch, off by default) — ``attach_ps`` points
    the engine at a live parameter server; between decode steps it pulls a
    fresh center over the existing ``'p'`` opcode, so training and serving
@@ -91,6 +104,7 @@ import numpy as np
 
 from . import networking
 from .core import decode as _dec
+from .core import quant as _quant
 from .core.decode import (_check_supported, _context_limit, _forward,
                           _to_ring, _validate_rolling, _validate_sampling,
                           _validate_stopping, _vocab_size, decode_step,
@@ -270,6 +284,74 @@ class RequestHandle:
                 else self.first_token_at - self.submitted_at)
 
 
+def _quantize_weights(params, mode: str):
+    """The engine's one weight-quantization path (construction AND every
+    ``attach_ps`` hot-reload pull go through it): ``"int8"`` —
+    ``quantize_params`` weight-only post-training quantization (matmul
+    kernels become (codes, scale) leaves that dequantize inside the
+    unmodified forward); ``"bf16"`` — every float leaf cast to bfloat16
+    (half the f32 weight traffic, no code change).  Idempotent."""
+    if mode == "int8":
+        return _quant.quantize_params(params)
+    # bf16: cast float leaves; QuantizedTensor leaves (already int8) and
+    # integer leaves pass through untouched
+    def cast(x):
+        if isinstance(x, _quant.QuantizedTensor):
+            return x
+        if hasattr(x, "dtype") and jnp.issubdtype(
+                jnp.asarray(x).dtype, jnp.floating):
+            return jnp.asarray(x).astype(jnp.bfloat16)
+        return x
+    return tmap(cast, params,
+                is_leaf=lambda x: isinstance(x, _quant.QuantizedTensor))
+
+
+def _commit_rows(big, row, slots, width: int, rolling: bool, p_lens):
+    """Scatter freshly-prefilled full-precision cache rows into the slot
+    pool: ring-converted per row for rolling pools, quantize-on-commit for
+    int8 pools (same per-entry scales the decode-time writes produce).
+    ``slots`` rows carrying index ``num_slots`` drop every write."""
+    if big is None:
+        return None
+    if rolling:
+        w = big["k"].shape[1]
+        row = {n: _dec.ring_from_prefill(row[n], p_lens, w)
+               for n in ("k", "v")}
+
+        def put(dst, src):
+            return dst.at[slots].set(src, mode="drop")
+    else:
+        def put(dst, src):
+            return dst.at[slots, :width].set(src, mode="drop")
+    if "ks" in big:
+        kq, ks = _quant.quantize_kv(row["k"])
+        vq, vs = _quant.quantize_kv(row["v"])
+        return {"k": put(big["k"], kq), "v": put(big["v"], vq),
+                "ks": put(big["ks"], ks), "vs": put(big["vs"], vs)}
+    return {n: put(big[n], row[n]) for n in ("k", "v")}
+
+
+def _commit_full_row(big, row, slot, rolling: bool, p_row):
+    """The chunked-prefill final commit: one staged full-length row
+    atomically replaces pool row ``slot`` (ring-collapsed for rolling
+    pools, quantize-on-commit for int8 pools)."""
+    if big is None:
+        return None
+    if rolling:
+        w = big["k"].shape[1]
+        row = {n: _dec.ring_from_prefill(row[n], p_row, w)
+               for n in ("k", "v")}
+    if "ks" in big:
+        kq, ks = _quant.quantize_kv(row["k"])
+        vq, vs = _quant.quantize_kv(row["v"])
+        return {"k": big["k"].at[slot].set(kq[0], mode="drop"),
+                "v": big["v"].at[slot].set(vq[0], mode="drop"),
+                "ks": big["ks"].at[slot].set(ks[0], mode="drop"),
+                "vs": big["vs"].at[slot].set(vs[0], mode="drop")}
+    return {n: big[n].at[slot].set(row[n][0], mode="drop")
+            for n in ("k", "v")}
+
+
 def _pow2_buckets(cap: int) -> List[int]:
     """The prefill length-bucket ladder: powers of two from 8 up, capped
     (and terminated) at ``cap`` — a SMALL set, so each bucket's jitted
@@ -294,11 +376,12 @@ class _PrefillJob:
     final chunk commits to the slot's pool row in one atomic program
     (ring-collapsed for rolling engines)."""
 
-    __slots__ = ("handle", "staging", "written")
+    __slots__ = ("handle", "staging", "d_staging", "written")
 
-    def __init__(self, handle: RequestHandle, staging=None):
+    def __init__(self, handle: RequestHandle, staging=None, d_staging=None):
         self.handle = handle
         self.staging = staging
+        self.d_staging = d_staging  # the draft model's twin (speculation)
         self.written = 0
 
 
@@ -323,6 +406,29 @@ class ServingEngine:
     decode steps, so admissions never stall the running batch for more
     than one chunk per iteration.
 
+    Speculation + quantization (all default OFF — defaults are
+    bit-identical to the pre-speculation engine):
+
+     - ``spec_draft`` (bucketed mode): a cheaper draft model
+       (``FittedModel`` or ``(Sequential, params)``, same vocabulary)
+       turns every decode iteration into a speculative ROUND — ``spec_len``
+       per-slot draft steps against the draft's own slot-pooled KV cache,
+       one batched target verify forward, heterogeneous per-row accept
+       lengths (each row advances 1..spec_len+1 positions).  Greedy
+       requests stay token-identical to non-speculative greedy (the
+       committed chain is the target's own argmax chain); sampled
+       requests follow the Leviathan/Chen rejection rule —
+       distribution-exact, deterministic per seed, but a different (and
+       documented) key-fold schedule than the non-speculative sampler.
+     - ``quantize``: ``"int8"`` (weight-only post-training quantization
+       through ``core.quant.quantize_params``) or ``"bf16"`` — applied at
+       construction and re-applied to every ``attach_ps`` hot-reload
+       pull.  Lossy; the eager engine stays the full-precision reference.
+     - ``kv_dtype="int8"`` (bucketed mode): the slot pools (target and
+       draft) store int8 codes + per-entry scales — roughly half the
+       bf16 slot bytes, so ``num_slots`` can ~double at fixed pool HBM
+       (``kv_pool_bytes`` is the byte-accounted observable).  Lossy.
+
     Threading: ``submit`` is thread-safe (any number of producers);
     the scheduler itself — ``step`` / ``run_until_idle`` / the ``start``
     background thread — must be driven from ONE thread at a time.
@@ -333,7 +439,12 @@ class ServingEngine:
                  queue_capacity: int = 64, prefills_per_step: int = 1,
                  rolling: bool = False,
                  default_deadline_s: Optional[float] = None,
-                 prefill_mode: str = "bucketed", prefill_chunk: int = 128):
+                 prefill_mode: str = "bucketed", prefill_chunk: int = 128,
+                 spec_draft: Optional[Union[FittedModel,
+                                            Tuple[Sequential, Any]]] = None,
+                 spec_len: int = 4,
+                 quantize: Optional[str] = None,
+                 kv_dtype: Optional[str] = None):
         if isinstance(model, FittedModel):
             self.model, self.params = model.model, model.params
         else:
@@ -341,6 +452,52 @@ class ServingEngine:
         _check_supported(self.model)
         if rolling:
             _validate_rolling(self.model)
+        # -- speculation + quantization knobs (all default OFF: the engine
+        #    is bit-identical to its pre-speculation self until asked)
+        if prefill_mode == "eager" and (spec_draft is not None
+                                        or kv_dtype is not None):
+            raise ValueError(
+                "spec_draft / kv_dtype are fast-path features "
+                "(prefill_mode='bucketed'); the eager engine stays the "
+                "unmodified bit-exactness reference")
+        if quantize not in (None, "int8", "bf16"):
+            raise ValueError(f"quantize must be None, 'int8' or 'bf16', "
+                             f"got {quantize!r}")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"kv_dtype must be None or 'int8', got "
+                             f"{kv_dtype!r}")
+        if int(spec_len) < 1:
+            raise ValueError(f"spec_len must be >= 1, got {spec_len}")
+        self.spec_len = int(spec_len)
+        self.quantize = quantize
+        self.kv_dtype = kv_dtype
+        if spec_draft is None:
+            self._draft_model, self._draft_params = None, None
+        else:
+            if isinstance(spec_draft, FittedModel):
+                self._draft_model = spec_draft.model
+                self._draft_params = spec_draft.params
+            else:
+                self._draft_model, self._draft_params = spec_draft
+            _check_supported(self._draft_model)
+            tv, dv = _vocab_size(self.model), _vocab_size(self._draft_model)
+            if tv is not None and dv is not None and tv != dv:
+                raise ValueError(
+                    f"target and draft vocabularies differ: {tv} vs {dv} — "
+                    f"draft proposals would be meaningless")
+        # quantize weights ONCE at construction; attach_ps re-quantizes
+        # every pulled center through the same path.  The f32 skeleton
+        # (scalar zeros of the pre-quant dtypes) is what set_weights maps
+        # a pulled flat weight list onto before re-quantization
+        if quantize is not None:
+            self._fp_skel = tmap(lambda x: np.zeros((), np.asarray(x).dtype),
+                                 self.params)
+            self.params = _quantize_weights(self.params, quantize)
+            if self._draft_params is not None:
+                self._draft_params = _quantize_weights(self._draft_params,
+                                                       quantize)
+        else:
+            self._fp_skel = None
         self.num_slots = int(num_slots)
         if self.num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
@@ -371,9 +528,24 @@ class ServingEngine:
         self.default_deadline_s = default_deadline_s
         self._vocab = _vocab_size(self.model)
 
-        # -- slot pool: ONE batched cache, one host-side row of state per slot
+        # -- slot pool: ONE batched cache, one host-side row of state per
+        #    slot.  With speculation on a rolling pool the ring gets
+        #    spec_len slots of slack so the L = spec_len + 1 verify write
+        #    never overwrites the oldest query's attention window; with
+        #    kv_dtype="int8" entries are stored as codes + per-entry
+        #    scales at roughly half the bf16 slot bytes.  The draft model
+        #    gets its OWN pool over the same slot indices (full-length:
+        #    draft caches are small next to the target's)
+        ring_slack = (self.spec_len if (rolling and spec_draft is not None)
+                      else 0)
         self.caches = init_cache(self.model, self.num_slots, self.max_len,
-                                 rolling=self.rolling)
+                                 rolling=self.rolling, kv_dtype=kv_dtype,
+                                 ring_slack=ring_slack)
+        if self._draft_model is not None:
+            self.d_caches = init_cache(self._draft_model, self.num_slots,
+                                       self.max_len, kv_dtype=kv_dtype)
+        else:
+            self.d_caches = None
         self._handles: List[Optional[RequestHandle]] = [None] * self.num_slots
         self._free: List[int] = list(range(self.num_slots - 1, -1, -1))
         self._positions = np.zeros((self.num_slots,), np.int32)
@@ -424,6 +596,9 @@ class ServingEngine:
             self._bucket_fns: Dict[int, Any] = {}
             self._stage_fns: Dict[int, Any] = {}
             self._final_fns: Dict[int, Any] = {}
+            if self._draft_model is not None:
+                self._draft_params = jax.device_put(self._draft_params)
+                self._spec_fn = self._build_spec_fn()
 
         # -- hot weight reload (stretch; off unless attach_ps is called)
         self._ps_addr: Optional[Tuple[str, int]] = None
@@ -462,6 +637,14 @@ class ServingEngine:
             "prefill_batched_requests": 0, "prefill_batch_size_mean": None,
             "prefill_tokens": 0,
             "h2d_transfers": 0, "d2h_transfers": 0,
+            # speculative-decoding observables, the same vocabulary as
+            # speculative_generate's per-run stats dict: ``drafted`` /
+            # ``accepted`` count draft proposals and accepted prefix
+            # tokens, ``verify_calls`` the batched target verify forwards
+            # (``target_calls`` mirrors it verbatim so offline and serving
+            # speculation report through one key set)
+            "drafted": 0, "accepted": 0,
+            "verify_calls": 0, "target_calls": 0,
         }
 
     # ------------------------------------------------------------------ jit
@@ -547,11 +730,144 @@ class ServingEngine:
 
         return jax.jit(step, donate_argnums=(1, 3))
 
+    def _build_spec_fn(self):
+        """The speculative decode round — ONE jitted program replacing the
+        plain device step when ``spec_draft`` is set: k = ``spec_len``
+        per-row draft steps (the draft's own slot pool, same slot
+        indices), ONE batched L = k + 1 target verify forward, per-row
+        accept/commit — greedy rows take the longest drafted prefix
+        matching the target's own argmax plus the correction/bonus token
+        (so their committed chain IS the target argmax chain, token-
+        identical to non-speculative greedy); sampled rows run the
+        Leviathan/Chen rejection rule against identically-warped
+        distributions with keys folded per (position, purpose), so the
+        committed distribution is exactly the warped target's — then a
+        draft back-fill step for the full-accept cache hole and the
+        device state advance.  Accept lengths are heterogeneous: row r
+        advances ``n_r`` in 1..k+1 positions per round.  The output packs
+        row r's committed tokens (first ``n_r`` of k+1 columns valid)
+        plus ``n_r`` in the last column — ONE drained array per round,
+        preserving the one-d2h-per-iteration discipline.
+
+        Rejected-position cache entries are never rolled back: the next
+        round's writes start at each row's new frontier and overwrite
+        them in-program before any query can attend that far (the same
+        no-rollback argument as ``speculative_generate``; on rolling
+        pools the ring's ``spec_len`` slack slots keep the oldest query's
+        window intact under the L-token write)."""
+        model, rolling = self.model, self.rolling
+        draft = self._draft_model
+        k = self.spec_len
+
+        def fold(keys, idx, tag):
+            # per-(row, absolute position, purpose) keys: tag 1 = draft
+            # proposal, 2 = accept uniform, 3 = residual/bonus draw.  A
+            # position's draws are pure functions of (request key, index),
+            # so re-drafting an index after a rejection reuses bits that
+            # never influenced any committed token — exactness holds
+            ks = jax.vmap(jax.random.fold_in)(keys, idx)
+            return jax.vmap(jax.random.fold_in)(ks, jnp.full_like(idx, tag))
+
+        def round_(params, dparams, caches, dcaches, tok, pos, act, temp,
+                   topk, topp, keys):
+            b = tok.shape[0]
+            sampled = temp > 0.0
+            safe_t = jnp.where(sampled, temp, 1.0)
+
+            def warp(l):
+                return _dec.filter_logits_batched(l / safe_t[:, None],
+                                                  topk, topp)
+
+            # -- draft phase: k per-row single-token steps, own pool
+            d_toks, q_logits = [], []
+            t = tok
+            for i in range(k):
+                dl, dcaches = _dec.decode_step(draft, dparams, dcaches, t,
+                                               pos + i)
+                wl = warp(dl)
+                prop = jax.vmap(jax.random.categorical)(
+                    fold(keys, pos + i + 1, 1), wl).astype(jnp.int32)
+                t = jnp.where(sampled, prop,
+                              jnp.argmax(dl, axis=-1).astype(jnp.int32))
+                d_toks.append(t)
+                q_logits.append(wl)
+            drafted = jnp.stack(d_toks, axis=1)                   # (B, k)
+
+            # -- verify: one batched target forward over [cur, d_1..d_k];
+            # logits[:, i] scores the token following fed position i, so a
+            # fully-accepted row still has a bonus distribution at index k
+            fed = jnp.concatenate([tok[:, None], drafted], axis=1)
+            logits, caches = _dec._forward(model, params, caches, fed, pos,
+                                           rolling)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            # greedy accept: longest drafted prefix matching the target's
+            # argmax; the committed chain is the argmax chain itself
+            match = drafted == greedy[:, :k]
+            a_g = jnp.sum(jnp.cumprod(match.astype(jnp.int32), 1), 1)
+
+            # sampled accept: accept x ~ q with prob min(1, p(x)/q(x));
+            # first rejection redraws from norm(max(p - q, 0)), a full
+            # accept draws the bonus from warped p — all per row
+            pk = jnp.reshape(_dec.filter_logits_batched(
+                jnp.reshape(logits[:, :k] / safe_t[:, None, None],
+                            (b * k, -1)),
+                jnp.repeat(topk, k), jnp.repeat(topp, k)), (b, k, -1))
+            p_probs = jax.nn.softmax(pk, axis=-1)
+            q_probs = jax.nn.softmax(jnp.stack(q_logits, 1), axis=-1)
+            px = jnp.take_along_axis(p_probs, drafted[..., None],
+                                     axis=-1)[..., 0]
+            qx = jnp.take_along_axis(q_probs, drafted[..., None],
+                                     axis=-1)[..., 0]
+            u = jnp.stack(
+                [jax.vmap(lambda kk: jax.random.uniform(kk, ()))(
+                    fold(keys, pos + i + 1, 2)) for i in range(k)], axis=1)
+            accept = u * jnp.maximum(qx, 1e-30) < px
+            a_s = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), 1), 1)
+            ai = jnp.clip(a_s, 0, k - 1)
+            p_a = jnp.take_along_axis(p_probs, ai[:, None, None], 1)[:, 0]
+            q_a = jnp.take_along_axis(q_probs, ai[:, None, None], 1)[:, 0]
+            res = jnp.maximum(p_a - q_a, 0.0)
+            rsum = jnp.sum(res, axis=-1, keepdims=True)
+            # res == 0 iff p <= q everywhere, i.e. p == q: fall back to p
+            res = jnp.where(rsum > 0.0, res / jnp.maximum(rsum, 1e-38),
+                            p_a)
+            bonus = jax.nn.softmax(warp(logits[:, k]), axis=-1)
+            dist = jnp.where((a_s == k)[:, None], bonus, res)
+            corr = jax.vmap(jax.random.categorical)(
+                fold(keys, pos + a_s + 1, 3),
+                jnp.log(jnp.maximum(dist, 1e-38))).astype(jnp.int32)
+            committed_s = jnp.concatenate(
+                [drafted, jnp.zeros((b, 1), jnp.int32)], axis=1)
+            committed_s = committed_s.at[jnp.arange(b), a_s].set(corr)
+
+            # -- per-row heterogeneous commit + device state advance
+            a = jnp.where(sampled, a_s, a_g)
+            committed = jnp.where(sampled[:, None], committed_s, greedy)
+            n = jnp.where(act, a + 1, 0)
+            last = jnp.take_along_axis(committed, a[:, None], axis=1)[:, 0]
+            new_tok = jnp.where(act, last, tok)
+            new_pos = jnp.where(act, pos + n, pos)
+
+            # draft back-fill: d_k at pos + k — the full-accept rows' cache
+            # hole (the committed bonus's predecessor, never fed to the
+            # draft); for every other row pos + k is at or past its new
+            # frontier, where the junk is masked until overwritten
+            _, dcaches = _dec.decode_step(draft, dparams, dcaches,
+                                          d_toks[-1], pos + k)
+
+            out = jnp.concatenate([committed, n[:, None]], axis=1)
+            return out, caches, dcaches, new_tok, new_pos
+
+        return jax.jit(round_, donate_argnums=(2, 3, 4, 5))
+
     def _build_bucket_fn(self, width: int):
         model, rolling = self.model, self.rolling
+        draft = self._draft_model
 
-        def run(params, pool, tok, pos, act, temp, topk, topp, keys,
-                prompts, p_lens, slots, r_temp, r_topk, r_topp, r_keys):
+        def prefill(params, dparams, pool, dpool, tok, pos, act, temp,
+                    topk, topp, keys, prompts, p_lens, slots, r_temp,
+                    r_topk, r_topp, r_keys):
             rows = init_cache(model, prompts.shape[0], width)
             # right-padded batch: the causal mask alone keeps pad keys out
             # of every real row (see _mha_forward), and the pad slots each
@@ -563,83 +879,101 @@ class ServingEngine:
                                        axis=1)[:, 0]
             first = _dec.sample_logits_batched(last, p_lens - 1, r_temp,
                                                r_keys, r_topk, r_topp)
-            new_pool = []
-            for big, row in zip(pool, rows):
-                if big is None:
-                    new_pool.append(None)
-                    continue
-                if rolling:
-                    w = big["k"].shape[1]
-                    ring = {n: _dec.ring_from_prefill(row[n], p_lens, w)
-                            for n in ("k", "v")}
-                    new_pool.append(
-                        {n: big[n].at[slots].set(ring[n], mode="drop")
-                         for n in ("k", "v")})
-                else:
-                    new_pool.append(
-                        {n: big[n].at[slots, :width].set(row[n],
-                                                         mode="drop")
-                         for n in ("k", "v")})
-            return (first, new_pool,
-                    tok.at[slots].set(first, mode="drop"),
+            out = [first,
+                   [_commit_rows(big, row, slots, width, rolling, p_lens)
+                    for big, row in zip(pool, rows)]]
+            if draft is not None:
+                # the draft shares the slot layout: prefill its pool from
+                # the same prompts (logits unused — the draft's LM head
+                # dead-code-eliminates out of this program)
+                drows = init_cache(draft, prompts.shape[0], width)
+                _, drows = _dec._forward(draft, dparams, drows, prompts, 0)
+                out.append(
+                    [_commit_rows(big, row, slots, width, False, p_lens)
+                     for big, row in zip(dpool, drows)])
+            out += [tok.at[slots].set(first, mode="drop"),
                     pos.at[slots].set(p_lens, mode="drop"),
                     act.at[slots].set(True, mode="drop"),
                     temp.at[slots].set(r_temp, mode="drop"),
                     topk.at[slots].set(r_topk, mode="drop"),
                     topp.at[slots].set(r_topp, mode="drop"),
-                    keys.at[slots].set(r_keys, mode="drop"))
+                    keys.at[slots].set(r_keys, mode="drop")]
+            return tuple(out)
+
+        if draft is not None:
+            return jax.jit(prefill, donate_argnums=(2, 3))
+
+        def run(params, pool, *rest):
+            return prefill(params, None, pool, None, *rest)
 
         return jax.jit(run, donate_argnums=(1,))
 
     def _build_stage_fn(self, width: int):
-        model = self.model
+        model, draft = self.model, self._draft_model
 
-        def run(params, staging, toks, offset):
+        def stage(params, staging, toks, offset):
             # mid chunk: cache writes only — the logits (and the whole
             # LM-head matmul) dead-code-eliminate
             _, staging = _dec._forward(model, params, staging, toks, offset)
             return staging
 
-        return jax.jit(run, donate_argnums=(1,))
+        if draft is None:
+            return jax.jit(stage, donate_argnums=(1,))
+
+        def stage_spec(params, dparams, staging, d_staging, toks, offset):
+            _, staging = _dec._forward(model, params, staging, toks, offset)
+            _, d_staging = _dec._forward(draft, dparams, d_staging, toks,
+                                         offset)
+            return staging, d_staging
+
+        return jax.jit(stage_spec, donate_argnums=(2, 3))
 
     def _build_final_fn(self, width: int):
         model, rolling = self.model, self.rolling
+        draft = self._draft_model
 
-        def run(params, pool, tok, pos, act, temp, topk, topp, keys,
-                staging, toks, slot, offset, last_idx, p_len,
-                r_temp, r_topk, r_topp, r_key):
+        def final(params, dparams, pool, dpool, tok, pos, act, temp, topk,
+                  topp, keys, staging, d_staging, toks, slot, offset,
+                  last_idx, p_len, r_temp, r_topk, r_topp, r_key):
             logits, staging = _dec._forward(model, params, staging, toks,
                                             offset)
             first = _dec.sample_logits_batched(
                 logits[0, last_idx][None], jnp.asarray(p_len - 1)[None],
                 r_temp, r_key, r_topk, r_topp)
             p_row = jnp.asarray(p_len)[None]
-            new_pool = []
-            for big, row in zip(pool, staging):
-                if big is None:
-                    new_pool.append(None)
-                    continue
-                if rolling:
-                    w = big["k"].shape[1]
-                    row = {n: _dec.ring_from_prefill(row[n], p_row, w)
-                           for n in ("k", "v")}
-                # full-row commit: atomically replaces whatever junk the
-                # free slot's decode passes wrote while chunks staged
-                new_pool.append(
-                    {n: big[n].at[slot].set(row[n][0], mode="drop")
-                     for n in ("k", "v")})
-            return (first, new_pool,
-                    tok.at[slot].set(first[0], mode="drop"),
+            # full-row commit: atomically replaces whatever junk the free
+            # slot's decode passes wrote while chunks staged
+            out = [first,
+                   [_commit_full_row(big, row, slot, rolling, p_row)
+                    for big, row in zip(pool, staging)]]
+            if draft is not None:
+                _, d_staging = _dec._forward(draft, dparams, d_staging,
+                                             toks, offset)
+                out.append([_commit_full_row(big, row, slot, False, p_row)
+                            for big, row in zip(dpool, d_staging)])
+            out += [tok.at[slot].set(first[0], mode="drop"),
                     pos.at[slot].set(p_len, mode="drop"),
                     act.at[slot].set(True, mode="drop"),
                     temp.at[slot].set(r_temp[0], mode="drop"),
                     topk.at[slot].set(r_topk[0], mode="drop"),
                     topp.at[slot].set(r_topp[0], mode="drop"),
-                    keys.at[slot].set(r_key[0], mode="drop"))
+                    keys.at[slot].set(r_key[0], mode="drop")]
+            return tuple(out)
 
         # staging is NOT donated: the ring relayout is a gather whose
         # output shape differs from the staging buffer, so XLA could not
         # reuse it anyway (it dies with the program instead)
+        if draft is not None:
+            return jax.jit(final, donate_argnums=(2, 3))
+
+        def run(params, pool, tok, pos, act, temp, topk, topp, keys,
+                staging, toks, slot, offset, last_idx, p_len,
+                r_temp, r_topk, r_topp, r_key):
+            return final(params, None, pool, None, tok, pos, act, temp,
+                         topk, topp, keys, staging, None, toks, slot,
+                         offset, last_idx, p_len, r_temp, r_topk, r_topp,
+                         r_key)
+
         return jax.jit(run, donate_argnums=(1,))
 
     # ----------------------------------------------------- device traffic
@@ -657,16 +991,33 @@ class ServingEngine:
         return np.asarray(arr)
 
     def _state_args(self):
-        return (self.caches, self._dev_tok, self._dev_pos, self._dev_act,
-                self._dev_temp, self._dev_topk, self._dev_topp,
-                self._dev_keys)
+        if self._draft_model is None:
+            return (self.caches, self._dev_tok, self._dev_pos,
+                    self._dev_act, self._dev_temp, self._dev_topk,
+                    self._dev_topp, self._dev_keys)
+        return (self.caches, self.d_caches, self._dev_tok, self._dev_pos,
+                self._dev_act, self._dev_temp, self._dev_topk,
+                self._dev_topp, self._dev_keys)
+
+    def _prog_args(self):
+        """Leading arguments of every prefill program: params (+ draft
+        params under speculation) then the device-resident state."""
+        if self._draft_model is None:
+            return (self.params,) + self._state_args()
+        return (self.params, self._draft_params) + self._state_args()
 
     def _apply_state(self, res):
-        """Unpack a prefill program's ``(first, pool, *state)`` result,
-        installing the new device arrays; returns ``first``."""
-        (first, self.caches, self._dev_tok, self._dev_pos, self._dev_act,
-         self._dev_temp, self._dev_topk, self._dev_topp,
-         self._dev_keys) = res
+        """Unpack a prefill program's ``(first, pool[, draft pool],
+        *state)`` result, installing the new device arrays; returns
+        ``first``."""
+        if self._draft_model is None:
+            (first, self.caches, self._dev_tok, self._dev_pos,
+             self._dev_act, self._dev_temp, self._dev_topk,
+             self._dev_topp, self._dev_keys) = res
+        else:
+            (first, self.caches, self.d_caches, self._dev_tok,
+             self._dev_pos, self._dev_act, self._dev_temp, self._dev_topk,
+             self._dev_topp, self._dev_keys) = res
         return first
 
     def _sampling_row(self, h: RequestHandle):
@@ -988,7 +1339,7 @@ class ServingEngine:
                 self.stats["prefill_tokens"] += p
                 entries.append((slot, h))
             first = self._apply_state(self._bucket_fn(width)(
-                self.params, *self._state_args(), self._put(prompts),
+                *self._prog_args(), self._put(prompts),
                 self._put(p_lens), self._put(slots), self._put(r_temp),
                 self._put(r_topk), self._put(r_topp), self._put(r_keys)))
             self.stats["prefill_batches"] += 1
@@ -1006,7 +1357,9 @@ class ServingEngine:
         h.started_at = time.perf_counter()
         self._handles[slot] = h
         staging = init_cache(self.model, 1, self.max_len)
-        self._prefilling[slot] = _PrefillJob(h, staging)
+        d_staging = (init_cache(self._draft_model, 1, self.max_len)
+                     if self._draft_model is not None else None)
+        self._prefilling[slot] = _PrefillJob(h, staging, d_staging)
         self.stats["prefills"] += 1
         self.stats["slot_requests"][slot] += 1
         self._advance_chunk(slot)
@@ -1031,13 +1384,25 @@ class ServingEngine:
         self.stats["prefill_chunks"] += 1
         self.stats["prefill_tokens"] += real
         if not final:
-            job.staging = self._stage_fn(width)(
-                self.params, job.staging, toks_d, offset)
+            if self._draft_model is not None:
+                job.staging, job.d_staging = self._stage_fn(width)(
+                    self.params, self._draft_params, job.staging,
+                    job.d_staging, toks_d, offset)
+            else:
+                job.staging = self._stage_fn(width)(
+                    self.params, job.staging, toks_d, offset)
         else:
-            first = self._apply_state(self._final_fn(width)(
-                self.params, *self._state_args(), job.staging, toks_d,
-                slot, offset, real - 1, p_len, *self._sampling_row(h)))
+            if self._draft_model is not None:
+                first = self._apply_state(self._final_fn(width)(
+                    *self._prog_args(), job.staging, job.d_staging,
+                    toks_d, slot, offset, real - 1, p_len,
+                    *self._sampling_row(h)))
+            else:
+                first = self._apply_state(self._final_fn(width)(
+                    *self._prog_args(), job.staging, toks_d,
+                    slot, offset, real - 1, p_len, *self._sampling_row(h)))
             job.staging = None
+            job.d_staging = None
         job.written += real
         if final:
             del self._prefilling[slot]
@@ -1137,6 +1502,23 @@ class ServingEngine:
         # iteration later by _drain_pending (one-step lookahead)
         entries = [(int(s), self._handles[s])
                    for s in np.flatnonzero(self._active)]
+        if self._draft_model is not None:
+            # speculative round: k draft steps + one batched verify in ONE
+            # program; rows commit 1..spec_len+1 tokens each, packed with
+            # their per-row counts into the one drained array
+            (out, self.caches, self.d_caches, self._dev_tok,
+             self._dev_pos) = self._spec_fn(
+                self.params, self._draft_params, self.caches,
+                self.d_caches, self._dev_tok, self._dev_pos,
+                self._dev_act, self._dev_temp, self._dev_topk,
+                self._dev_topp, self._dev_keys)
+            self.stats["decode_steps"] += 1
+            self.stats["verify_calls"] += 1
+            self.stats["target_calls"] += 1
+            self.stats["drafted"] += self.spec_len * len(entries)
+            self.stats["active_slot_steps"] += len(entries)
+            self._pending.append(("spec", out, entries))
+            return
         out, self.caches, self._dev_pos = self._decode_fn(
             self.params, *self._state_args())
         self._dev_tok = out
@@ -1158,6 +1540,23 @@ class ServingEngine:
             vals = self._fetch(arr)
             for i, (slot, h) in enumerate(entries):
                 if h.finish is not None or self._handles[slot] is not h:
+                    continue
+                if kind == "spec":
+                    # row ``slot`` committed n tokens this round (its
+                    # per-row accept length + 1); emit in order, stopping
+                    # the moment eos/length retires the request — the
+                    # round's trailing tokens die here, like any
+                    # lookahead junk
+                    n = int(vals[slot, -1])
+                    self.stats["accepted"] += max(n - 1, 0)
+                    self._positions[slot] += n
+                    for j in range(n):
+                        token = int(vals[slot, j])
+                        self._cur_tok[slot] = token
+                        self._emit(slot, token)
+                        if (h.finish is not None
+                                or self._handles[slot] is not h):
+                            break
                     continue
                 token = int(vals[slot] if kind == "decode" else vals[i])
                 if kind == "decode":
@@ -1345,10 +1744,27 @@ class ServingEngine:
             prefills_per_step=self.prefills_per_step, rolling=self.rolling,
             default_deadline_s=self.default_deadline_s,
             prefill_mode=self.prefill_mode,
-            prefill_chunk=self.prefill_chunk)
+            prefill_chunk=self.prefill_chunk,
+            spec_draft=(None if self._draft_model is None
+                        else (self._draft_model, self._draft_params)),
+            spec_len=self.spec_len, quantize=self.quantize,
+            kv_dtype=self.kv_dtype)
+        # quantized clones re-quantize idempotently; the f32 skeleton the
+        # hot-reload path maps pulled weights onto carries over as-is
+        # (the clone's params are already quantized, so it could not
+        # rebuild the pre-quant dtypes itself)
+        if self._fp_skel is not None:
+            eng._fp_skel = self._fp_skel
         if self._ps_addr is not None:
             eng.attach_ps(*self._ps_addr, every=self._reload_every)
         return eng
+
+    @property
+    def kv_pool_bytes(self) -> int:
+        """On-device bytes of the target KV slot pool (int8 codes + scales
+        for ``kv_dtype="int8"`` pools, itemsize-true otherwise) — the
+        byte-accounting behind ``serving_quant_capacity_slots``."""
+        return _quant.kv_cache_bytes(self.caches)
 
     def warmup(self) -> "ServingEngine":
         """Compile the engine's jitted programs before serving traffic: the
@@ -1383,17 +1799,31 @@ class ServingEngine:
                                               jnp.int32(0))
             jax.block_until_ready(jax.tree_util.tree_leaves(self.caches)[0])
             return self
-        # bucketed: one all-slots-inactive decode step...
-        out, self.caches, self._dev_pos = self._decode_fn(
-            self.params, *self._state_args())
-        self._dev_tok = out
-        jax.block_until_ready(out)
-        # ...every bucket's batched prefill program (all rows dropped)...
+        # bucketed: one all-slots-inactive decode step (the speculative
+        # round — draft steps + verify + back-fill — when a draft is
+        # attached: a respawn under live traffic must pay zero jit on its
+        # first real round)...
+        if self._draft_model is not None:
+            (_, self.caches, self.d_caches, self._dev_tok,
+             self._dev_pos) = self._spec_fn(
+                self.params, self._draft_params, self.caches,
+                self.d_caches, self._dev_tok, self._dev_pos,
+                self._dev_act, self._dev_temp, self._dev_topk,
+                self._dev_topp, self._dev_keys)
+            jax.block_until_ready(self._dev_tok)
+        else:
+            out, self.caches, self._dev_pos = self._decode_fn(
+                self.params, *self._state_args())
+            self._dev_tok = out
+            jax.block_until_ready(out)
+        # ...every bucket's batched prefill program (all rows dropped;
+        # quantized pools and draft-pool prefill compile here too — the
+        # commit/quantize paths live inside these same programs)...
         nb = self.prefills_per_step
         drop = jnp.full((nb,), self.num_slots, jnp.int32)
         for width in self._buckets:
             self._apply_state(self._bucket_fn(width)(
-                self.params, *self._state_args(),
+                *self._prog_args(),
                 jnp.zeros((nb, width), jnp.int32),
                 jnp.ones((nb,), jnp.int32), drop,
                 jnp.zeros((nb,), jnp.float32), jnp.zeros((nb,), jnp.int32),
@@ -1408,11 +1838,21 @@ class ServingEngine:
             for width in sorted({self._chunk_width, *self._buckets}):
                 toks = jnp.zeros((1, width), jnp.int32)
                 staging = init_cache(self.model, 1, self.max_len)
-                staging = self._stage_fn(width)(self.params, staging,
-                                                toks, 0)
-                self._apply_state(self._final_fn(width)(
-                    self.params, *self._state_args(), staging, toks,
-                    self.num_slots, 0, 0, 1, *one))
+                if self._draft_model is not None:
+                    d_staging = init_cache(self._draft_model, 1,
+                                           self.max_len)
+                    staging, d_staging = self._stage_fn(width)(
+                        self.params, self._draft_params, staging,
+                        d_staging, toks, 0)
+                    self._apply_state(self._final_fn(width)(
+                        *self._prog_args(), staging, d_staging, toks,
+                        self.num_slots, 0, 0, 1, *one))
+                else:
+                    staging = self._stage_fn(width)(self.params, staging,
+                                                    toks, 0)
+                    self._apply_state(self._final_fn(width)(
+                        *self._prog_args(), staging, toks,
+                        self.num_slots, 0, 0, 1, *one))
         jax.block_until_ready(jax.tree_util.tree_leaves(self.caches)[0])
         return self
 
@@ -1457,8 +1897,17 @@ class ServingEngine:
             networking.send_opcode(self._reload_sock, b"p")
             msg = networking.recv_data(self._reload_sock,
                                        pool=self._reload_pool)
-            self.params = self.model.set_weights(self.params,
-                                                 msg["weights"])
+            if self.quantize is not None:
+                # re-quantize the pulled center through the SAME path the
+                # constructor used — never swap raw fp32 weights into a
+                # quantized engine (the f32 skeleton maps the flat wire
+                # list back onto the pre-quant pytree first)
+                fresh = self.model.set_weights(self._fp_skel,
+                                               msg["weights"])
+                self.params = _quantize_weights(fresh, self.quantize)
+            else:
+                self.params = self.model.set_weights(self.params,
+                                                     msg["weights"])
             if self.prefill_mode == "bucketed":
                 # keep the weights device-resident: the decode loop's
                 # zero-upload contract must survive a reload

@@ -48,20 +48,31 @@ PROMPT_LENGTHS = (4, 6, 8)
 def make_trace(num_requests: int, seed: int = 0, vocab: int = 16,
                num_steps: int = 16, temperature: float = 0.0,
                sampled_fraction: float = 0.5,
-               prompt_lengths: Sequence[int] = PROMPT_LENGTHS
-               ) -> List[Dict[str, Any]]:
+               prompt_lengths: Sequence[int] = PROMPT_LENGTHS,
+               pattern: str = "random") -> List[Dict[str, Any]]:
     """A deterministic request trace: seeded prompt contents + lengths, a
     ``sampled_fraction`` of requests sampling at ``temperature`` (per-
     request seeds), the rest greedy — so the slot batch always mixes
     sampling configs, exercising the per-slot sampler.  ``prompt_lengths``
     overrides the drawn length set (the long-prompt TTFT legs use lengths
-    past the engine's ``prefill_chunk`` to exercise chunked prefill)."""
+    past the engine's ``prefill_chunk`` to exercise chunked prefill).
+
+    ``pattern="arith"`` draws each prompt as a seeded-start x+1 (mod
+    vocab) run instead of iid tokens — in-distribution for the
+    ``build_spec_engine`` trained pair, the way real serving prompts are
+    in-distribution for a production draft (speculation's accept rate,
+    and therefore its win, is a property of the traffic)."""
     rng = np.random.default_rng(seed)
     trace = []
     for i in range(int(num_requests)):
         p_len = int(prompt_lengths[rng.integers(0, len(prompt_lengths))])
+        if pattern == "arith":
+            start = int(rng.integers(0, vocab))
+            prompt = ((start + np.arange(p_len)) % vocab).astype(np.int32)
+        else:
+            prompt = rng.integers(0, vocab, p_len).astype(np.int32)
         req: Dict[str, Any] = {
-            "prompt": rng.integers(0, vocab, p_len).astype(np.int32),
+            "prompt": prompt,
             "num_steps": int(num_steps),
             "seed": int(seed * 10_000 + i),
         }
@@ -106,6 +117,12 @@ def _metrics(engine, latencies: List[float], wall_s: float,
         "deadline_miss_rate": round(s["requests_expired"] / submitted, 4),
         "slot_reclaim_ms": (round(float(np.mean(s["slot_reclaim_ms"])), 3)
                             if s["slot_reclaim_ms"] else None),
+        # speculative-decoding observables (None unless spec_draft is on):
+        # accept rate = accepted draft tokens / drafted, the knob that
+        # decides whether spec_len is paying for itself
+        "spec_accept_rate": (round(s["accepted"] / s["drafted"], 4)
+                             if s["drafted"] else None),
+        "spec_verify_calls": s["verify_calls"] or None,
     }
 
 
@@ -279,13 +296,23 @@ def build_engine(num_slots: int = 4, max_len: int = 32, vocab: int = 16,
                  queue_capacity: int = 64, seed: int = 0,
                  prefill_mode: str = "bucketed",
                  prefill_chunk: Optional[int] = None,
-                 prefills_per_step: Optional[int] = None):
+                 prefills_per_step: Optional[int] = None,
+                 spec_draft: Optional[str] = None,
+                 spec_len: Optional[int] = None,
+                 quantize: Optional[str] = None,
+                 kv_dtype: Optional[str] = None):
     """A small random-weight LM + engine (throughput benches measure
     scheduling and batching, not model quality) — one place so bench,
     tests, and the CLI agree on the workload shape.  ``prefill_mode``/
     ``prefill_chunk``/``prefills_per_step`` pass through to the engine
     (the TTFT comparison legs run the same trace through ``"bucketed"``
-    and ``"eager"``)."""
+    and ``"eager"``).
+
+    ``spec_draft``: ``"self"`` uses the target as its own draft (high
+    accept rate — the round-collapsing win is real because the whole
+    draft+verify round is ONE dispatch), or an int layer count for a
+    separate random-weight draft (near-floor accept rate — the worst
+    case).  ``spec_len``/``quantize``/``kv_dtype`` pass through."""
     import jax
 
     from distkeras_tpu.core.model import FittedModel
@@ -302,9 +329,68 @@ def build_engine(num_slots: int = 4, max_len: int = 32, vocab: int = 16,
         kw["prefill_chunk"] = int(prefill_chunk)
     if prefills_per_step is not None:
         kw["prefills_per_step"] = int(prefills_per_step)
+    if spec_draft is not None:
+        if str(spec_draft) == "self":
+            kw["spec_draft"] = fitted
+        else:
+            dm = transformer_lm(vocab_size=vocab, seq_len=max_len,
+                                d_model=32, num_heads=4,
+                                num_layers=int(spec_draft), mlp_dim=64,
+                                compute_dtype="float32")
+            kw["spec_draft"] = FittedModel(
+                dm, dm.init(jax.random.PRNGKey(seed + 1), (max_len,)))
+    if spec_len is not None:
+        kw["spec_len"] = int(spec_len)
+    if quantize is not None:
+        kw["quantize"] = quantize
+    if kv_dtype is not None:
+        kw["kv_dtype"] = kv_dtype
     engine = ServingEngine(fitted, num_slots=num_slots, max_len=max_len,
                            queue_capacity=queue_capacity, **kw)
     return fitted, engine
+
+
+def build_spec_engine(num_slots: int = 4, max_len: int = 32,
+                      vocab: int = 16, queue_capacity: int = 64,
+                      spec_len: int = 4, num_epoch: int = 25,
+                      seed: int = 0, **engine_kw):
+    """A TRAINED (2-layer target, 1-layer draft) pair on the
+    deterministic x+1 token task + a speculative engine over them — the
+    honest speculative configuration: the draft is roughly half the
+    target's compute yet proposes what the target would emit (accept
+    rate ≳ 0.8 — tests/test_speculative.py trains the same pair), so a
+    round commits ~``spec_len`` tokens for less than ``spec_len + 1``
+    target-step-equivalents of compute ON TOP of collapsing the round to
+    one dispatch.  ``bench.py``'s ``serving_spec_*`` leg runs this
+    against the plain fast path (identical architecture, so service
+    times are comparable)."""
+    import jax  # noqa: F401  (platform init before model building)
+
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.models import transformer_lm
+    from distkeras_tpu.serving import ServingEngine
+    from distkeras_tpu.trainers import SingleTrainer
+
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, vocab, (256, 12)).astype(np.int32)
+    y = (x + 1) % vocab
+
+    def train(layers):
+        model = transformer_lm(vocab_size=vocab, seq_len=max_len,
+                               d_model=32, num_heads=4, num_layers=layers,
+                               mlp_dim=64, compute_dtype="float32")
+        t = SingleTrainer(
+            model, batch_size=32, num_epoch=num_epoch,
+            loss="sparse_categorical_crossentropy_from_logits",
+            worker_optimizer="adam", learning_rate=3e-3)
+        return t.train(Dataset({"features": x, "label": y}))
+
+    target, draft = train(2), train(1)
+    engine = ServingEngine(target, num_slots=num_slots, max_len=max_len,
+                           queue_capacity=queue_capacity,
+                           spec_draft=draft, spec_len=spec_len,
+                           **engine_kw)
+    return target, draft, engine
 
 
 def main():
@@ -338,11 +424,28 @@ def main():
                     help="print a dedicated time-to-first-token percentile "
                          "line (p50/p99 + prefill counters) for the "
                          "closed loop")
+    ap.add_argument("--spec-draft", type=str, default=None,
+                    help="speculative decoding: 'self' (target drafts for "
+                         "itself — high accept) or an int layer count for "
+                         "a separate random-weight draft model")
+    ap.add_argument("--spec-len", type=int, default=None,
+                    help="draft tokens per speculative round "
+                         "(rows commit 1..spec_len+1 tokens per round)")
+    ap.add_argument("--quantize", choices=("int8", "bf16"), default=None,
+                    help="weight quantization applied at engine build "
+                         "(and to every hot-reload pull)")
+    ap.add_argument("--kv-dtype", choices=("int8",), default=None,
+                    help="int8 KV slot pool (codes + per-entry scales, "
+                         "~half the slot bytes)")
     args = ap.parse_args()
 
     fitted, engine = build_engine(num_slots=args.slots,
                                   prefill_mode=args.prefill_mode,
-                                  prefill_chunk=args.prefill_chunk)
+                                  prefill_chunk=args.prefill_chunk,
+                                  spec_draft=args.spec_draft,
+                                  spec_len=args.spec_len,
+                                  quantize=args.quantize,
+                                  kv_dtype=args.kv_dtype)
     trace = make_trace(args.requests, num_steps=args.steps,
                        temperature=args.temperature)
     try:
@@ -353,6 +456,13 @@ def main():
                                  deadline_s=args.deadline)
         print(json.dumps({"mode": "closed_loop",
                           "concurrency": args.concurrency, **closed}))
+        if args.spec_draft is not None:
+            print(json.dumps({
+                "mode": "spec", "spec_draft": args.spec_draft,
+                "accept_rate": closed["spec_accept_rate"],
+                "drafted": engine.stats["drafted"],
+                "accepted": engine.stats["accepted"],
+                "verify_calls": engine.stats["verify_calls"]}))
         if args.ttft:
             print(json.dumps({
                 "mode": "ttft", "prefill_mode": args.prefill_mode,
@@ -372,7 +482,11 @@ def main():
         for qps in filter(None, args.qps_sweep.split(",")):
             _, engine = build_engine(num_slots=args.slots,
                                      prefill_mode=args.prefill_mode,
-                                     prefill_chunk=args.prefill_chunk)
+                                     prefill_chunk=args.prefill_chunk,
+                                     spec_draft=args.spec_draft,
+                                     spec_len=args.spec_len,
+                                     quantize=args.quantize,
+                                     kv_dtype=args.kv_dtype)
             point = run_open_loop(engine, trace, qps=float(qps))
             engine.stop()
             print(json.dumps({"mode": "open_loop", **point}))

@@ -125,3 +125,60 @@ def test_quantize_idempotent_and_count_params():
     assert isinstance(qq[0]["kernel"], QuantizedTensor)
     # logical param count unchanged by quantization
     assert model.count_params(qp) == model.count_params(params)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache quantization (the serving engine's int8 slot pool, PR 11)
+# ---------------------------------------------------------------------------
+
+def test_quantize_kv_roundtrip_and_zero_preservation():
+    from distkeras_tpu.core.quant import dequantize_kv, quantize_kv
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 5, 3, 8)), jnp.float32)
+    q, scale = quantize_kv(x)
+    assert q.dtype == jnp.int8 and scale.shape == (2, 5, 3)
+    back = np.asarray(dequantize_kv(q, scale, jnp.float32))
+    # per-entry symmetric int8: relative error bounded by scale/2 per dim
+    err = np.abs(back - np.asarray(x))
+    amax = np.abs(np.asarray(x)).max(axis=-1, keepdims=True)
+    assert (err <= amax / 127.0 * 0.51 + 1e-7).all()
+    # never-written (all-zero) entries dequantize to EXACT zeros with
+    # scale 0 — the empty-slot invariant the serving pool leans on
+    z = jnp.zeros((1, 4, 2, 8), jnp.float32)
+    qz, sz = quantize_kv(z)
+    assert (np.asarray(sz) == 0).all()
+    assert (np.asarray(dequantize_kv(qz, sz, jnp.float32)) == 0).all()
+
+
+def test_init_cache_kv_dtype_and_bytes():
+    from distkeras_tpu.core.decode import init_cache
+    from distkeras_tpu.core.quant import kv_cache_bytes
+    from distkeras_tpu.models import transformer_lm
+
+    model = transformer_lm(vocab_size=16, seq_len=32, d_model=16,
+                           num_heads=2, num_layers=2, mlp_dim=32,
+                           compute_dtype="float32")
+    model.init(jax.random.PRNGKey(0), (32,))
+    fp = init_cache(model, 4, 32)
+    q8 = init_cache(model, 4, 32, kv_dtype="int8")
+    assert set(q8[2]) == {"k", "v", "ks", "vs"}
+    assert q8[2]["k"].dtype == jnp.int8
+    # >= 1.5x slots at fixed bytes — here f32 pools give ~2.7x
+    assert kv_cache_bytes(fp) >= 1.5 * kv_cache_bytes(q8)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        init_cache(model, 1, 8, kv_dtype="int4")
+
+
+def test_init_cache_ring_slack_widens_ring():
+    from distkeras_tpu.core.decode import init_cache
+    from distkeras_tpu.models import transformer_lm
+
+    model = transformer_lm(vocab_size=16, seq_len=32, d_model=16,
+                           num_heads=2, num_layers=2, mlp_dim=32,
+                           compute_dtype="float32", attention_window=6)
+    model.init(jax.random.PRNGKey(0), (32,))
+    ring = init_cache(model, 2, 24, rolling=True)
+    slack = init_cache(model, 2, 24, rolling=True, ring_slack=4)
+    assert ring[2]["k"].shape[1] == 6
+    assert slack[2]["k"].shape[1] == 10

@@ -371,13 +371,29 @@ def test_per_row_positions_match_scalar_decode(positional):
         np.stack([np.asarray(t) for t in got], 1), want[:, 4:])
 
 
-def test_per_row_positions_reject_multi_token_steps():
+def test_per_row_multi_token_forward_matches_chain():
+    """Per-row positions with L > 1 (PR 11's speculative verify): one
+    batched forward over L tokens at each row's own offset produces the
+    same logits as L single-token per-row steps — the substrate the
+    engine's draft-then-verify round stands on."""
     fm = _fitted(seed=2)
+    prompt = jnp.asarray([[3, 4, 5], [9, 2, 7]], jnp.int32)
     caches = decode.init_cache(fm.model, 2, 16)
-    with pytest.raises(ValueError, match="single-token"):
-        decode._forward(fm.model, fm.params, caches,
-                        jnp.zeros((2, 3), jnp.int32),
-                        jnp.array([0, 0], jnp.int32))
+    logits, caches = decode._forward(fm.model, fm.params, caches, prompt, 0)
+    tok = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+    pos = jnp.array([3, 3], jnp.int32)
+    chain, toks, cc = [], [tok], caches
+    for i in range(3):
+        lg, cc = decode.decode_step(fm.model, fm.params, cc, toks[-1],
+                                    pos + i)
+        chain.append(lg)
+        toks.append(jnp.argmax(lg, -1).astype(jnp.int32))
+    fed = jnp.stack(toks[:3], axis=1)                          # (2, 3)
+    multi, _ = decode._forward(fm.model, fm.params, caches, fed, pos)
+    for i in range(3):
+        np.testing.assert_allclose(np.asarray(multi[:, i]),
+                                   np.asarray(chain[i]),
+                                   rtol=2e-5, atol=2e-5)
 
 
 def test_batched_sampler_matches_scalar_rows():

@@ -99,7 +99,10 @@ def test_bench_serving_fields_shape():
                         "serving_ttft_p50_ms", "serving_ttft_p99_ms",
                         "serving_prefill_tokens_per_sec",
                         "serving_longprompt_ttft_p99_ms",
-                        "serving_longprompt_ttft_eager_p99_ms"}
+                        "serving_longprompt_ttft_eager_p99_ms",
+                        "serving_spec_tokens_per_sec",
+                        "serving_spec_accept_rate",
+                        "serving_quant_capacity_slots"}
 
 
 def test_closed_loop_chaos_kill_schedule_no_leaks():

@@ -348,6 +348,257 @@ def test_reload_gate_requires_decode_progress(fitted):
 
 
 # ---------------------------------------------------------------------------
+# speculative decoding on the fast path (PR 11): greedy token-identity,
+# heterogeneous per-row accept lengths, stats vocabulary, warmup coverage
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def draft():
+    return _fitted(seed=99)  # independent random draft: near-floor accepts
+
+
+@pytest.mark.parametrize("draft_kind", ["self", "random"])
+@pytest.mark.parametrize("spec_len", [1, 3])
+def test_spec_greedy_token_identity_vs_eager(fitted, draft, draft_kind,
+                                             spec_len):
+    """The tentpole contract: greedy speculation is TOKEN-IDENTICAL to
+    the non-speculative engine whatever the draft proposes — a self-draft
+    (high accept: rows ride the fast lane) and an independent random
+    draft (near-floor accept: every round falls back to the correction
+    token) both reproduce the eager reference bit for bit, with MIXED
+    prompt lengths (so mixed accept lengths) sharing one batch."""
+    d = fitted if draft_kind == "self" else draft
+    subs = [(np.arange(1, 1 + p, dtype=np.int32) % VOCAB, 5 + p % 3)
+            for p in (2, 4, 7)]
+    eager = ServingEngine(fitted, num_slots=3, max_len=24,
+                          prefill_mode="eager", prefills_per_step=3)
+    want = [eager.submit(pr, n) for pr, n in subs]
+    eager.run_until_idle()
+    eng = ServingEngine(fitted, num_slots=3, max_len=24, spec_draft=d,
+                        spec_len=spec_len, prefills_per_step=3)
+    got = [eng.submit(pr, n) for pr, n in subs]
+    eng.run_until_idle()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(g.result(), w.result())
+    assert eng.stats["verify_calls"] >= 1
+    assert eng.stats["drafted"] >= spec_len
+    assert 0 <= eng.stats["accepted"] <= eng.stats["drafted"]
+
+
+def test_spec_rolling_token_identity(windowed):
+    """Rolling pools under speculation: the ring carries spec_len slack
+    slots so the L-token verify never overwrites the oldest query's
+    window — greedy output still matches the eager rolling reference."""
+    subs = [(np.arange(1, 8, dtype=np.int32) % VOCAB, 10),
+            (np.array([1, 2], np.int32), 6)]
+    eager = ServingEngine(windowed, num_slots=2, max_len=24, rolling=True,
+                          prefill_mode="eager", prefills_per_step=2)
+    want = [eager.submit(pr, n) for pr, n in subs]
+    eager.run_until_idle()
+    eng = ServingEngine(windowed, num_slots=2, max_len=24, rolling=True,
+                        spec_draft=windowed, spec_len=3,
+                        prefills_per_step=2)
+    # the pool ring really is window + spec_len slots
+    assert eng.caches[2]["k"].shape[1] == 6 + 3
+    got = [eng.submit(pr, n) for pr, n in subs]
+    eng.run_until_idle()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(g.result(), w.result())
+
+
+def test_spec_sampled_deterministic_and_greedy_rows_exact(fitted):
+    """A mixed greedy + sampled batch under speculation: sampled rows are
+    deterministic per seed (run twice, identical) and the GREEDY rows in
+    the same batch stay bit-identical to the eager reference — per-row
+    independence of the accept/commit machinery."""
+    subs = [((PROMPT, 8), {}),
+            ((np.array([1, 2], np.int32), 6),
+             {"temperature": 0.7, "top_k": 5, "seed": 3}),
+            ((np.arange(1, 8, dtype=np.int32), 5), {})]
+
+    def run():
+        eng = ServingEngine(fitted, num_slots=3, max_len=24,
+                            spec_draft=fitted, spec_len=4,
+                            prefills_per_step=3)
+        hs = [eng.submit(*a, **k) for a, k in subs]
+        eng.run_until_idle()
+        return [h.result() for h in hs]
+
+    rows1, rows2 = run(), run()
+    for a, b in zip(rows1, rows2):
+        np.testing.assert_array_equal(a, b)
+    eager = ServingEngine(fitted, num_slots=2, max_len=24,
+                          prefill_mode="eager", prefills_per_step=2)
+    w0 = eager.submit(*subs[0][0])
+    w2 = eager.submit(*subs[2][0])
+    eager.run_until_idle()
+    np.testing.assert_array_equal(rows1[0], w0.result())
+    np.testing.assert_array_equal(rows1[2], w2.result())
+
+
+def test_spec_chunked_prefill_and_eos(fitted):
+    """Long prompts chunk-prefill into BOTH pools (target + draft
+    staging), and eos retirement mid-round matches generate's stopping
+    semantics token for token."""
+    lp = (np.arange(1, 14, dtype=np.int32) * 3) % VOCAB
+    eng = ServingEngine(fitted, num_slots=2, max_len=32, spec_draft=fitted,
+                        spec_len=3, prefill_chunk=4)
+    h = eng.submit(lp, 8)
+    eng.run_until_idle()
+    assert eng.stats["prefill_chunks"] == 4
+    np.testing.assert_array_equal(h.result(), _want(fitted, h, max_len=32))
+
+    greedy = np.asarray(fitted.generate(PROMPT[None], 8, max_len=24))[0]
+    eos = int(greedy[len(PROMPT) + 2])
+    eng = ServingEngine(fitted, num_slots=2, max_len=24, spec_draft=fitted,
+                        spec_len=4)
+    h = eng.submit(PROMPT, 8, eos_id=eos, pad_id=1)
+    eng.run_until_idle()
+    want = np.asarray(fitted.generate(PROMPT[None], 8, eos_id=eos,
+                                      pad_id=1, max_len=24))[0]
+    np.testing.assert_array_equal(h.result(), want)
+    assert h.finish == "eos"
+
+
+def test_spec_stats_mirror_offline_vocabulary(fitted):
+    """The engine reports speculation through speculative_generate's own
+    stats keys: drafted/accepted (+ verify_calls, mirrored verbatim by
+    target_calls) — one vocabulary across offline and serving."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=24, spec_draft=fitted,
+                        spec_len=3)
+    h = eng.submit(PROMPT, 10)
+    eng.run_until_idle()
+    s = eng.stats
+    assert h.done and s["verify_calls"] >= 1
+    assert s["target_calls"] == s["verify_calls"]
+    assert s["drafted"] == 3 * s["verify_calls"]
+    assert 0 <= s["accepted"] <= s["drafted"]
+    # offline stats carry the same keys (the satellite's shared contract)
+    _, off = fitted.speculative_generate(fitted, PROMPT[None], 6,
+                                         draft_len=3, return_stats=True)
+    assert set(off) == {"target_calls", "drafted", "accepted"}
+    assert set(off) < set(s)
+
+
+def test_spec_warmup_precompiles_draft_and_verify(fitted, monkeypatch):
+    """warmup() on a speculative engine compiles the spec round (draft
+    steps + verify + back-fill), every bucket's dual-pool prefill, and
+    the chunk programs — live traffic re-traces NOTHING (the respawn-
+    under-traffic guarantee, extended to the new programs)."""
+    calls = []
+    orig = decode._forward
+
+    def counting(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(decode, "_forward", counting)
+    eng = ServingEngine(fitted, num_slots=2, max_len=24, spec_draft=fitted,
+                        spec_len=2, prefill_chunk=4,
+                        prefills_per_step=2).warmup()
+    traced = len(calls)
+    assert traced > 0
+    h1 = eng.submit(np.array([2, 3, 4], np.int32), 6)        # bucket batch
+    h2 = eng.submit((np.arange(1, 12, dtype=np.int32)) % VOCAB, 6)  # chunks
+    eng.run_until_idle()
+    assert h1.done and h2.done
+    assert len(calls) == traced, "live speculative traffic re-traced"
+
+
+def test_spec_and_quant_validation(fitted, draft):
+    with pytest.raises(ValueError, match="spec_len"):
+        ServingEngine(fitted, num_slots=1, max_len=24, spec_draft=fitted,
+                      spec_len=0)
+    with pytest.raises(ValueError, match="bit-exactness reference"):
+        ServingEngine(fitted, num_slots=1, max_len=24,
+                      prefill_mode="eager", spec_draft=fitted)
+    with pytest.raises(ValueError, match="bit-exactness reference"):
+        ServingEngine(fitted, num_slots=1, max_len=24,
+                      prefill_mode="eager", kv_dtype="int8")
+    with pytest.raises(ValueError, match="quantize"):
+        ServingEngine(fitted, num_slots=1, max_len=24, quantize="fp4")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        ServingEngine(fitted, num_slots=1, max_len=24, kv_dtype="int4")
+    small = _fitted(seed=5)
+    small.model.layers[0].input_dim = VOCAB + 1  # forge a vocab mismatch
+    with pytest.raises(ValueError, match="vocabularies differ"):
+        ServingEngine(fitted, num_slots=1, max_len=24, spec_draft=small)
+
+
+# ---------------------------------------------------------------------------
+# quantization on the fast path: int8/bf16 weights, int8 KV pool
+# ---------------------------------------------------------------------------
+
+def test_weight_quant_int8_matches_offline_quantized_generate(fitted):
+    """quantize="int8" routes construction through quantize_params: the
+    engine's output equals offline generate on the SAME quantized params
+    (lossy vs fp32, exact vs the quantized reference)."""
+    q = fitted.quantize()
+    want = np.asarray(q.generate(PROMPT[None], 8, max_len=24))[0]
+    eng = ServingEngine(fitted, num_slots=2, max_len=24, quantize="int8")
+    h = eng.submit(PROMPT, 8)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h.result(), want)
+
+
+def test_kv_int8_pool_halves_slot_bytes(fitted):
+    """The capacity math: an int8 KV pool sustains >= 1.5x the slots of
+    the full-precision pool at fixed bytes (byte-accounted, not assumed),
+    and requests still complete sanely through the quantized read/write
+    path — including under speculation (both pools quantized)."""
+    fp = ServingEngine(fitted, num_slots=4, max_len=24)
+    q8 = ServingEngine(fitted, num_slots=4, max_len=24, kv_dtype="int8")
+    per_slot_q8 = q8.kv_pool_bytes // q8.num_slots
+    assert fp.kv_pool_bytes // per_slot_q8 >= int(1.5 * fp.num_slots)
+    h = q8.submit(PROMPT, 8)
+    q8.run_until_idle()
+    row = h.result()
+    assert row.shape == (len(PROMPT) + 8,)
+    assert (0 <= row).all() and (row < VOCAB).all()
+    spec = ServingEngine(fitted, num_slots=2, max_len=24, kv_dtype="int8",
+                         spec_draft=fitted, spec_len=3, quantize="int8")
+    h2 = spec.submit(PROMPT, 8)
+    spec.run_until_idle()
+    assert h2.result().shape == (len(PROMPT) + 8,)
+    assert spec.stats["verify_calls"] >= 1
+
+
+def test_respawn_clone_carries_spec_and_quant_state(fitted, draft):
+    """The supervisor contract: a respawned clone carries the draft model,
+    spec_len, and both quantization knobs — and still warms up and
+    serves (greedy spec identity preserved across the respawn)."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=24, spec_draft=draft,
+                        spec_len=2, quantize="bf16", kv_dtype="int8")
+    clone = eng.respawn_clone().warmup()
+    assert clone.spec_len == 2 and clone.quantize == "bf16"
+    assert clone.kv_dtype == "int8"
+    assert clone._draft_model is draft.model
+    h = clone.submit(PROMPT, 4)
+    clone.run_until_idle()
+    assert h.result().shape == (len(PROMPT) + 4,)
+
+    # without quantization, the clone's greedy spec output is bit-equal
+    eng2 = ServingEngine(fitted, num_slots=2, max_len=24, spec_draft=fitted)
+    clone2 = eng2.respawn_clone()
+    h2 = clone2.submit(PROMPT, 8)
+    clone2.run_until_idle()
+    np.testing.assert_array_equal(h2.result(),
+                                  _want(fitted, h2, max_len=24))
+
+
+def test_defaults_unchanged_no_spec_counters_move(fitted):
+    """spec_draft=None / quantize=None / kv_dtype=None: the PR 9 engine,
+    bit for bit — pools keep their dtypes and the speculation counters
+    never move."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    assert "ks" not in eng.caches[2] and eng.d_caches is None
+    h = eng.submit(PROMPT, 8)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h.result(), _want(fitted, h, max_len=24))
+    assert eng.stats["drafted"] == 0 and eng.stats["verify_calls"] == 0
+
+
+# ---------------------------------------------------------------------------
 # perf smoke (slow): compiled batched prefill beats sequential eager
 # ---------------------------------------------------------------------------
 

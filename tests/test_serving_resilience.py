@@ -798,6 +798,206 @@ def test_chaos_client_stall_reclaims_via_deadline(fitted):
 
 
 # ---------------------------------------------------------------------------
+# speculation under chaos (PR 11): retiring a slot MID-draft-round must
+# free both target and draft KV rows with zero leaks
+# ---------------------------------------------------------------------------
+
+def _spec_engine(fitted, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 24)
+    return ServingEngine(fitted, spec_draft=fitted, spec_len=3, **kw)
+
+
+@pytest.mark.parametrize("spec_draft", [False, True])
+def test_cancel_mid_round_frees_slot_next_occupant_unpolluted(fitted,
+                                                              spec_draft):
+    """Cancel lands while a (speculative) round is in flight: the slot —
+    target AND draft KV rows — returns to the pool within one iteration,
+    and the next occupant's output is bit-identical to offline generate
+    (no stale draft/verify state bleeds across occupancies)."""
+    eng = (_spec_engine(fitted, num_slots=1)
+           if spec_draft else ServingEngine(fitted, num_slots=1,
+                                            max_len=24))
+    h = eng.submit(PROMPT, 16)
+    eng.step()   # prefill
+    eng.step()   # a decode/spec round dispatched (lookahead in flight)
+    eng.cancel(h)
+    eng.step()
+    assert h.finish == "cancel"
+    assert eng.stats["requests_cancelled"] == 1
+    _assert_slots_reclaimed(eng)
+    # greedy next occupant: under speculation greedy is the
+    # token-identity contract (sampled rows are distribution-exact with
+    # a different key schedule — see docs/serving.md)
+    h2 = eng.submit(OTHER, 10)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h2.result(),
+                                  _want(fitted, OTHER, 10))
+
+
+@pytest.mark.parametrize("spec_draft", [False, True])
+def test_deadline_mid_round_and_mid_chunked_prefill(fitted, spec_draft):
+    """Deadline expiry retires a speculating slot mid-run AND a chunked
+    prefill mid-flight (both pools' staging dropped) — zero leaks."""
+    build = (_spec_engine if spec_draft
+             else lambda f, **kw: ServingEngine(f, max_len=24, **kw))
+    eng = build(fitted, num_slots=1, prefill_chunk=4)
+    h = eng.submit(LONG_PROMPT, 4, deadline_s=0.05)
+    eng.step()
+    assert eng._prefilling
+    time.sleep(0.06)
+    eng.run_until_idle()
+    assert h.finish == "deadline" and not h.tokens
+    _assert_slots_reclaimed(eng)
+
+    eng = build(fitted, num_slots=2)
+    doomed = eng.submit(PROMPT, 16, deadline_s=0.05)
+    healthy = eng.submit(OTHER, 10)
+    eng.step()
+    eng.step()
+    time.sleep(0.06)
+    eng.run_until_idle()
+    assert doomed.finish == "deadline"
+    assert healthy.finish == "length"
+    np.testing.assert_array_equal(healthy.result(),
+                                  _want(fitted, OTHER, 10))
+    _assert_slots_reclaimed(eng)
+
+
+def test_disconnect_mid_round_reclaims_speculating_slot(fitted):
+    """A client RST while its request is mid-speculative-round: the wire
+    server's disconnect reclamation cancels it and both KV pools' rows
+    free — the engine keeps serving, bit-identical."""
+    eng = _spec_engine(fitted)
+    with ServingServer(eng, poll_s=0.01) as srv:
+        c = ServingClient(*srv.addr)
+        rid = c.submit(PROMPT, 16)
+        gen = c.stream(rid)
+        next(gen)
+        _hard_close(c.sock)
+        assert _wait_for(lambda: eng.stats["requests_cancelled"] >= 1)
+        assert _wait_for(lambda: not eng._active.any())
+        assert srv.disconnect_cancels >= 1
+        with ServingClient(*srv.addr) as c2:
+            np.testing.assert_array_equal(c2.generate(OTHER, 10),
+                                          _want(fitted, OTHER, 10))
+        _assert_slots_reclaimed(eng)
+        with srv._hlock:
+            assert rid not in srv._handles and rid not in srv._owner
+
+
+@pytest.mark.parametrize("fault", [
+    ChaosFault(0, 0, "reset"),
+    ChaosFault(0, 1, "cut_stream", 2),
+])
+def test_chaos_matrix_under_speculation(fitted, fault):
+    """The PR 8 chaos matrix rows re-run against a SPECULATIVE engine:
+    the faulted slot reclaims (draft pool included), the unaffected
+    concurrent request stays bit-identical to offline generate."""
+    eng = _spec_engine(fitted)
+    # greedy concurrent request: the spec-mode bit-identity contract
+    want_other = _want(fitted, OTHER, 10)
+    with ServingServer(eng, poll_s=0.01) as srv:
+        with ChaosProxy(*srv.addr, protocol="serving",
+                        faults=[fault]) as px:
+            faulted = ServingClient(*px.addr)
+            healthy = ServingClient(*srv.addr)
+            rid_h = healthy.submit(OTHER, 10)
+            with pytest.raises((ConnectionError, OSError, ValueError,
+                                QueueFull)):
+                faulted.generate(PROMPT, 16)
+            final = None
+            for tokens, done in healthy.stream(rid_h):
+                if done is not None:
+                    final = done
+            np.testing.assert_array_equal(final["row"], want_other)
+            faulted.close()
+            healthy.close()
+        assert _wait_for(lambda: not eng._active.any())
+        assert _wait_for(lambda: srv.live_connections == 0)
+        _assert_slots_reclaimed(eng)
+        with srv._hlock:
+            assert not srv._handles and not srv._owner
+
+
+def test_supervisor_restart_preserves_spec_and_quant(fitted):
+    """An engine crash under supervision: the respawned clone carries the
+    draft + quantization state (satellite contract) and the retried
+    request completes — greedy speculation still token-identical."""
+    eng = _spec_engine(fitted, kv_dtype="int8").warmup()
+    with ServingServer(eng, poll_s=0.01) as srv:
+        with EngineSupervisor(srv, heartbeat_interval=0.05,
+                              liveness_deadline=2.0) as sup:
+            with ServingClient(*srv.addr) as c:
+                def boom():
+                    raise RuntimeError("chaos: decode crashed")
+
+                eng._decode_once = boom
+                row = c.generate(
+                    PROMPT, 6,
+                    retry_policy=RetryPolicy(attempts=40, backoff=0.05))
+                np.testing.assert_array_equal(row.shape,
+                                              (len(PROMPT) + 6,))
+            new = srv.engine
+            assert new is not eng and new.dead is None
+            assert new._draft_model is eng._draft_model
+            assert new.spec_len == eng.spec_len
+            assert new.kv_dtype == "int8"
+            assert len(sup.recoveries) == 1
+            _assert_slots_reclaimed(new)
+
+
+def test_attach_ps_pull_requantizes_center(fitted):
+    """Satellite: a quantized engine's hot reload re-quantizes the pulled
+    center through quantize_params instead of swapping raw fp32 weights
+    in — post-pull params still carry QuantizedTensor kernel leaves and
+    serve the quantized numerics of the NEW weights."""
+    from distkeras_tpu.core.quant import QuantizedTensor
+
+    new_fitted = _fitted(seed=42)  # the center the fake PS serves
+    ready = threading.Event()
+    addr = {}
+
+    def one_pull_ps():
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        addr["port"] = srv.getsockname()[1]
+        ready.set()
+        try:
+            conn, _ = srv.accept()
+            while conn.recv(1) == b"p":
+                networking.send_data(
+                    conn, {"weights": new_fitted.get_weights()})
+        except OSError:
+            pass
+        finally:
+            srv.close()
+
+    t = threading.Thread(target=one_pull_ps, daemon=True)
+    t.start()
+    assert ready.wait(timeout=5.0)
+    eng = ServingEngine(fitted, num_slots=1, max_len=24, quantize="int8")
+    eng.attach_ps("127.0.0.1", addr["port"], every=1)
+    h = eng.submit(PROMPT, 6)
+    eng.run_until_idle()
+    assert h.done and eng.stats["weight_reloads"] >= 1
+    leaves = jax.tree_util.tree_leaves(
+        eng.params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+    assert any(isinstance(l, QuantizedTensor) for l in leaves), \
+        "pull swapped raw weights into a quantized engine"
+    # the engine now serves the NEW center's quantized numerics
+    want = np.asarray(new_fitted.quantize().generate(
+        OTHER[None], 5, max_len=24))[0]
+    h2 = eng.submit(OTHER, 5)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h2.result(), want)
+    eng.stop()
+    t.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
 # hot reload under PS death (claimed in PR 6's docstring, now pinned)
 # ---------------------------------------------------------------------------
 
