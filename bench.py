@@ -571,8 +571,21 @@ def serving_bench(budget_s: float = 90.0):
     with ``serving_spec_accept_rate`` (accepted/drafted), and
     ``serving_quant_capacity_slots`` — the byte-accounted slot count an
     int8 KV pool sustains inside the full-precision pool's HBM budget
-    (>= 1.5× ``num_slots`` is the acceptance bar).  Returns Nones on
-    overrun/failure — never fatal to the north-star artifact.
+    (>= 1.5× ``num_slots`` is the acceptance bar).
+
+    Paged KV + prefix sharing observables (PR 12): one shared-prefix
+    trace (8 users over a single 128-token prefix, steady state — the
+    prefix is warmed once first) through the paged pool AND the PR 9
+    bucketed path: ``serving_prefix_ttft_p99_ms`` (paged) vs
+    ``serving_prefix_ttft_dense_p99_ms`` (the ≥5× acceptance
+    comparison), ``serving_prefix_hit_rate`` (fraction of demanded
+    prompt tokens served from the radix index — byte-accounted block
+    reuse, not just speed), and ``serving_paged_capacity_slots`` — how
+    many concurrent shared-prefix requests the paged pool's on-demand
+    allocation sustains inside the dense pool's byte budget (shared
+    blocks counted once + marginal private blocks per request).
+    Returns Nones on overrun/failure — never fatal to the north-star
+    artifact.
     """
     sys.path.insert(0, os.path.join(_REPO, "examples"))
     import loadgen
@@ -588,7 +601,13 @@ def serving_bench(budget_s: float = 90.0):
             "serving_longprompt_ttft_eager_p99_ms": None,
             "serving_spec_tokens_per_sec": None,
             "serving_spec_accept_rate": None,
-            "serving_quant_capacity_slots": None}
+            "serving_quant_capacity_slots": None,
+            "serving_prefix_ttft_p99_ms": None,
+            "serving_prefix_ttft_dense_p99_ms": None,
+            "serving_prefix_hit_rate": None,
+            "serving_prefix_prefill_tokens_per_sec": None,
+            "serving_prefix_prefill_dense_tokens_per_sec": None,
+            "serving_paged_capacity_slots": None}
     if budget_s < 5.0:  # not enough budget to even warm the engine up
         return none
     t0 = time.perf_counter()
@@ -621,6 +640,58 @@ def serving_bench(budget_s: float = 90.0):
         fp_eng.kv_pool_bytes // (q8_eng.kv_pool_bytes // q8_eng.num_slots))
     fp_eng.stop()
     q8_eng.stop()
+    if time.perf_counter() - t0 > budget_s * 0.35:
+        return out
+    # paged prefix-sharing leg (PR 12): 8 users over ONE 128-token shared
+    # prefix (each request adds a short private suffix), the prefix warmed
+    # once — steady-state multi-tenant serving — then the SAME trace
+    # through the paged pool and the PR 9 bucketed path.  TTFT p99 and
+    # effective prefill-tokens/sec (demanded = prefilled + trie-served)
+    # are the ≥5× acceptance comparison; prefix_hit_rate byte-accounts
+    # the reuse
+    px_trace = loadgen.make_trace(16, num_steps=1, prompt_lengths=(4, 6, 8),
+                                  prefix_groups=1, prefix_len=240)
+    for paged, tf, pf in (
+            (True, "serving_prefix_ttft_p99_ms",
+             "serving_prefix_prefill_tokens_per_sec"),
+            (False, "serving_prefix_ttft_dense_p99_ms",
+             "serving_prefix_prefill_dense_tokens_per_sec")):
+        _, px_eng = loadgen.build_engine(num_slots=8, max_len=256,
+                                        paged=paged, block_size=16,
+                                        prefill_chunk=16,
+                                        prefills_per_step=4)
+        try:
+            px_eng.warmup()
+            px_eng.submit(px_trace[0]["prompt"], 1)
+            px_eng.run_until_idle()      # warm the shared prefix once
+            px = loadgen.run_closed_loop(px_eng, px_trace, concurrency=8,
+                                         timeout_s=budget_s)
+            out[tf] = px["ttft_p99_ms"]
+            eff = px["prefill_tokens_per_sec"] or 0.0
+            if px["wall_s"]:
+                eff += px["prefix_hit_tokens"] / px["wall_s"]
+            out[pf] = round(eff, 1)
+            if paged:
+                out["serving_prefix_hit_rate"] = px["prefix_hit_rate"]
+                # capacity: blocks the dense pool's byte budget buys,
+                # minus the shared prefix chain (counted ONCE), divided
+                # by the worst-case PRIVATE blocks one trace request
+                # needs — concurrent shared-prefix requests at fixed HBM
+                blk_bytes = px_eng.kv_pool_bytes // (px_eng.kv_blocks + 1)
+                _, dn_eng = loadgen.build_engine(num_slots=8, max_len=256)
+                budget_blocks = dn_eng.kv_pool_bytes // blk_bytes
+                dn_eng.stop()
+                bs = px_eng.block_size
+                shared = 240 // bs
+                marg = max(
+                    -(-(len(r["prompt"]) + r["num_steps"]) // bs) - shared
+                    for r in px_trace)
+                out["serving_paged_capacity_slots"] = int(
+                    (budget_blocks - shared) // max(marg, 1))
+        finally:
+            px_eng.stop()
+        if time.perf_counter() - t0 > budget_s * 0.5:
+            return out
     if time.perf_counter() - t0 > budget_s * 0.45:
         return out
     # speculative leg: a TRAINED (2-layer target, 1-layer draft) pair on
@@ -976,7 +1047,13 @@ def main():
                       "serving_longprompt_ttft_eager_p99_ms": None,
                       "serving_spec_tokens_per_sec": None,
                       "serving_spec_accept_rate": None,
-                      "serving_quant_capacity_slots": None}
+                      "serving_quant_capacity_slots": None,
+                      "serving_prefix_ttft_p99_ms": None,
+                      "serving_prefix_ttft_dense_p99_ms": None,
+                      "serving_prefix_hit_rate": None,
+                      "serving_prefix_prefill_tokens_per_sec": None,
+                      "serving_prefix_prefill_dense_tokens_per_sec": None,
+                      "serving_paged_capacity_slots": None}
     serving_remaining = budget - (time.perf_counter() - t_start)
     if serving_remaining > 45:
         try:

@@ -139,6 +139,80 @@ def init_cache(model, batch: int, max_len: int,
     return caches
 
 
+class PagedView:
+    """Static+traced description of a paged-KV access, threaded through
+    the decode walker (``_forward(paged=...)``): ``tables`` (B, T) int32
+    per-row block tables (traced), ``page`` tokens per block and ``view``
+    the logical sequence length (both STATIC — construct this object
+    INSIDE the jitted program, closing over the ints).  ``floor``/``ceil``
+    (B,) bound each row's write range: logical positions below ``floor``
+    (a shared — refcounted — prefix another request owns) or at/above
+    ``ceil`` (right-pad junk past the real prompt) are routed into the
+    arena's null block instead of written.  ``qcap`` (B,) clamps pad
+    QUERY positions onto the last real position (see
+    ``ops.attention.dot_product_attention(q_positions=)``).  ``ring``
+    lays logical positions out modulo ``view`` (the paged form of the
+    rolling ring — same slot-holds-``p % view`` contract, addressed
+    through the block table)."""
+
+    __slots__ = ("tables", "page", "view", "floor", "ceil", "qcap", "ring")
+
+    def __init__(self, tables, page: int, view: int, floor=None, ceil=None,
+                 qcap=None, ring: bool = False):
+        self.tables = tables
+        self.page = int(page)
+        self.view = int(view)
+        self.floor = floor
+        self.ceil = ceil
+        self.qcap = qcap
+        self.ring = bool(ring)
+
+
+def init_paged_arena(model, num_blocks: int, block_size: int,
+                     kv_dtype: Optional[str] = None) -> List[Any]:
+    """The paged slot pool's backing store: per TransformerBlock a FLAT
+    arena of ``num_blocks + 1`` fixed-size blocks laid out contiguously —
+    ``{"k", "v"}`` of shape ((num_blocks + 1) * block_size, num_kv_heads,
+    key_dim) (plus ``{"ks", "vs"}`` per-entry scales for
+    ``kv_dtype="int8"``, quantized codes paged identically to the
+    full-precision entries).  Physical block b owns arena slots
+    [b * block_size, (b + 1) * block_size); logical position p of a
+    request whose block table maps logical block ``p // block_size`` to b
+    lives at slot ``b * block_size + p % block_size``.  The EXTRA
+    trailing block (id ``num_blocks``) is the NULL block: free slots'
+    junk decode writes, right-pad prefill writes, and warmup all route
+    there, so no real request's blocks are ever touched by another row's
+    program.  Unlike ``init_cache`` there is no per-slot ``max_len``
+    axis — capacity is ``num_blocks × block_size`` TOKENS, allocated on
+    demand per request instead of ``num_slots × max_len`` up front."""
+    _check_supported(model)
+    if kv_dtype not in (None, "int8"):
+        raise ValueError(f"kv_dtype must be None or 'int8', got "
+                         f"{kv_dtype!r}")
+    if int(num_blocks) < 1:
+        raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+    if int(block_size) < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    arena_len = (int(num_blocks) + 1) * int(block_size)
+    dtype = model._cdtype
+    caches: List[Any] = []
+    for layer in model.layers:
+        if isinstance(layer, TransformerBlock):
+            mha = layer._mha()
+            shape = (arena_len, mha._kv_heads(), mha.key_dim)
+            if kv_dtype == "int8":
+                caches.append({"k": jnp.zeros(shape, jnp.int8),
+                               "v": jnp.zeros(shape, jnp.int8),
+                               "ks": jnp.zeros(shape[:2], jnp.float32),
+                               "vs": jnp.zeros(shape[:2], jnp.float32)})
+            else:
+                caches.append({"k": jnp.zeros(shape, dtype),
+                               "v": jnp.zeros(shape, dtype)})
+        else:
+            caches.append(None)
+    return caches
+
+
 def _kv_quantized(cache) -> bool:
     """True for an int8 KV cache dict (codes + per-entry scales)."""
     return isinstance(cache, dict) and "ks" in cache
@@ -180,7 +254,7 @@ def _per_row(pos) -> bool:
 
 
 def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype,
-                 rolling: bool = False):
+                 rolling: bool = False, paged: Optional[PagedView] = None):
     """Cached attention over (B, L, D) queries starting at position
     ``pos``; writes k/v for those L positions into the cache and attends
     through ``ops.attention.dot_product_attention`` (same numerics as the
@@ -201,11 +275,27 @@ def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype,
     per-row kv_length mask would be WRONG for windowed models: a pad
     query whose window has slid past the real prompt would mask every
     key, and the resulting empty-softmax NaN row poisons real outputs
-    through the next layer's ``0 * NaN`` value products.)"""
-    from ..ops.attention import dot_product_attention
+    through the next layer's ``0 * NaN`` value products.)
+
+    ``paged`` (a :class:`PagedView`): the cache is a FLAT block arena
+    (``init_paged_arena``) addressed through per-row block tables instead
+    of a (B, S, ...) slab.  Writes scatter at gather-computed physical
+    slots (``floor``/``ceil`` route shared-prefix and right-pad positions
+    into the null block); reads gather each row's logical view back out
+    (``ops.attention.paged_gather``) and attend with the SAME per-row
+    masks as the dense path — the paged step is a storage relayout, not
+    a numerics change.  Requires per-row ``pos``."""
+    from ..ops.attention import dot_product_attention, paged_gather
     b, length = h.shape[0], h.shape[1]
     dh = mha.key_dim
     per_row = _per_row(pos)
+    q_clamped = None
+    if paged is not None:
+        if not per_row:
+            raise ValueError("paged KV access needs per-row (B,) positions")
+        q_idx = pos[:, None] + jnp.arange(length)[None, :]       # (B, L)
+        q_clamped = (q_idx if paged.qcap is None
+                     else jnp.minimum(q_idx, paged.qcap[:, None]))
 
     def proj(name, heads):
         bias = params.get("b" + name[1]) if mha.use_bias else None
@@ -219,12 +309,71 @@ def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype,
         # rotate by the suffix's ABSOLUTE positions; cached k stay rotated
         # by their own positions (RoPE scores depend only on distance)
         from ..ops.rope import apply_rope
-        positions = (pos[:, None] + jnp.arange(length)[None, :] if per_row
-                     else pos + jnp.arange(length))
+        if q_clamped is not None:
+            positions = q_clamped
+        else:
+            positions = (pos[:, None] + jnp.arange(length)[None, :]
+                         if per_row else pos + jnp.arange(length))
         q = apply_rope(q, positions, mha.rope_theta, mha.rope_scale)
         k_t = apply_rope(k_t, positions, mha.rope_theta, mha.rope_scale)
     new_cache = None
-    if per_row:
+    if paged is not None:
+        # -- paged arena: block-table-indexed scatter write, gathered read
+        bs, view = paged.page, paged.view
+        idx = pos[:, None] + jnp.arange(length)[None, :]         # (B, L)
+        if paged.ring:
+            w = view
+            if length > 1 and w < mha.attention_window + length - 1:
+                raise ValueError(
+                    f"multi-token per-row steps on a paged ring need a "
+                    f"view of >= window + L - 1 = "
+                    f"{mha.attention_window + length - 1} slots, got {w} "
+                    f"— the oldest query's window would be overwritten "
+                    f"by the newest write")
+            lidx = idx % w
+        else:
+            lidx = idx
+        blk = jnp.minimum(lidx // bs, paged.tables.shape[1] - 1)
+        phys = (jnp.take_along_axis(paged.tables, blk, axis=1) * bs
+                + lidx % bs)
+        null_phys = cache["k"].shape[0] - 1  # inside the null block
+        if paged.floor is not None:
+            phys = jnp.where(idx >= jnp.reshape(paged.floor, (-1, 1)),
+                             phys, null_phys)
+        if paged.ceil is not None:
+            phys = jnp.where(idx < jnp.reshape(paged.ceil, (-1, 1)),
+                             phys, null_phys)
+        new_cache = _kv_write(cache, (phys,), k_t, v_t)
+        if _kv_quantized(new_cache):
+            from .quant import dequantize_kv
+            k = dequantize_kv(
+                paged_gather(new_cache["k"], paged.tables, bs, view),
+                paged_gather(new_cache["ks"], paged.tables, bs, view),
+                cdtype)
+            v = dequantize_kv(
+                paged_gather(new_cache["v"], paged.tables, bs, view),
+                paged_gather(new_cache["vs"], paged.tables, bs, view),
+                cdtype)
+        else:
+            k = paged_gather(new_cache["k"], paged.tables, bs, view)
+            v = paged_gather(new_cache["v"], paged.tables, bs, view)
+        if paged.ring:
+            # same frontier layout as the dense ring: view slot j holds
+            # the newest position <= each row's write frontier congruent
+            # to j mod view (negative = never written)
+            front = pos[:, None] + (length - 1)
+            j = jnp.arange(view)
+            kv_positions = front - jnp.mod(front - j[None, :], view)
+            out = dot_product_attention(q, k, v, causal=True,
+                                        q_positions=q_clamped,
+                                        window=mha.attention_window,
+                                        kv_positions=kv_positions)
+        else:
+            out = dot_product_attention(q, k, v, causal=True,
+                                        q_positions=q_clamped,
+                                        kv_length=pos + length,
+                                        window=mha.attention_window)
+    elif per_row:
         # L >= 1: every row writes its L entries at its own offsets (the
         # serving engine's decode step at L == 1, its speculative verify
         # at L == spec_len + 1) and the per-row masks score all L queries
@@ -289,12 +438,13 @@ def _mha_forward(mha: MultiHeadAttention, params, h, cache, pos, cdtype,
 
 
 def _block_forward(block: TransformerBlock, params, x, cache, pos, cdtype,
-                   rolling: bool = False):
+                   rolling: bool = False,
+                   paged: Optional[PagedView] = None):
     """Mirrors ``TransformerBlock.apply`` (train=False) with cached MHA."""
     ln = LayerNormalization()
     h = ln.apply(params["ln1"], x, compute_dtype=cdtype)
     h, cache = _mha_forward(block._mha(), params["attn"], h, cache, pos,
-                            cdtype, rolling)
+                            cdtype, rolling, paged)
     x = x + h.astype(x.dtype)
     h = ln.apply(params["ln2"], x, compute_dtype=cdtype)
     h = _project(h, params["mlp_w1"], params["mlp_b1"], cdtype)
@@ -303,7 +453,8 @@ def _block_forward(block: TransformerBlock, params, x, cache, pos, cdtype,
     return x + h.astype(x.dtype), cache
 
 
-def _forward(model, params, caches, toks, pos, rolling: bool = False):
+def _forward(model, params, caches, toks, pos, rolling: bool = False,
+             paged: Optional[PagedView] = None):
     """Walk the layer stack over (B, L) tokens starting at position
     ``pos``; returns ((B, L, V) f32 logits, new caches).  L == 1 is a
     decode step, L == P is the batched prompt prefill.  ``pos`` may be a
@@ -340,23 +491,26 @@ def _forward(model, params, caches, toks, pos, rolling: bool = False):
                 x = x + pe.astype(x.dtype)[None]
         elif isinstance(layer, TransformerBlock):
             x, cache = _block_forward(layer, p, x, cache, pos, cdtype,
-                                      rolling)
+                                      rolling, paged)
         else:  # LayerNormalization / Dense: position-independent
             x = layer.apply(p, x, compute_dtype=cdtype, train=False)
         new_caches.append(cache)
     return x.astype(jnp.float32), new_caches
 
 
-def decode_step(model, params, caches, tok, pos, rolling: bool = False):
+def decode_step(model, params, caches, tok, pos, rolling: bool = False,
+                paged: Optional[PagedView] = None):
     """Advance one position.  tok: (B,) int32 current tokens; pos: scalar
     int32 position (0-based), or a (B,) int32 vector advancing every row
     at its OWN position (the serving engine's slot batch — each row writes
-    its k/v at, and attends from, its own position).  Returns (logits
-    (B, V) f32, new caches).  Jittable — wrap in ``jax.jit`` (or let
-    ``generate`` do it) for real use; ``jit_decode_step`` packages exactly
-    that."""
+    its k/v at, and attends from, its own position).  ``paged``: the
+    caches are a flat block arena addressed through per-row block tables
+    (the serving engine's paged slot pool) — same numerics, block-granular
+    storage.  Returns (logits (B, V) f32, new caches).  Jittable — wrap
+    in ``jax.jit`` (or let ``generate`` do it) for real use;
+    ``jit_decode_step`` packages exactly that."""
     logits, caches = _forward(model, params, caches, tok[:, None], pos,
-                              rolling)
+                              rolling, paged)
     return logits[:, 0], caches
 
 

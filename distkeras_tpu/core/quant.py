@@ -169,3 +169,19 @@ def kv_cache_bytes(caches: Any) -> int:
     for leaf in jax.tree_util.tree_leaves(caches):
         total += leaf.size * jnp.dtype(leaf.dtype).itemsize
     return total
+
+
+def kv_block_bytes(caches: Any, block_size: int) -> int:
+    """Bytes ONE ``block_size``-token block costs across all layers of a
+    paged arena (``core.decode.init_paged_arena`` — flat (A, ...) leaves,
+    codes + scales included for int8 arenas).  ``kv_cache_bytes(arena) ==
+    kv_block_bytes(arena, bs) × (num_blocks + 1)`` by construction; the
+    per-block figure is what the paged capacity math
+    (``serving_paged_capacity_slots``) and TUNING.md's fragmentation-vs-
+    gather-overhead guidance reason in."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(caches):
+        per_slot = (leaf.size // leaf.shape[0]) \
+            * jnp.dtype(leaf.dtype).itemsize
+        total += per_slot * int(block_size)
+    return total

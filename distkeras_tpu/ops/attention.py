@@ -40,7 +40,8 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
                           scale: Optional[float] = None,
                           q_offset=None, kv_length=None,
                           window: Optional[int] = None,
-                          kv_positions=None, segment_ids=None):
+                          kv_positions=None, segment_ids=None,
+                          q_positions=None):
     """Softmax(q·kᵀ)·v with f32 softmax arithmetic.
 
     q: (B, Sq, H, Dh); k, v: (B, Sk, Hkv, Dh) → (B, Sq, H, Dh), in q.dtype.
@@ -89,6 +90,16 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     output poisons real rows through the next layer's 0·NaN value
     products.  The causal mask always leaves a query its own key.)
 
+    ``q_positions`` (B, Sq) int: EXPLICIT per-query absolute positions,
+    overriding the ``q_offset + arange(Sq)`` layout (and forcing the
+    per-row mask path).  The paged-KV suffix prefill uses it to clamp its
+    right-pad queries onto the last real prompt position — a pad query
+    past the view (or past a sliding window's reach over the view) would
+    otherwise mask EVERY key and poison real rows with its empty-softmax
+    NaN; clamped, it attends like the final real token and its junk
+    output is simply discarded.  Real queries pass their true positions,
+    so this is mask-identical to ``q_offset`` for them.
+
     ``segment_ids`` (B, S) int: sequence-packing isolation — query and key
     attend only within equal segment ids (on top of causal/window), so
     several documents packed into one row never see each other.  Id 0 is
@@ -118,14 +129,19 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
                         preferred_element_type=jnp.float32) * scale
     k_pos = (jnp.arange(k.shape[1]) if kv_positions is None
              else jnp.asarray(kv_positions))
-    per_row = (k_pos.ndim == 2
+    per_row = (q_positions is not None
+               or k_pos.ndim == 2
                or getattr(q_offset, "ndim", 0) >= 1
                or getattr(kv_length, "ndim", 0) >= 1)
     if causal:
         if per_row:
             # batched masks: row r is a request at its own position
-            q_off = jnp.asarray(0 if q_offset is None else q_offset)
-            q_pos = jnp.arange(sq)[None, :] + jnp.reshape(q_off, (-1, 1))
+            if q_positions is not None:
+                q_pos = jnp.asarray(q_positions)
+            else:
+                q_off = jnp.asarray(0 if q_offset is None else q_offset)
+                q_pos = jnp.arange(sq)[None, :] + jnp.reshape(q_off,
+                                                              (-1, 1))
             kp = k_pos if k_pos.ndim == 2 else k_pos[None, :]  # (B|1, Sk)
             mask = kp[:, None, :] > q_pos[:, :, None]          # (B, Sq, Sk)
             if window is not None:
@@ -157,6 +173,53 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v)
     return out.reshape(b, sq, h, d)
+
+
+# ---------------------------------------------------------------------------
+# paged-KV (block-table) forms — the serving engine's paged slot pool
+# ---------------------------------------------------------------------------
+
+def paged_gather(arena, block_tables, page_size: int, view_len: int):
+    """Gather a per-row logical K/V view out of a flat paged arena.
+
+    ``arena``: (A, ...) — a flat pool of fixed-size blocks laid out
+    contiguously along axis 0 (``A = (num_blocks + 1) * page_size``; the
+    trailing block is the NULL block junk writes are routed into).
+    ``block_tables``: (B, T) int32 — row r's logical block i lives at
+    physical block ``block_tables[r, i]``; entries equal to the null
+    block id drop reads into junk (masked by the caller's frontier).
+    Returns the (B, view_len, ...) logical view: entry (r, p) is the
+    arena slot holding row r's logical position p.  This is the
+    gather-by-block-table read the paged decode/prefill programs run —
+    the values are bit-identical to a dense (B, view_len, ...) cache
+    holding the same writes, so attention over the view reproduces the
+    dense path's numerics exactly.
+    """
+    idx = jnp.arange(int(view_len))
+    blk = jnp.minimum(idx // int(page_size), block_tables.shape[1] - 1)
+    phys = (jnp.take(block_tables, blk, axis=1) * int(page_size)
+            + (idx % int(page_size))[None, :])            # (B, view_len)
+    return arena[phys]
+
+
+def paged_attention(q, k_arena, v_arena, block_tables, page_size: int,
+                    view_len: int, *, q_positions=None, q_offset=None,
+                    kv_length=None, window: Optional[int] = None,
+                    kv_positions=None, scale: Optional[float] = None):
+    """``dot_product_attention`` over block-table-gathered K/V: each row's
+    keys/values are gathered from the flat ``k_arena``/``v_arena`` through
+    its block table, then attended with the usual per-row causal masks
+    (``q_positions``/``q_offset`` anchor the queries, ``kv_length`` masks
+    the unwritten logical tail, ``kv_positions`` carries ring layouts).
+    Quantized arenas dequantize BEFORE this entry point (the caller
+    gathers codes + scales and fuses the dequant — see
+    ``core/decode.py``)."""
+    k = paged_gather(k_arena, block_tables, page_size, view_len)
+    v = paged_gather(v_arena, block_tables, page_size, view_len)
+    return dot_product_attention(q, k, v, causal=True, scale=scale,
+                                 q_positions=q_positions, q_offset=q_offset,
+                                 kv_length=kv_length, window=window,
+                                 kv_positions=kv_positions)
 
 
 def attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None,

@@ -374,15 +374,279 @@ class _PrefillJob:
     the chunks accumulate into — private to the job, so the decode
     batch's junk writes into free pool rows can't race it — which the
     final chunk commits to the slot's pool row in one atomic program
-    (ring-collapsed for rolling engines)."""
+    (ring-collapsed for rolling engines).
 
-    __slots__ = ("handle", "staging", "d_staging", "written")
+    Paged engines (non-rolling) chunk IN-ARENA instead: the job's blocks
+    are private by construction (every other row's writes go through its
+    OWN block table, and the prefilling slot's device table stays null
+    until the final chunk installs it — junk decode passes drop into the
+    null block), so the dense path's staging race cannot exist and the
+    chunks write straight into the request's allocated blocks (``bt`` /
+    ``dbt`` hold the row's uploaded block tables)."""
 
-    def __init__(self, handle: RequestHandle, staging=None, d_staging=None):
+    __slots__ = ("handle", "staging", "d_staging", "written", "bt", "dbt")
+
+    def __init__(self, handle: RequestHandle, staging=None, d_staging=None,
+                 bt=None, dbt=None):
         self.handle = handle
         self.staging = staging
         self.d_staging = d_staging  # the draft model's twin (speculation)
+        self.bt = bt                # paged: (1, T) device block-table row
+        self.dbt = dbt
         self.written = 0
+
+
+# ---------------------------------------------------------------------------
+# paged KV pool: host-side block allocator + radix prefix index
+# ---------------------------------------------------------------------------
+
+class _RadixNode:
+    """One FULL block of prompt tokens in the prefix trie: ``key`` is its
+    ``block_size``-token chunk (the edge label from ``parent``), ``block``
+    the physical arena block holding those tokens' K/V (all layers, both
+    pools — target and draft arenas share one block-id namespace).
+    ``ref`` counts live requests currently sharing the block; at ref 0
+    the node stays CACHED (its K/V remain valid in the arena) until the
+    allocator evicts it — LRU over ``last_used``, leaves first, so a
+    chain is reclaimed suffix-inward.  ``epoch`` stamps the scheduler
+    pass that inserted it: a node is matchable only from LATER passes,
+    which is what keeps a same-pass matcher from reading blocks whose
+    prefill program (possibly a different bucket group) has not been
+    dispatched yet."""
+
+    __slots__ = ("parent", "key", "block", "ref", "last_used", "children",
+                 "epoch")
+
+    def __init__(self, parent, key: Tuple[int, ...], block: int,
+                 epoch: int = -1):
+        self.parent = parent
+        self.key = key
+        self.block = block
+        self.ref = 0
+        self.last_used = 0
+        self.children: Dict[Tuple[int, ...], "_RadixNode"] = {}
+        self.epoch = epoch
+
+
+class _BlockPlan:
+    """One admitted request's block bookkeeping: ``blocks`` is the full
+    logical chain (matched + fresh, in logical-block order), ``nodes``
+    the trie nodes it holds a reference on, ``private`` the block ids it
+    owns outright (COW copy, partial prompt boundary, decode region),
+    ``matched`` the prefix tokens served from the trie, and ``cow`` the
+    ``(src, dst)`` block pair of the copy-on-write boundary copy (or
+    None)."""
+
+    __slots__ = ("nodes", "private", "blocks", "matched", "cow")
+
+    def __init__(self, nodes, private, blocks, matched, cow):
+        self.nodes = nodes
+        self.private = private
+        self.blocks = blocks
+        self.matched = matched
+        self.cow = cow
+
+
+class _PagedKVPool:
+    """Host-side allocator + radix prefix index over a flat block arena
+    (``core.decode.init_paged_arena``).  All scheduler-thread-only.
+
+    Allocation is block-granular and on demand: a request takes
+    ``ceil((p_len + num_steps) / block_size)`` blocks instead of a
+    ``max_len`` row, so capacity is bounded by TOKENS IN FLIGHT rather
+    than ``num_slots × max_len``.  With ``share=True`` (non-rolling
+    pools) admissions first walk the trie: full blocks whose token chunk
+    matches the prompt are SHARED (refcounted — never written again:
+    every sharer's write floor sits above them), a partially-matched
+    boundary block is COPIED (copy-on-write: the admission owns the
+    copy and continues writing into it), and only the unmatched suffix
+    is prefilled.  Matching is capped at ``p_len - 1`` so at least one
+    prompt token is always prefilled — the logits that sample the first
+    token must be computed.  Retirement decrements refs; refcount-0
+    chains stay cached until LRU eviction (leaves first) reclaims their
+    blocks for new admissions.  Stats are written straight into the
+    engine's ``stats`` dict."""
+
+    def __init__(self, num_blocks: int, block_size: int, share: bool,
+                 stats: Dict[str, Any]):
+        self.num_blocks = int(num_blocks)
+        self.bs = int(block_size)
+        self.share = bool(share)
+        self.stats = stats
+        self.free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self.root = _RadixNode(None, (), -1)
+        self.private_out = 0
+        self._clock = 0
+        self.epoch = 0
+
+    # -- clocks ------------------------------------------------------------
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def next_epoch(self) -> None:
+        """One scheduler pass = one epoch: nodes inserted this pass are
+        not matchable until the next (their prefill program may belong
+        to a bucket group dispatched AFTER the matcher's)."""
+        self.epoch += 1
+
+    # -- introspection -----------------------------------------------------
+    def _nodes(self) -> List[_RadixNode]:
+        out, stack = [], list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            out.append(n)
+            stack.extend(n.children.values())
+        return out
+
+    def cached_blocks(self) -> int:
+        """Trie-held blocks (shared + refcount-0 cached)."""
+        return len(self._nodes())
+
+    def in_use(self) -> int:
+        """Blocks held by LIVE requests: privately-owned ones plus trie
+        nodes with a non-zero refcount.  0 when the engine is idle — the
+        zero-leak assertion every retirement path must restore."""
+        return self.private_out + sum(1 for n in self._nodes() if n.ref > 0)
+
+    def check_conservation(self) -> bool:
+        """free + cached == num_blocks − private_out, always."""
+        return (len(self.free) + self.cached_blocks() + self.private_out
+                == self.num_blocks)
+
+    # -- match / evict / allocate ------------------------------------------
+    def _match(self, toks: List[int], cap: int):
+        """Walk the trie: full-block matches (chain), then the best
+        PARTIAL child match at the divergence point (the COW boundary).
+        ``cap`` bounds matchable tokens (< p_len, see class docstring).
+        Nodes inserted this epoch are invisible."""
+        nodes: List[_RadixNode] = []
+        parent = self.root
+        d = 0
+        while d + self.bs <= cap:
+            child = parent.children.get(tuple(toks[d:d + self.bs]))
+            if child is None or child.epoch >= self.epoch:
+                break
+            nodes.append(child)
+            parent = child
+            d += self.bs
+        pnode, plen = None, 0
+        lim = min(cap - d, self.bs)
+        if lim > 0:
+            for key, child in parent.children.items():
+                if child.epoch >= self.epoch:
+                    continue
+                j = 0
+                while j < lim and key[j] == toks[d + j]:
+                    j += 1
+                if j > plen:
+                    pnode, plen = child, j
+        return nodes, pnode, plen
+
+    def _evictable(self, pinned) -> List[_RadixNode]:
+        out, stack = [], list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif n.ref == 0 and n not in pinned:
+                out.append(n)
+        return out
+
+    def _reserve(self, need: int, pinned=()) -> bool:
+        """Ensure ``need`` free blocks, evicting LRU refcount-0 leaf
+        chains (suffix-inward); False when live requests hold too much —
+        the admission stays queued until retirements free blocks."""
+        pinned = set(pinned)
+        while len(self.free) < need:
+            cands = self._evictable(pinned)
+            if not cands:
+                return False
+            victim = min(cands, key=lambda n: n.last_used)
+            del victim.parent.children[victim.key]
+            self.free.append(victim.block)
+            self.stats["blocks_evicted"] += 1
+        return True
+
+    def admit(self, tokens, n_blocks: int) -> Optional[_BlockPlan]:
+        """Reserve a request's block chain.  ``tokens`` (the prompt) is
+        None for share-off (rolling) pools — a plain allocation.  Trie
+        INSERTION of the request's own full prompt blocks is deferred to
+        :meth:`publish` (after their contents' program is dispatched).
+        Returns None when blocks are unavailable (admission backs off)."""
+        if not self.share or tokens is None:
+            if not self._reserve(n_blocks):
+                return None
+            fresh = [self.free.pop() for _ in range(n_blocks)]
+            self.stats["blocks_allocated"] += n_blocks
+            self.private_out += n_blocks
+            return _BlockPlan([], fresh, list(fresh), 0, None)
+        toks = [int(t) for t in tokens]
+        cap = len(toks) - 1
+        nodes, pnode, plen = self._match(toks, cap)
+        matched = len(nodes) * self.bs + plen
+        need = n_blocks - len(nodes)
+        pinned = list(nodes) + ([pnode] if pnode is not None else [])
+        if not self._reserve(need, pinned):
+            return None
+        fresh = [self.free.pop() for _ in range(need)]
+        self.stats["blocks_allocated"] += need
+        self.private_out += need
+        chain = [n.block for n in nodes] + fresh
+        now = self._tick()
+        for n in nodes:
+            n.ref += 1
+            n.last_used = now
+        self.stats["blocks_reused"] += len(nodes)
+        if matched:
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_hit_tokens"] += matched
+        cow = None
+        if pnode is not None:
+            cow = (pnode.block, chain[len(nodes)])
+            pnode.last_used = now
+            self.stats["cow_copies"] += 1
+        return _BlockPlan(list(nodes), fresh, chain, matched, cow)
+
+    def publish(self, plan: _BlockPlan, tokens) -> None:
+        """Insert the request's own FULL prompt blocks into the trie
+        (ref 1 — held live until release) so later admissions can share
+        them.  Called once the program writing their contents has been
+        dispatched: immediately for bucket prefills, at the final chunk
+        for chunked ones (earlier would let a matcher's program overtake
+        an undispatched chunk).  Stops at the first key collision —
+        a concurrent chain insertion keeps the existing nodes and this
+        plan's duplicates stay private."""
+        if not self.share or tokens is None:
+            return
+        toks = [int(t) for t in tokens]
+        parent = plan.nodes[-1] if plan.nodes else self.root
+        now = self._tick()
+        i = len(plan.nodes)
+        while (i + 1) * self.bs <= len(toks) and i < len(plan.blocks):
+            key = tuple(toks[i * self.bs:(i + 1) * self.bs])
+            if key in parent.children:
+                break
+            node = _RadixNode(parent, key, plan.blocks[i], self.epoch)
+            node.ref = 1
+            node.last_used = now
+            parent.children[key] = node
+            plan.nodes.append(node)
+            plan.private.remove(plan.blocks[i])
+            self.private_out -= 1
+            parent = node
+            i += 1
+
+    def release(self, plan: _BlockPlan) -> None:
+        """Retirement: drop the plan's refs (refcount-0 chains stay
+        cached for future prefix hits) and free its private blocks."""
+        now = self._tick()
+        for n in plan.nodes:
+            n.ref -= 1
+            n.last_used = now
+        self.free.extend(plan.private)
+        self.private_out -= len(plan.private)
+        plan.nodes, plan.private = [], []
 
 
 class ServingEngine:
@@ -428,6 +692,23 @@ class ServingEngine:
        draft) store int8 codes + per-entry scales — roughly half the
        bf16 slot bytes, so ``num_slots`` can ~double at fixed pool HBM
        (``kv_pool_bytes`` is the byte-accounted observable).  Lossy.
+     - ``paged=True`` (bucketed mode): the slot pool becomes a PAGED KV
+       pool — a flat arena of ``kv_blocks`` fixed-size blocks
+       (``block_size`` tokens each, int8 codes + scales paged identically
+       when ``kv_dtype="int8"``) with per-request block tables, so a
+       request allocates ``ceil((p_len + num_steps) / block_size)``
+       blocks instead of a ``max_len`` row and capacity is bounded by
+       tokens in flight.  On top of the arena a host-side RADIX PREFIX
+       INDEX maps full prompt blocks to refcounted chains: an admission
+       walks the trie, SHARES matched full blocks (copy-on-write at a
+       partially-matched boundary block), and prefills only the
+       unmatched suffix — TTFT for a shared-prefix admission drops from
+       O(prompt) to O(suffix).  Refcount-0 chains stay cached until LRU
+       eviction.  Speculative engines page the draft pool over the SAME
+       block chain (one trie serves both).  Exact: a lone request's
+       output is token-identical to the dense engine and to offline
+       ``generate``; the default ``paged=False`` keeps the dense pool
+       byte-for-byte.
 
     Threading: ``submit`` is thread-safe (any number of producers);
     the scheduler itself — ``step`` / ``run_until_idle`` / the ``start``
@@ -444,7 +725,9 @@ class ServingEngine:
                                             Tuple[Sequential, Any]]] = None,
                  spec_len: int = 4,
                  quantize: Optional[str] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 paged: bool = False, block_size: int = 16,
+                 kv_blocks: Optional[int] = None):
         if isinstance(model, FittedModel):
             self.model, self.params = model.model, model.params
         else:
@@ -455,9 +738,9 @@ class ServingEngine:
         # -- speculation + quantization knobs (all default OFF: the engine
         #    is bit-identical to its pre-speculation self until asked)
         if prefill_mode == "eager" and (spec_draft is not None
-                                        or kv_dtype is not None):
+                                        or kv_dtype is not None or paged):
             raise ValueError(
-                "spec_draft / kv_dtype are fast-path features "
+                "spec_draft / kv_dtype / paged are fast-path features "
                 "(prefill_mode='bucketed'); the eager engine stays the "
                 "unmodified bit-exactness reference")
         if quantize not in (None, "int8", "bf16"):
@@ -538,14 +821,66 @@ class ServingEngine:
         #    draft caches are small next to the target's)
         ring_slack = (self.spec_len if (rolling and spec_draft is not None)
                       else 0)
-        self.caches = init_cache(self.model, self.num_slots, self.max_len,
-                                 rolling=self.rolling, kv_dtype=kv_dtype,
-                                 ring_slack=ring_slack)
-        if self._draft_model is not None:
-            self.d_caches = init_cache(self._draft_model, self.num_slots,
-                                       self.max_len, kv_dtype=kv_dtype)
+        # -- paged KV pool (paged=True): the slot pool becomes a flat
+        #    arena of block_size-token blocks + per-request block tables;
+        #    blocks are allocated on demand (capacity = tokens in flight,
+        #    not num_slots × max_len) and — non-rolling — shared across
+        #    requests through the radix prefix index.  Default kv_blocks
+        #    matches the dense pool's capacity exactly, so paged=True
+        #    alone changes layout, not limits.
+        self.paged = bool(paged)
+        if int(block_size) < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = int(block_size)
+        self._pool = None
+        self._plans: Dict[int, _BlockPlan] = {}
+        if self.paged:
+            bs = self.block_size
+            if self.rolling:
+                windows = {layer._mha().attention_window
+                           for layer in self.model.layers
+                           if hasattr(layer, "_mha")}
+                if len(windows) != 1:
+                    raise ValueError(
+                        "paged rolling pools need one uniform "
+                        "attention_window across every TransformerBlock "
+                        f"(the block table is per-request, shared by all "
+                        f"layers); got windows {sorted(windows)}")
+                self._t_view = min(windows.pop() + ring_slack, self.max_len)
+            else:
+                self._t_view = self.max_len
+            self._blocks_per_slot = -(-self._t_view // bs)
+            if self._draft_model is not None:
+                self._blocks_per_slot = max(self._blocks_per_slot,
+                                            -(-self.max_len // bs))
+            if kv_blocks is None:
+                kv_blocks = self.num_slots * self._blocks_per_slot
+            self.kv_blocks = int(kv_blocks)
+            if self.kv_blocks < self._blocks_per_slot:
+                raise ValueError(
+                    f"kv_blocks {self.kv_blocks} cannot hold even one "
+                    f"max-length request ({self._blocks_per_slot} blocks "
+                    f"of {bs} tokens)")
+            self.caches = _dec.init_paged_arena(self.model, self.kv_blocks,
+                                                bs, kv_dtype=kv_dtype)
+            if self._draft_model is not None:
+                self.d_caches = _dec.init_paged_arena(
+                    self._draft_model, self.kv_blocks, bs,
+                    kv_dtype=kv_dtype)
+            else:
+                self.d_caches = None
         else:
-            self.d_caches = None
+            self.kv_blocks = None
+            self.caches = init_cache(self.model, self.num_slots,
+                                     self.max_len, rolling=self.rolling,
+                                     kv_dtype=kv_dtype,
+                                     ring_slack=ring_slack)
+            if self._draft_model is not None:
+                self.d_caches = init_cache(self._draft_model,
+                                           self.num_slots, self.max_len,
+                                           kv_dtype=kv_dtype)
+            else:
+                self.d_caches = None
         self._handles: List[Optional[RequestHandle]] = [None] * self.num_slots
         self._free: List[int] = list(range(self.num_slots - 1, -1, -1))
         self._positions = np.zeros((self.num_slots,), np.int32)
@@ -590,9 +925,26 @@ class ServingEngine:
             self._dev_topk = jnp.zeros((self.num_slots,), jnp.int32)
             self._dev_topp = jnp.zeros((self.num_slots,), jnp.float32)
             self._dev_keys = jnp.zeros((self.num_slots, 2), jnp.uint32)
+            if self.paged:
+                # device-resident block tables (one row per slot, null-
+                # filled — null = kv_blocks, the arena's junk block) plus
+                # the host-side allocator/prefix-trie.  A retired slot's
+                # table row is re-nulled so its idle decode passes junk
+                # into the null block, never a reallocated block.
+                bs = self.block_size
+                self._t_tbl = -(-self._t_view // bs) + 1
+                self._dev_bt = jnp.full((self.num_slots, self._t_tbl),
+                                        self.kv_blocks, jnp.int32)
+                if self._draft_model is not None:
+                    self._d_tbl = -(-self.max_len // bs) + 1
+                    self._dev_dbt = jnp.full(
+                        (self.num_slots, self._d_tbl), self.kv_blocks,
+                        jnp.int32)
+                else:
+                    self._dev_dbt = None
+                self._copy_fn = self._build_copy_fn()
             self._decode_fn = self._build_device_step_fn()
-            self._deact_fn = jax.jit(
-                lambda act, slot: act.at[slot].set(False))
+            self._deact_fn = self._build_deact_fn()
             self._bucket_fns: Dict[int, Any] = {}
             self._stage_fns: Dict[int, Any] = {}
             self._final_fns: Dict[int, Any] = {}
@@ -645,7 +997,23 @@ class ServingEngine:
             # speculation report through one key set)
             "drafted": 0, "accepted": 0,
             "verify_calls": 0, "target_calls": 0,
+            # paged-pool observables: blocks_allocated counts fresh
+            # allocations, blocks_reused trie-shared blocks, prefix_hits/
+            # prefix_hit_tokens admissions (and their token counts) served
+            # from the radix index, cow_copies boundary copy-on-writes,
+            # blocks_evicted LRU reclaims of refcount-0 cached chains.
+            # kv_pool_bytes is the on-device pool footprint gauge (arena
+            # bytes when paged, the dense slot pool's otherwise) — the
+            # byte-accounting that proves block reuse next to PR 11's
+            # kv_cache_bytes math
+            "blocks_allocated": 0, "blocks_reused": 0, "blocks_evicted": 0,
+            "prefix_hits": 0, "prefix_hit_tokens": 0, "cow_copies": 0,
+            "kv_pool_bytes": _quant.kv_cache_bytes(self.caches),
         }
+        if self.paged:
+            self._pool = _PagedKVPool(self.kv_blocks, self.block_size,
+                                      share=not self.rolling,
+                                      stats=self.stats)
 
     # ------------------------------------------------------------------ jit
     def _build_step_fn(self):
@@ -715,8 +1083,27 @@ class ServingEngine:
     def _build_device_step_fn(self):
         """The bucketed-mode decode step: state advances ON DEVICE (donated
         caches, new positions), so a steady-state iteration uploads nothing
-        and reads back only the sampled token row."""
+        and reads back only the sampled token row.  Paged engines take the
+        device block tables as an extra (read-only) argument and write/
+        gather through them — per-row block-indexed cache writes inside
+        the same jitted step."""
         model, rolling = self.model, self.rolling
+
+        if self.paged:
+            page, view = self.block_size, self._t_view
+
+            def pstep(params, caches, bt, tok, positions, active, temp,
+                      topk, topp, keys):
+                pv = _dec.PagedView(bt, page, view, ring=rolling)
+                logits, caches = _dec.decode_step(model, params, caches,
+                                                  tok, positions, paged=pv)
+                nxt = _dec.sample_logits_batched(logits, positions, temp,
+                                                 keys, topk, topp)
+                out = jnp.where(active, nxt, tok)
+                positions = jnp.where(active, positions + 1, positions)
+                return out, caches, positions
+
+            return jax.jit(pstep, donate_argnums=(1, 4))
 
         def step(params, caches, tok, positions, active, temp, topk, topp,
                  keys):
@@ -729,6 +1116,44 @@ class ServingEngine:
             return out, caches, positions
 
         return jax.jit(step, donate_argnums=(1, 3))
+
+    def _build_deact_fn(self):
+        """Slot retirement on device: clear the active flag and — paged —
+        re-null the slot's block-table row(s), so the retired row's idle
+        decode junk drops into the null block instead of blocks the
+        allocator may already have handed to a new request."""
+        if not self.paged:
+            return jax.jit(lambda act, slot: act.at[slot].set(False))
+        null = jnp.int32(self.kv_blocks)
+        if self._draft_model is None:
+            return jax.jit(lambda act, bt, slot: (
+                act.at[slot].set(False), bt.at[slot].set(null)))
+        return jax.jit(lambda act, bt, dbt, slot: (
+            act.at[slot].set(False), bt.at[slot].set(null),
+            dbt.at[slot].set(null)))
+
+    def _build_copy_fn(self):
+        """The copy-on-write program: duplicate one physical block (all
+        layers, target AND draft arenas) so an admission that matched a
+        cached block PARTIALLY can keep writing its own suffix into the
+        copy while the original stays shared."""
+        bs = self.block_size
+
+        def copy_one(caches, src, dst):
+            def cp(leaf):
+                row = jax.lax.dynamic_slice_in_dim(leaf, src * bs, bs, 0)
+                return jax.lax.dynamic_update_slice_in_dim(leaf, row,
+                                                           dst * bs, 0)
+            return [None if c is None else {k: cp(v) for k, v in c.items()}
+                    for c in caches]
+
+        if self._draft_model is None:
+            return jax.jit(copy_one, donate_argnums=(0,))
+
+        def copy_both(caches, dcaches, src, dst):
+            return copy_one(caches, src, dst), copy_one(dcaches, src, dst)
+
+        return jax.jit(copy_both, donate_argnums=(0, 1))
 
     def _build_spec_fn(self):
         """The speculative decode round — ONE jitted program replacing the
@@ -758,6 +1183,10 @@ class ServingEngine:
         model, rolling = self.model, self.rolling
         draft = self._draft_model
         k = self.spec_len
+        paged = self.paged
+        page = self.block_size
+        t_view = self._t_view if paged else None
+        d_view = self.max_len
 
         def fold(keys, idx, tag):
             # per-(row, absolute position, purpose) keys: tag 1 = draft
@@ -769,10 +1198,16 @@ class ServingEngine:
             return jax.vmap(jax.random.fold_in)(ks, jnp.full_like(idx, tag))
 
         def round_(params, dparams, caches, dcaches, tok, pos, act, temp,
-                   topk, topp, keys):
+                   topk, topp, keys, bt=None, dbt=None):
             b = tok.shape[0]
             sampled = temp > 0.0
             safe_t = jnp.where(sampled, temp, 1.0)
+            # paged pools: the round's every cache access goes through the
+            # slot block tables (read-only here — allocation is host-side)
+            pv_t = (_dec.PagedView(bt, page, t_view, ring=rolling)
+                    if bt is not None else None)
+            pv_d = (_dec.PagedView(dbt, page, d_view)
+                    if dbt is not None else None)
 
             def warp(l):
                 return _dec.filter_logits_batched(l / safe_t[:, None],
@@ -783,7 +1218,7 @@ class ServingEngine:
             t = tok
             for i in range(k):
                 dl, dcaches = _dec.decode_step(draft, dparams, dcaches, t,
-                                               pos + i)
+                                               pos + i, paged=pv_d)
                 wl = warp(dl)
                 prop = jax.vmap(jax.random.categorical)(
                     fold(keys, pos + i + 1, 1), wl).astype(jnp.int32)
@@ -798,7 +1233,8 @@ class ServingEngine:
             # fully-accepted row still has a bonus distribution at index k
             fed = jnp.concatenate([tok[:, None], drafted], axis=1)
             logits, caches = _dec._forward(model, params, caches, fed, pos,
-                                           rolling)
+                                           rolling and pv_t is None,
+                                           paged=pv_t)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
             # greedy accept: longest drafted prefix matching the target's
@@ -854,14 +1290,24 @@ class ServingEngine:
             # draft); for every other row pos + k is at or past its new
             # frontier, where the junk is masked until overwritten
             _, dcaches = _dec.decode_step(draft, dparams, dcaches,
-                                          d_toks[-1], pos + k)
+                                          d_toks[-1], pos + k, paged=pv_d)
 
             out = jnp.concatenate([committed, n[:, None]], axis=1)
             return out, caches, dcaches, new_tok, new_pos
 
+        if paged:
+            def round_paged(params, dparams, caches, dcaches, bt, dbt,
+                            tok, pos, act, temp, topk, topp, keys):
+                return round_(params, dparams, caches, dcaches, tok, pos,
+                              act, temp, topk, topp, keys, bt=bt, dbt=dbt)
+
+            return jax.jit(round_paged, donate_argnums=(2, 3, 6, 7))
+
         return jax.jit(round_, donate_argnums=(2, 3, 4, 5))
 
     def _build_bucket_fn(self, width: int):
+        if self.paged:
+            return self._build_paged_bucket_fn(width)
         model, rolling = self.model, self.rolling
         draft = self._draft_model
 
@@ -908,7 +1354,95 @@ class ServingEngine:
 
         return jax.jit(run, donate_argnums=(1,))
 
+    def _build_paged_bucket_fn(self, width: int):
+        """The paged bucket program.  Non-rolling: the batch prefills its
+        UNMATCHED SUFFIXES directly into the arena through per-row block
+        tables — each row's queries start at its matched length, attend
+        the shared prefix blocks through the block-table gather (rows
+        admitted in the same program read each other's just-written
+        prefix: the layer's scatter covers every row before its gather),
+        and write with a ``floor`` at the matched frontier so shared
+        blocks are never touched.  Rolling: the dense prefill +
+        ``ring_from_prefill`` relayout commits through the block table
+        instead of into pool rows (no sharing on rings — ring layout is
+        position-dependent).  Either way the program also installs the
+        slot rows of the DEVICE block tables, so decode needs no
+        per-iteration upload."""
+        model, rolling = self.model, self.rolling
+        draft = self._draft_model
+        page, t_view, d_view = self.block_size, self._t_view, self.max_len
+
+        def prefill(params, dparams, pool, dpool, bt, dbt, tok, pos, act,
+                    temp, topk, topp, keys, prompts, match, p_lens, slots,
+                    row_bt, row_dbt, r_temp, r_topk, r_topp, r_keys):
+            if not rolling:
+                pv = _dec.PagedView(row_bt, page, t_view, floor=match,
+                                    ceil=p_lens, qcap=p_lens - 1)
+                logits, pool = _dec._forward(model, params, pool, prompts,
+                                             match, paged=pv)
+                idx = jnp.clip(p_lens - match - 1, 0, width - 1)
+                last = jnp.take_along_axis(logits, idx[:, None, None],
+                                           axis=1)[:, 0]
+            else:
+                rows = init_cache(model, prompts.shape[0], width)
+                logits, rows = _dec._forward(model, params, rows, prompts,
+                                             0)
+                idx = jnp.clip(p_lens - 1, 0, width - 1)
+                last = jnp.take_along_axis(logits, idx[:, None, None],
+                                           axis=1)[:, 0]
+                j = jnp.arange(t_view)
+                blk = jnp.minimum(j // page, row_bt.shape[1] - 1)
+                phys = (jnp.take(row_bt, blk, axis=1) * page
+                        + (j % page)[None, :])
+                new_pool = []
+                for big, row in zip(pool, rows):
+                    if big is None:
+                        new_pool.append(None)
+                        continue
+                    rk = _dec.ring_from_prefill(row["k"], p_lens, t_view)
+                    rv = _dec.ring_from_prefill(row["v"], p_lens, t_view)
+                    new_pool.append(_dec._kv_write(big, (phys,), rk, rv))
+                pool = new_pool
+            first = _dec.sample_logits_batched(last, p_lens - 1, r_temp,
+                                               r_keys, r_topk, r_topp)
+            out = [first, pool]
+            if draft is not None:
+                # the draft pool is always full-view (non-rolling): its
+                # prefill runs arena-direct per-row whatever the target's
+                # layout — match is 0 for rolling targets (no sharing)
+                pv_d = _dec.PagedView(row_dbt, page, d_view, floor=match,
+                                      ceil=p_lens, qcap=p_lens - 1)
+                _, dpool = _dec._forward(draft, dparams, dpool, prompts,
+                                         match, paged=pv_d)
+                out.append(dpool)
+            out.append(bt.at[slots].set(row_bt, mode="drop"))
+            if draft is not None:
+                out.append(dbt.at[slots].set(row_dbt, mode="drop"))
+            out += [tok.at[slots].set(first, mode="drop"),
+                    pos.at[slots].set(p_lens, mode="drop"),
+                    act.at[slots].set(True, mode="drop"),
+                    temp.at[slots].set(r_temp, mode="drop"),
+                    topk.at[slots].set(r_topk, mode="drop"),
+                    topp.at[slots].set(r_topp, mode="drop"),
+                    keys.at[slots].set(r_keys, mode="drop")]
+            return tuple(out)
+
+        if draft is not None:
+            return jax.jit(prefill, donate_argnums=(2, 3, 4, 5))
+
+        def run(params, pool, bt, tok, pos, act, temp, topk, topp, keys,
+                prompts, match, p_lens, slots, row_bt,
+                r_temp, r_topk, r_topp, r_keys):
+            return prefill(params, None, pool, None, bt, None, tok, pos,
+                           act, temp, topk, topp, keys, prompts, match,
+                           p_lens, slots, row_bt, None, r_temp, r_topk,
+                           r_topp, r_keys)
+
+        return jax.jit(run, donate_argnums=(1, 2))
+
     def _build_stage_fn(self, width: int):
+        if self.paged and not self.rolling:
+            return self._build_paged_stage_fn(width)
         model, draft = self.model, self._draft_model
 
         def stage(params, staging, toks, offset):
@@ -928,7 +1462,173 @@ class ServingEngine:
 
         return jax.jit(stage_spec, donate_argnums=(2, 3))
 
+    def _build_paged_stage_fn(self, width: int):
+        """Paged (non-rolling) chunked prefill: chunks write STRAIGHT into
+        the request's allocated blocks (no staging cache — the blocks are
+        private by construction, and the slot's device table stays null
+        until the final chunk, so nothing else can write them).  The
+        chunk's queries attend every earlier position — shared prefix
+        included — through the block-table gather."""
+        model, draft = self.model, self._draft_model
+        page, t_view, d_view = self.block_size, self._t_view, self.max_len
+
+        def stage(params, pool, toks, offset, p_len, row_bt):
+            pv = _dec.PagedView(row_bt, page, t_view, floor=offset,
+                                ceil=p_len, qcap=p_len - 1)
+            _, pool = _dec._forward(model, params, pool, toks, offset,
+                                    paged=pv)
+            return pool
+
+        if draft is None:
+            return jax.jit(stage, donate_argnums=(1,))
+
+        def stage_spec(params, dparams, pool, dpool, toks, offset, p_len,
+                       row_bt, row_dbt):
+            pv = _dec.PagedView(row_bt, page, t_view, floor=offset,
+                                ceil=p_len, qcap=p_len - 1)
+            _, pool = _dec._forward(model, params, pool, toks, offset,
+                                    paged=pv)
+            pv_d = _dec.PagedView(row_dbt, page, d_view, floor=offset,
+                                  ceil=p_len, qcap=p_len - 1)
+            _, dpool = _dec._forward(draft, dparams, dpool, toks, offset,
+                                     paged=pv_d)
+            return pool, dpool
+
+        return jax.jit(stage_spec, donate_argnums=(2, 3))
+
+    def _build_paged_final_fn(self, width: int):
+        """Paged (non-rolling) final chunk: last suffix tokens into the
+        arena + first-token sample + device state install (block-table
+        row included) — the paged twin of the dense final commit, minus
+        the staging copy it no longer needs."""
+        model, draft = self.model, self._draft_model
+        page, t_view, d_view = self.block_size, self._t_view, self.max_len
+
+        def final(params, dparams, pool, dpool, bt, dbt, tok, pos, act,
+                  temp, topk, topp, keys, toks, slot, offset, p_len,
+                  last_idx, row_bt, row_dbt, r_temp, r_topk, r_topp,
+                  r_key):
+            pv = _dec.PagedView(row_bt, page, t_view, floor=offset,
+                                ceil=p_len, qcap=p_len - 1)
+            logits, pool = _dec._forward(model, params, pool, toks, offset,
+                                         paged=pv)
+            first = _dec.sample_logits_batched(
+                logits[0, last_idx][None], p_len - 1, r_temp, r_key,
+                r_topk, r_topp)
+            out = [first, pool]
+            if draft is not None:
+                pv_d = _dec.PagedView(row_dbt, page, d_view, floor=offset,
+                                      ceil=p_len, qcap=p_len - 1)
+                _, dpool = _dec._forward(draft, dparams, dpool, toks,
+                                         offset, paged=pv_d)
+                out.append(dpool)
+            out.append(bt.at[slot].set(row_bt[0], mode="drop"))
+            if draft is not None:
+                out.append(dbt.at[slot].set(row_dbt[0], mode="drop"))
+            out += [tok.at[slot].set(first[0], mode="drop"),
+                    pos.at[slot].set(p_len[0], mode="drop"),
+                    act.at[slot].set(True, mode="drop"),
+                    temp.at[slot].set(r_temp[0], mode="drop"),
+                    topk.at[slot].set(r_topk[0], mode="drop"),
+                    topp.at[slot].set(r_topp[0], mode="drop"),
+                    keys.at[slot].set(r_key[0], mode="drop")]
+            return tuple(out)
+
+        if draft is not None:
+            return jax.jit(final, donate_argnums=(2, 3, 4, 5))
+
+        def run(params, pool, bt, tok, pos, act, temp, topk, topp, keys,
+                toks, slot, offset, p_len, last_idx, row_bt,
+                r_temp, r_topk, r_topp, r_key):
+            return final(params, None, pool, None, bt, None, tok, pos,
+                         act, temp, topk, topp, keys, toks, slot, offset,
+                         p_len, last_idx, row_bt, None, r_temp, r_topk,
+                         r_topp, r_key)
+
+        return jax.jit(run, donate_argnums=(1, 2))
+
+    def _build_paged_ring_final_fn(self, width: int):
+        """Paged ROLLING final chunk: the dense staging cache (rolling
+        chunks still stage — a ring commit needs the whole prompt tail at
+        once) ring-collapses through ``ring_from_prefill`` and scatters
+        into the slot's blocks via its block table; the draft twin (full
+        view, non-rolling) commits its staged positions below ``p_len``
+        and routes the rest into the null block."""
+        model, draft = self.model, self._draft_model
+        page, t_view, d_view = self.block_size, self._t_view, self.max_len
+
+        def final(params, dparams, pool, dpool, bt, dbt, tok, pos, act,
+                  temp, topk, topp, keys, staging, d_staging, toks, slot,
+                  offset, last_idx, p_len, row_bt, row_dbt, r_temp,
+                  r_topk, r_topp, r_key):
+            logits, staging = _dec._forward(model, params, staging, toks,
+                                            offset)
+            first = _dec.sample_logits_batched(
+                logits[0, last_idx][None], jnp.asarray(p_len - 1)[None],
+                r_temp, r_key, r_topk, r_topp)
+            p_row = jnp.asarray(p_len)[None]
+            j = jnp.arange(t_view)
+            blk = jnp.minimum(j // page, row_bt.shape[1] - 1)
+            phys = (jnp.take(row_bt, blk, axis=1) * page
+                    + (j % page)[None, :])
+            new_pool = []
+            for big, row in zip(pool, staging):
+                if big is None:
+                    new_pool.append(None)
+                    continue
+                rk = _dec.ring_from_prefill(row["k"], p_row, t_view)
+                rv = _dec.ring_from_prefill(row["v"], p_row, t_view)
+                new_pool.append(_dec._kv_write(big, (phys,), rk, rv))
+            out = [first, new_pool]
+            if draft is not None:
+                _, d_staging = _dec._forward(draft, dparams, d_staging,
+                                             toks, offset)
+                null_phys = dpool[[i for i, c in enumerate(dpool)
+                                   if c is not None][0]]["k"].shape[0] - 1
+                jd = jnp.arange(d_view)
+                blkd = jnp.minimum(jd // page, row_dbt.shape[1] - 1)
+                physd = (jnp.take(row_dbt, blkd, axis=1) * page
+                         + (jd % page)[None, :])
+                physd = jnp.where(jd[None, :] < p_row[:, None], physd,
+                                  null_phys)
+                new_dpool = []
+                for big, row in zip(dpool, d_staging):
+                    if big is None:
+                        new_dpool.append(None)
+                        continue
+                    new_dpool.append(_dec._kv_write(big, (physd,),
+                                                    row["k"], row["v"]))
+                out.append(new_dpool)
+            out.append(bt.at[slot].set(row_bt[0], mode="drop"))
+            if draft is not None:
+                out.append(dbt.at[slot].set(row_dbt[0], mode="drop"))
+            out += [tok.at[slot].set(first[0], mode="drop"),
+                    pos.at[slot].set(p_len, mode="drop"),
+                    act.at[slot].set(True, mode="drop"),
+                    temp.at[slot].set(r_temp[0], mode="drop"),
+                    topk.at[slot].set(r_topk[0], mode="drop"),
+                    topp.at[slot].set(r_topp[0], mode="drop"),
+                    keys.at[slot].set(r_key[0], mode="drop")]
+            return tuple(out)
+
+        if draft is not None:
+            return jax.jit(final, donate_argnums=(2, 3, 4, 5))
+
+        def run(params, pool, bt, tok, pos, act, temp, topk, topp, keys,
+                staging, toks, slot, offset, last_idx, p_len, row_bt,
+                r_temp, r_topk, r_topp, r_key):
+            return final(params, None, pool, None, bt, None, tok, pos,
+                         act, temp, topk, topp, keys, staging, None, toks,
+                         slot, offset, last_idx, p_len, row_bt, None,
+                         r_temp, r_topk, r_topp, r_key)
+
+        return jax.jit(run, donate_argnums=(1, 2))
+
     def _build_final_fn(self, width: int):
+        if self.paged and not self.rolling:
+            return self._build_paged_final_fn(width)
+        if self.paged:
+            return self._build_paged_ring_final_fn(width)
         model, rolling = self.model, self.rolling
         draft = self._draft_model
 
@@ -991,6 +1691,15 @@ class ServingEngine:
         return np.asarray(arr)
 
     def _state_args(self):
+        if self.paged:
+            if self._draft_model is None:
+                return (self.caches, self._dev_bt, self._dev_tok,
+                        self._dev_pos, self._dev_act, self._dev_temp,
+                        self._dev_topk, self._dev_topp, self._dev_keys)
+            return (self.caches, self.d_caches, self._dev_bt,
+                    self._dev_dbt, self._dev_tok, self._dev_pos,
+                    self._dev_act, self._dev_temp, self._dev_topk,
+                    self._dev_topp, self._dev_keys)
         if self._draft_model is None:
             return (self.caches, self._dev_tok, self._dev_pos,
                     self._dev_act, self._dev_temp, self._dev_topk,
@@ -1008,8 +1717,19 @@ class ServingEngine:
 
     def _apply_state(self, res):
         """Unpack a prefill program's ``(first, pool[, draft pool],
-        *state)`` result, installing the new device arrays; returns
-        ``first``."""
+        [block tables,] *state)`` result, installing the new device
+        arrays; returns ``first``."""
+        if self.paged:
+            if self._draft_model is None:
+                (first, self.caches, self._dev_bt, self._dev_tok,
+                 self._dev_pos, self._dev_act, self._dev_temp,
+                 self._dev_topk, self._dev_topp, self._dev_keys) = res
+            else:
+                (first, self.caches, self.d_caches, self._dev_bt,
+                 self._dev_dbt, self._dev_tok, self._dev_pos,
+                 self._dev_act, self._dev_temp, self._dev_topk,
+                 self._dev_topp, self._dev_keys) = res
+            return first
         if self._draft_model is None:
             (first, self.caches, self._dev_tok, self._dev_pos,
              self._dev_act, self._dev_temp, self._dev_topk,
@@ -1195,13 +1915,25 @@ class ServingEngine:
         """Retire a request MID-chunked-prefill (cancel / deadline /
         client disconnect): the slot goes straight back to the pool — the
         chunks already written are junk the next occupant's prefill
-        overwrites, exactly like a retired decode slot's cache row."""
+        overwrites, exactly like a retired decode slot's cache row.
+        Paged engines release the job's block plan — refcounts drop and
+        its private blocks (mid-chunk contents included) go straight back
+        to the allocator; the device table was never installed, so no
+        junk write can reach them once reallocated."""
         h = self._prefilling.pop(slot).handle
         self._handles[slot] = None
         self._free.append(slot)
+        self._release_blocks(slot)
         if h._finish(reason):
             self.stats["requests_completed"] += 1
             self._account_terminal(h, reason, time.perf_counter())
+
+    def _release_blocks(self, slot: int) -> None:
+        if self._pool is None:
+            return
+        plan = self._plans.pop(slot, None)
+        if plan is not None:
+            self._pool.release(plan)
 
     def _account_terminal(self, h: RequestHandle, reason: str,
                           now: float, held_slot: bool = True) -> None:
@@ -1275,9 +2007,21 @@ class ServingEngine:
         TTFT), then admit queued requests.  Bucketed mode gathers short
         prompts into per-bucket batches (one jitted forward each) and
         routes prompts longer than ``prefill_chunk`` to the chunked path;
-        eager mode prefills per request, as it always did."""
+        eager mode prefills per request, as it always did.
+
+        Paged engines additionally walk the radix index per admission:
+        matched prefix blocks are shared (COW at a partial boundary), the
+        block chain is reserved from the allocator, and — when blocks
+        are exhausted even after evicting cached chains — the head
+        request stays queued until retirements free blocks (FIFO
+        head-of-line, deliberately: admission order is the fairness
+        contract).  Chunked routing keys on the UNMATCHED suffix length,
+        so a long shared prompt with a hot prefix admits in one bucket
+        program."""
         did = False
         budget = self.prefills_per_step
+        if self.paged:
+            self._pool.next_epoch()
         for slot in list(self._prefilling):
             if budget <= 0:
                 break
@@ -1285,45 +2029,120 @@ class ServingEngine:
             budget -= 1
             did = True
         batch: List[RequestHandle] = []
+        plans: Dict[int, _BlockPlan] = {}
         while budget > 0 and len(self._free) > len(batch):
             h = self._pop_queued()
             if h is None:
                 break
+            plan = None
+            if self.paged:
+                plan = self._admit_blocks(h)
+                if plan is None:
+                    # no blocks even after eviction: requeue at the FRONT
+                    # and stop admitting — retirements will free blocks
+                    with self._qlock:
+                        self._queue.appendleft(h)
+                    break
             budget -= 1
             did = True
             if self.prefill_mode == "eager":
                 self._prefill(self._free.pop(), h)
-            elif len(h.prompt) > self.prefill_chunk:
-                self._start_chunked(self._free.pop(), h)
+            elif (len(h.prompt) - (plan.matched if plan else 0)
+                    > self.prefill_chunk):
+                self._start_chunked(self._free.pop(), h, plan)
             else:
+                if plan is not None:
+                    plans[h.id] = plan
+                    self._pool.publish(plan, h.prompt)
                 batch.append(h)
         if batch:
-            self._batch_prefill(batch)
+            self._batch_prefill(batch, plans)
         return did
 
-    def _batch_prefill(self, batch: List[RequestHandle]) -> None:
+    # ------------------------------------------------- paged admission
+    def _admit_blocks(self, h: RequestHandle) -> Optional[_BlockPlan]:
+        """Reserve a request's block chain (trie walk + allocation) and
+        dispatch its copy-on-write block copy, if any."""
+        total = len(h.prompt) + h.num_steps
+        if self.rolling:
+            plan = self._pool.admit(None, self._blocks_per_slot)
+        else:
+            # target and draft pools page the same chain, and both write
+            # at most up to the verify frontier — positions past `total`
+            # drop into the null block via the table, so ceil(total/bs)
+            # blocks cover every entry a live query can ever attend
+            plan = self._pool.admit(h.prompt,
+                                    -(-total // self.block_size))
+        if plan is not None and plan.cow is not None:
+            src, dst = plan.cow
+            if self._draft_model is None:
+                self.caches = self._copy_fn(self.caches, src, dst)
+            else:
+                self.caches, self.d_caches = self._copy_fn(
+                    self.caches, self.d_caches, src, dst)
+        return plan
+
+    def _row_tables(self, plan: _BlockPlan):
+        """A plan's chain as null-padded numpy block-table rows (target
+        [+ draft])."""
+        bt = np.full((self._t_tbl,), self.kv_blocks, np.int32)
+        n = min(len(plan.blocks), self._t_tbl - 1)
+        bt[:n] = plan.blocks[:n]
+        if self._draft_model is None:
+            return bt, None
+        dbt = np.full((self._d_tbl,), self.kv_blocks, np.int32)
+        n = min(len(plan.blocks), self._d_tbl - 1)
+        dbt[:n] = plan.blocks[:n]
+        return bt, dbt
+
+    def _batch_prefill(self, batch: List[RequestHandle],
+                       plans: Optional[Dict[int, _BlockPlan]] = None
+                       ) -> None:
         """Admit up to ``prefills_per_step`` short prompts in ONE jitted
         batched forward per length bucket.  The program batch is always
         ``prefills_per_step`` rows (one compiled shape per bucket);
         unfilled rows target slot ``num_slots``, so every write they
-        produce is dropped on device."""
+        produce is dropped on device.  Paged engines bucket by UNMATCHED
+        suffix length and pass each row's match frontier + block-table
+        row; ``prefill_tokens`` counts only what is actually prefilled
+        (the hit tokens live in ``prefix_hit_tokens``)."""
         groups: Dict[int, List[RequestHandle]] = {}
         for h in batch:
-            groups.setdefault(self._bucket_of(len(h.prompt)), []).append(h)
+            matched = plans[h.id].matched if (plans and h.id in plans) \
+                else 0
+            groups.setdefault(self._bucket_of(len(h.prompt) - matched),
+                              []).append(h)
         for width, group in groups.items():
             nb = self.prefills_per_step
             prompts = np.zeros((nb, width), np.int32)
+            match = np.zeros((nb,), np.int32)
             p_lens = np.ones((nb,), np.int32)
             slots = np.full((nb,), self.num_slots, np.int32)
             r_temp = np.zeros((nb,), np.float32)
             r_topk = np.zeros((nb,), np.int32)
             r_topp = np.zeros((nb,), np.float32)
             r_keys = np.zeros((nb, 2), np.uint32)
+            if self.paged:
+                row_bt = np.full((nb, self._t_tbl), self.kv_blocks,
+                                 np.int32)
+                row_dbt = (np.full((nb, self._d_tbl), self.kv_blocks,
+                                   np.int32)
+                           if self._draft_model is not None else None)
             entries: List[Tuple[int, RequestHandle]] = []
             for i, h in enumerate(group):
                 slot = self._free.pop()
                 p = len(h.prompt)
-                prompts[i, :p] = h.prompt
+                m = 0
+                if self.paged:
+                    plan = plans[h.id]
+                    m = plan.matched
+                    self._plans[slot] = plan
+                    rb, rd = self._row_tables(plan)
+                    row_bt[i] = rb
+                    if rd is not None:
+                        row_dbt[i] = rd
+                prompts[i, :p - m] = h.prompt[m:]
+                match[i] = m
                 p_lens[i] = p
                 slots[i] = slot
                 r_temp[i] = h.temperature
@@ -1336,12 +2155,24 @@ class ServingEngine:
                 self._mirror_admit(slot, h)
                 self.stats["prefills"] += 1
                 self.stats["slot_requests"][slot] += 1
-                self.stats["prefill_tokens"] += p
+                self.stats["prefill_tokens"] += p - m
                 entries.append((slot, h))
-            first = self._apply_state(self._bucket_fn(width)(
-                *self._prog_args(), self._put(prompts),
-                self._put(p_lens), self._put(slots), self._put(r_temp),
-                self._put(r_topk), self._put(r_topp), self._put(r_keys)))
+            if self.paged:
+                extra = [self._put(prompts), self._put(match),
+                         self._put(p_lens), self._put(slots),
+                         self._put(row_bt)]
+                if row_dbt is not None:
+                    extra.append(self._put(row_dbt))
+                first = self._apply_state(self._bucket_fn(width)(
+                    *self._prog_args(), *extra, self._put(r_temp),
+                    self._put(r_topk), self._put(r_topp),
+                    self._put(r_keys)))
+            else:
+                first = self._apply_state(self._bucket_fn(width)(
+                    *self._prog_args(), self._put(prompts),
+                    self._put(p_lens), self._put(slots), self._put(r_temp),
+                    self._put(r_topk), self._put(r_topp),
+                    self._put(r_keys)))
             self.stats["prefill_batches"] += 1
             self.stats["prefill_batched_requests"] += len(group)
             self.stats["prefill_batch_size_mean"] = round(
@@ -1349,17 +2180,37 @@ class ServingEngine:
                 / self.stats["prefill_batches"], 3)
             self._pending.append(("prefill", first, entries))
 
-    def _start_chunked(self, slot: int, h: RequestHandle) -> None:
+    def _start_chunked(self, slot: int, h: RequestHandle,
+                       plan: Optional[_BlockPlan] = None) -> None:
         """Claim ``slot`` for a long prompt and run its first chunk; the
         scheduler advances one more chunk per iteration (``_reap`` can
-        retire it mid-prefill)."""
+        retire it mid-prefill).  Paged non-rolling jobs skip the staging
+        cache entirely — chunks write into the request's own blocks
+        (private until the final chunk installs the device table and
+        publishes the prompt chain into the trie), starting at the
+        matched frontier so a hot shared prefix skips its chunks."""
         h.slot = slot
         h.started_at = time.perf_counter()
         self._handles[slot] = h
-        staging = init_cache(self.model, 1, self.max_len)
-        d_staging = (init_cache(self._draft_model, 1, self.max_len)
-                     if self._draft_model is not None else None)
-        self._prefilling[slot] = _PrefillJob(h, staging, d_staging)
+        if self.paged:
+            self._plans[slot] = plan
+            bt, dbt = self._row_tables(plan)
+            bt_d = self._put(bt[None])
+            dbt_d = self._put(dbt[None]) if dbt is not None else None
+            if self.rolling:
+                staging = init_cache(self.model, 1, self.max_len)
+                d_staging = (init_cache(self._draft_model, 1, self.max_len)
+                             if self._draft_model is not None else None)
+                job = _PrefillJob(h, staging, d_staging, bt_d, dbt_d)
+            else:
+                job = _PrefillJob(h, bt=bt_d, dbt=dbt_d)
+                job.written = plan.matched
+        else:
+            staging = init_cache(self.model, 1, self.max_len)
+            d_staging = (init_cache(self._draft_model, 1, self.max_len)
+                         if self._draft_model is not None else None)
+            job = _PrefillJob(h, staging, d_staging)
+        self._prefilling[slot] = job
         self.stats["prefills"] += 1
         self.stats["slot_requests"][slot] += 1
         self._advance_chunk(slot)
@@ -1383,8 +2234,22 @@ class ServingEngine:
         toks_d = self._put(toks)
         self.stats["prefill_chunks"] += 1
         self.stats["prefill_tokens"] += real
+        paged_direct = self.paged and not self.rolling
+        if paged_direct:
+            off_vec = self._put(np.asarray([offset], np.int32))
+            plen_vec = self._put(np.asarray([p_len], np.int32))
         if not final:
-            if self._draft_model is not None:
+            if paged_direct:
+                if self._draft_model is not None:
+                    self.caches, self.d_caches = self._stage_fn(width)(
+                        self.params, self._draft_params, self.caches,
+                        self.d_caches, toks_d, off_vec, plen_vec,
+                        job.bt, job.dbt)
+                else:
+                    self.caches = self._stage_fn(width)(
+                        self.params, self.caches, toks_d, off_vec,
+                        plen_vec, job.bt)
+            elif self._draft_model is not None:
                 job.staging, job.d_staging = self._stage_fn(width)(
                     self.params, self._draft_params, job.staging,
                     job.d_staging, toks_d, offset)
@@ -1392,7 +2257,29 @@ class ServingEngine:
                 job.staging = self._stage_fn(width)(
                     self.params, job.staging, toks_d, offset)
         else:
-            if self._draft_model is not None:
+            if paged_direct:
+                if self._draft_model is not None:
+                    first = self._apply_state(self._final_fn(width)(
+                        *self._prog_args(), toks_d, slot, off_vec,
+                        plen_vec, real - 1, job.bt, job.dbt,
+                        *self._sampling_row(h)))
+                else:
+                    first = self._apply_state(self._final_fn(width)(
+                        *self._prog_args(), toks_d, slot, off_vec,
+                        plen_vec, real - 1, job.bt,
+                        *self._sampling_row(h)))
+            elif self.paged:  # rolling: staged chunks, block-table commit
+                if self._draft_model is not None:
+                    first = self._apply_state(self._final_fn(width)(
+                        *self._prog_args(), job.staging, job.d_staging,
+                        toks_d, slot, offset, real - 1, p_len,
+                        job.bt, job.dbt, *self._sampling_row(h)))
+                else:
+                    first = self._apply_state(self._final_fn(width)(
+                        *self._prog_args(), job.staging, toks_d, slot,
+                        offset, real - 1, p_len, job.bt,
+                        *self._sampling_row(h)))
+            elif self._draft_model is not None:
                 first = self._apply_state(self._final_fn(width)(
                     *self._prog_args(), job.staging, job.d_staging,
                     toks_d, slot, offset, real - 1, p_len,
@@ -1403,6 +2290,10 @@ class ServingEngine:
                     slot, offset, real - 1, p_len, *self._sampling_row(h)))
             job.staging = None
             job.d_staging = None
+            if self.paged:
+                # the chain's contents are now fully dispatched: publish
+                # the prompt's full blocks into the prefix trie
+                self._pool.publish(self._plans[slot], h.prompt)
         job.written += real
         if final:
             del self._prefilling[slot]
@@ -1446,8 +2337,23 @@ class ServingEngine:
             # deactivate the device row too: an in-flight lookahead step
             # may compute one junk token for it (drained entries skip
             # finished handles), but from the next dispatch on the slot is
-            # inert until a prefill program rewrites it
-            self._dev_act = self._deact_fn(self._dev_act, slot)
+            # inert until a prefill program rewrites it.  Paged: the
+            # block-table row is re-nulled IN THE SAME program, so that
+            # junk (and every later idle pass) drops into the null block
+            # while the released blocks go back to the allocator — the
+            # one in-flight lookahead write ordered before any program
+            # that could reuse them
+            if self.paged:
+                if self._draft_model is None:
+                    self._dev_act, self._dev_bt = self._deact_fn(
+                        self._dev_act, self._dev_bt, slot)
+                else:
+                    (self._dev_act, self._dev_bt,
+                     self._dev_dbt) = self._deact_fn(
+                        self._dev_act, self._dev_bt, self._dev_dbt, slot)
+                self._release_blocks(slot)
+            else:
+                self._dev_act = self._deact_fn(self._dev_act, slot)
         if h._finish(reason):  # no-op when _declare_dead already failed it
             self.stats["requests_completed"] += 1
             self._account_terminal(h, reason, time.perf_counter())
@@ -1508,10 +2414,7 @@ class ServingEngine:
             # their per-row counts into the one drained array
             (out, self.caches, self.d_caches, self._dev_tok,
              self._dev_pos) = self._spec_fn(
-                self.params, self._draft_params, self.caches,
-                self.d_caches, self._dev_tok, self._dev_pos,
-                self._dev_act, self._dev_temp, self._dev_topk,
-                self._dev_topp, self._dev_keys)
+                self.params, self._draft_params, *self._state_args())
             self.stats["decode_steps"] += 1
             self.stats["verify_calls"] += 1
             self.stats["target_calls"] += 1
@@ -1748,7 +2651,12 @@ class ServingEngine:
             spec_draft=(None if self._draft_model is None
                         else (self._draft_model, self._draft_params)),
             spec_len=self.spec_len, quantize=self.quantize,
-            kv_dtype=self.kv_dtype)
+            kv_dtype=self.kv_dtype,
+            # paged knobs carry over with the SAME arena shape but a
+            # FRESH trie + allocator — cached prefix chains belong to the
+            # dead pool's arena contents, which the clone does not share
+            paged=self.paged, block_size=self.block_size,
+            kv_blocks=self.kv_blocks)
         # quantized clones re-quantize idempotently; the f32 skeleton the
         # hot-reload path maps pulled weights onto carries over as-is
         # (the clone's params are already quantized, so it could not
@@ -1761,10 +2669,21 @@ class ServingEngine:
 
     @property
     def kv_pool_bytes(self) -> int:
-        """On-device bytes of the target KV slot pool (int8 codes + scales
-        for ``kv_dtype="int8"`` pools, itemsize-true otherwise) — the
-        byte-accounting behind ``serving_quant_capacity_slots``."""
+        """On-device bytes of the target KV slot pool — the flat block
+        arena for paged engines — (int8 codes + scales for
+        ``kv_dtype="int8"`` pools, itemsize-true otherwise): the
+        byte-accounting behind ``serving_quant_capacity_slots`` and
+        ``serving_paged_capacity_slots``."""
         return _quant.kv_cache_bytes(self.caches)
+
+    @property
+    def kv_blocks_in_use(self) -> Optional[int]:
+        """Paged engines: blocks currently HELD by live requests
+        (privately-owned + trie-shared with ref > 0).  0 when idle —
+        refcount-0 cached chains are reusable capacity, not leaks; the
+        resilience matrix asserts this returns to 0 after every
+        retirement path.  None for dense engines."""
+        return None if self._pool is None else self._pool.in_use()
 
     def warmup(self) -> "ServingEngine":
         """Compile the engine's jitted programs before serving traffic: the
@@ -1806,10 +2725,7 @@ class ServingEngine:
         if self._draft_model is not None:
             (_, self.caches, self.d_caches, self._dev_tok,
              self._dev_pos) = self._spec_fn(
-                self.params, self._draft_params, self.caches,
-                self.d_caches, self._dev_tok, self._dev_pos,
-                self._dev_act, self._dev_temp, self._dev_topk,
-                self._dev_topp, self._dev_keys)
+                self.params, self._draft_params, *self._state_args())
             jax.block_until_ready(self._dev_tok)
         else:
             out, self.caches, self._dev_pos = self._decode_fn(
@@ -1818,17 +2734,47 @@ class ServingEngine:
             jax.block_until_ready(out)
         # ...every bucket's batched prefill program (all rows dropped;
         # quantized pools and draft-pool prefill compile here too — the
-        # commit/quantize paths live inside these same programs)...
+        # commit/quantize paths live inside these same programs; paged
+        # warmups pass all-null block tables, so every cache write drops
+        # into the null block)...
         nb = self.prefills_per_step
         drop = jnp.full((nb,), self.num_slots, jnp.int32)
+        if self.paged:
+            null_bt = jnp.full((nb, self._t_tbl), self.kv_blocks,
+                               jnp.int32)
+            null_dbt = (jnp.full((nb, self._d_tbl), self.kv_blocks,
+                                 jnp.int32)
+                        if self._draft_model is not None else None)
+            # the copy-on-write block-copy program (null → null)
+            if self._draft_model is None:
+                self.caches = self._copy_fn(self.caches, self.kv_blocks,
+                                            self.kv_blocks)
+            else:
+                self.caches, self.d_caches = self._copy_fn(
+                    self.caches, self.d_caches, self.kv_blocks,
+                    self.kv_blocks)
         for width in self._buckets:
-            self._apply_state(self._bucket_fn(width)(
-                *self._prog_args(),
-                jnp.zeros((nb, width), jnp.int32),
-                jnp.ones((nb,), jnp.int32), drop,
-                jnp.zeros((nb,), jnp.float32), jnp.zeros((nb,), jnp.int32),
-                jnp.zeros((nb,), jnp.float32),
-                jnp.zeros((nb, 2), jnp.uint32)))
+            if self.paged:
+                extra = [jnp.zeros((nb, width), jnp.int32),
+                         jnp.zeros((nb,), jnp.int32),
+                         jnp.ones((nb,), jnp.int32), drop, null_bt]
+                if null_dbt is not None:
+                    extra.append(null_dbt)
+                self._apply_state(self._bucket_fn(width)(
+                    *self._prog_args(), *extra,
+                    jnp.zeros((nb,), jnp.float32),
+                    jnp.zeros((nb,), jnp.int32),
+                    jnp.zeros((nb,), jnp.float32),
+                    jnp.zeros((nb, 2), jnp.uint32)))
+            else:
+                self._apply_state(self._bucket_fn(width)(
+                    *self._prog_args(),
+                    jnp.zeros((nb, width), jnp.int32),
+                    jnp.ones((nb,), jnp.int32), drop,
+                    jnp.zeros((nb,), jnp.float32),
+                    jnp.zeros((nb,), jnp.int32),
+                    jnp.zeros((nb,), jnp.float32),
+                    jnp.zeros((nb, 2), jnp.uint32)))
         # ...and the chunk-step programs, when a prompt can be long enough
         # to take the chunked path at all
         if self.max_len > self.prefill_chunk:
@@ -1837,6 +2783,26 @@ class ServingEngine:
                    jnp.zeros((1, 2), jnp.uint32))
             for width in sorted({self._chunk_width, *self._buckets}):
                 toks = jnp.zeros((1, width), jnp.int32)
+                if self.paged and not self.rolling:
+                    off = jnp.zeros((1,), jnp.int32)
+                    plen = jnp.ones((1,), jnp.int32)
+                    bt1 = null_bt[:1]
+                    if self._draft_model is not None:
+                        self.caches, self.d_caches = self._stage_fn(width)(
+                            self.params, self._draft_params, self.caches,
+                            self.d_caches, toks, off, plen, bt1,
+                            null_dbt[:1])
+                        self._apply_state(self._final_fn(width)(
+                            *self._prog_args(), toks, self.num_slots,
+                            off, plen, 0, bt1, null_dbt[:1], *one))
+                    else:
+                        self.caches = self._stage_fn(width)(
+                            self.params, self.caches, toks, off, plen,
+                            bt1)
+                        self._apply_state(self._final_fn(width)(
+                            *self._prog_args(), toks, self.num_slots,
+                            off, plen, 0, bt1, *one))
+                    continue
                 staging = init_cache(self.model, 1, self.max_len)
                 if self._draft_model is not None:
                     d_staging = init_cache(self._draft_model, 1,
@@ -1844,15 +2810,26 @@ class ServingEngine:
                     staging, d_staging = self._stage_fn(width)(
                         self.params, self._draft_params, staging,
                         d_staging, toks, 0)
-                    self._apply_state(self._final_fn(width)(
-                        *self._prog_args(), staging, d_staging, toks,
-                        self.num_slots, 0, 0, 1, *one))
+                    if self.paged:  # rolling paged: block-table commit
+                        self._apply_state(self._final_fn(width)(
+                            *self._prog_args(), staging, d_staging, toks,
+                            self.num_slots, 0, 0, 1, null_bt[:1],
+                            null_dbt[:1], *one))
+                    else:
+                        self._apply_state(self._final_fn(width)(
+                            *self._prog_args(), staging, d_staging, toks,
+                            self.num_slots, 0, 0, 1, *one))
                 else:
                     staging = self._stage_fn(width)(self.params, staging,
                                                     toks, 0)
-                    self._apply_state(self._final_fn(width)(
-                        *self._prog_args(), staging, toks,
-                        self.num_slots, 0, 0, 1, *one))
+                    if self.paged:
+                        self._apply_state(self._final_fn(width)(
+                            *self._prog_args(), staging, toks,
+                            self.num_slots, 0, 0, 1, null_bt[:1], *one))
+                    else:
+                        self._apply_state(self._final_fn(width)(
+                            *self._prog_args(), staging, toks,
+                            self.num_slots, 0, 0, 1, *one))
         jax.block_until_ready(jax.tree_util.tree_leaves(self.caches)[0])
         return self
 

@@ -49,7 +49,9 @@ def make_trace(num_requests: int, seed: int = 0, vocab: int = 16,
                num_steps: int = 16, temperature: float = 0.0,
                sampled_fraction: float = 0.5,
                prompt_lengths: Sequence[int] = PROMPT_LENGTHS,
-               pattern: str = "random") -> List[Dict[str, Any]]:
+               pattern: str = "random",
+               prefix_groups: Optional[int] = None,
+               prefix_len: int = 0) -> List[Dict[str, Any]]:
     """A deterministic request trace: seeded prompt contents + lengths, a
     ``sampled_fraction`` of requests sampling at ``temperature`` (per-
     request seeds), the rest greedy — so the slot batch always mixes
@@ -61,8 +63,25 @@ def make_trace(num_requests: int, seed: int = 0, vocab: int = 16,
     vocab) run instead of iid tokens — in-distribution for the
     ``build_spec_engine`` trained pair, the way real serving prompts are
     in-distribution for a production draft (speculation's accept rate,
-    and therefore its win, is a property of the traffic)."""
+    and therefore its win, is a property of the traffic).
+
+    ``prefix_groups``/``prefix_len``: the SHARED-PREFIX trace the paged
+    engine's radix index exists for — requests split round-robin across
+    ``prefix_groups`` seeded common prefixes of ``prefix_len`` tokens
+    (the system prompt / few-shot header / per-tenant template shape),
+    each followed by the request's own drawn suffix.  With a paged
+    engine every admission after a group's first is a prefix hit that
+    prefills only the suffix; a dense engine prefills ``prefix_len +
+    suffix`` every time — the TTFT comparison ``bench.py``'s
+    ``serving_prefix_ttft_p99_ms`` leg measures."""
     rng = np.random.default_rng(seed)
+    prefixes = None
+    if prefix_groups is not None:
+        if int(prefix_groups) < 1 or int(prefix_len) < 1:
+            raise ValueError("prefix_groups needs prefix_groups >= 1 and "
+                             "prefix_len >= 1")
+        prefixes = [rng.integers(0, vocab, int(prefix_len)).astype(np.int32)
+                    for _ in range(int(prefix_groups))]
     trace = []
     for i in range(int(num_requests)):
         p_len = int(prompt_lengths[rng.integers(0, len(prompt_lengths))])
@@ -71,6 +90,9 @@ def make_trace(num_requests: int, seed: int = 0, vocab: int = 16,
             prompt = ((start + np.arange(p_len)) % vocab).astype(np.int32)
         else:
             prompt = rng.integers(0, vocab, p_len).astype(np.int32)
+        if prefixes is not None:
+            prompt = np.concatenate(
+                [prefixes[i % len(prefixes)], prompt]).astype(np.int32)
         req: Dict[str, Any] = {
             "prompt": prompt,
             "num_steps": int(num_steps),
@@ -123,6 +145,19 @@ def _metrics(engine, latencies: List[float], wall_s: float,
         "spec_accept_rate": (round(s["accepted"] / s["drafted"], 4)
                              if s["drafted"] else None),
         "spec_verify_calls": s["verify_calls"] or None,
+        # paged-pool observables (zero unless paged=True): hit_rate is the
+        # fraction of demanded prompt tokens served from the radix index
+        # instead of prefilled — the byte-accounted proof of block reuse
+        "prefix_hits": s["prefix_hits"],
+        "prefix_hit_tokens": s["prefix_hit_tokens"],
+        "prefix_hit_rate": (
+            round(s["prefix_hit_tokens"]
+                  / (s["prefix_hit_tokens"] + s["prefill_tokens"]), 4)
+            if s["prefix_hit_tokens"] + s["prefill_tokens"] else None),
+        "blocks_allocated": s["blocks_allocated"],
+        "blocks_reused": s["blocks_reused"],
+        "cow_copies": s["cow_copies"],
+        "kv_pool_bytes": s["kv_pool_bytes"],
     }
 
 
@@ -300,7 +335,10 @@ def build_engine(num_slots: int = 4, max_len: int = 32, vocab: int = 16,
                  spec_draft: Optional[str] = None,
                  spec_len: Optional[int] = None,
                  quantize: Optional[str] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 paged: bool = False,
+                 block_size: Optional[int] = None,
+                 kv_blocks: Optional[int] = None):
     """A small random-weight LM + engine (throughput benches measure
     scheduling and batching, not model quality) — one place so bench,
     tests, and the CLI agree on the workload shape.  ``prefill_mode``/
@@ -345,6 +383,12 @@ def build_engine(num_slots: int = 4, max_len: int = 32, vocab: int = 16,
         kw["quantize"] = quantize
     if kv_dtype is not None:
         kw["kv_dtype"] = kv_dtype
+    if paged:
+        kw["paged"] = True
+        if block_size is not None:
+            kw["block_size"] = int(block_size)
+        if kv_blocks is not None:
+            kw["kv_blocks"] = int(kv_blocks)
     engine = ServingEngine(fitted, num_slots=num_slots, max_len=max_len,
                            queue_capacity=queue_capacity, **kw)
     return fitted, engine
@@ -437,17 +481,40 @@ def main():
     ap.add_argument("--kv-dtype", choices=("int8",), default=None,
                     help="int8 KV slot pool (codes + per-entry scales, "
                          "~half the slot bytes)")
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV pool: block-granular arena + "
+                         "per-request block tables + radix prefix "
+                         "sharing (see --block-size / --prefix-groups)")
+    ap.add_argument("--block-size", type=int, default=None,
+                    help="paged pool block size in tokens (default 16)")
+    ap.add_argument("--kv-blocks", type=int, default=None,
+                    help="paged pool arena size in blocks (default: the "
+                         "dense pool's capacity)")
+    ap.add_argument("--prefix-groups", type=int, default=None,
+                    help="shared-prefix trace: requests split round-robin "
+                         "across this many seeded common prefixes")
+    ap.add_argument("--prefix-len", type=int, default=16,
+                    help="shared-prefix length in tokens "
+                         "(with --prefix-groups)")
+    ap.add_argument("--max-len", type=int, default=32,
+                    help="engine max_len (raise for long shared prefixes)")
     args = ap.parse_args()
 
     fitted, engine = build_engine(num_slots=args.slots,
+                                  max_len=args.max_len,
                                   prefill_mode=args.prefill_mode,
                                   prefill_chunk=args.prefill_chunk,
                                   spec_draft=args.spec_draft,
                                   spec_len=args.spec_len,
                                   quantize=args.quantize,
-                                  kv_dtype=args.kv_dtype)
+                                  kv_dtype=args.kv_dtype,
+                                  paged=args.paged,
+                                  block_size=args.block_size,
+                                  kv_blocks=args.kv_blocks)
     trace = make_trace(args.requests, num_steps=args.steps,
-                       temperature=args.temperature)
+                       temperature=args.temperature,
+                       prefix_groups=args.prefix_groups,
+                       prefix_len=args.prefix_len)
     try:
         closed = run_closed_loop(engine, trace,
                                  concurrency=args.concurrency,
@@ -463,6 +530,18 @@ def main():
                 "drafted": engine.stats["drafted"],
                 "accepted": engine.stats["accepted"],
                 "verify_calls": engine.stats["verify_calls"]}))
+        if args.paged:
+            print(json.dumps({
+                "mode": "paged",
+                "block_size": engine.block_size,
+                "kv_blocks": engine.kv_blocks,
+                "prefix_hits": closed["prefix_hits"],
+                "prefix_hit_tokens": closed["prefix_hit_tokens"],
+                "prefix_hit_rate": closed["prefix_hit_rate"],
+                "blocks_allocated": closed["blocks_allocated"],
+                "blocks_reused": closed["blocks_reused"],
+                "cow_copies": closed["cow_copies"],
+                "kv_pool_bytes": closed["kv_pool_bytes"]}))
         if args.ttft:
             print(json.dumps({
                 "mode": "ttft", "prefill_mode": args.prefill_mode,
@@ -481,12 +560,16 @@ def main():
                                     / seq["tokens_per_sec"], 2)}))
         for qps in filter(None, args.qps_sweep.split(",")):
             _, engine = build_engine(num_slots=args.slots,
+                                     max_len=args.max_len,
                                      prefill_mode=args.prefill_mode,
                                      prefill_chunk=args.prefill_chunk,
                                      spec_draft=args.spec_draft,
                                      spec_len=args.spec_len,
                                      quantize=args.quantize,
-                                     kv_dtype=args.kv_dtype)
+                                     kv_dtype=args.kv_dtype,
+                                     paged=args.paged,
+                                     block_size=args.block_size,
+                                     kv_blocks=args.kv_blocks)
             point = run_open_loop(engine, trace, qps=float(qps))
             engine.stop()
             print(json.dumps({"mode": "open_loop", **point}))

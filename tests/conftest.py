@@ -53,3 +53,9 @@ def pytest_configure(config):
         "stream: streaming-ingestion / online-learning contract tests "
         "(tier-1 ones are generator-backed — no live sockets or sleeps on "
         "the fast path; socket-feed coverage uses socketpair only)")
+    config.addinivalue_line(
+        "markers",
+        "paged: paged-KV-pool / radix-prefix-sharing serving tests "
+        "(tier-1 ones run small seeded traces inline — no sleeps; the "
+        "arena-pressure soaks and timing comparisons are additionally "
+        "marked slow, mirroring the stream marker's tiering)")

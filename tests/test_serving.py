@@ -426,3 +426,84 @@ def test_generate_unchanged_by_sampling_factor():
     b = np.asarray(fm.generate(PROMPT[None], 8, temperature=0.7, top_k=4,
                                top_p=0.9, rng=jax.random.PRNGKey(0)))
     np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# paged-KV substrate (PR 12): block-table decode is a storage relayout
+# ---------------------------------------------------------------------------
+
+@pytest.mark.paged
+def test_paged_decode_step_bit_identical_to_dense():
+    """Raw substrate parity: the same prefill + decode chain through a
+    dense (B, S) cache and through a flat block arena + block tables
+    produces BIT-identical logits at every step (the paged serving
+    engine's exactness rests on this)."""
+    fm = _fitted(seed=6)
+    model, params = fm.model, fm.params
+    B, max_len, bs = 2, 16, 4
+    nblocks = B * (max_len // bs)
+    dense = decode.init_cache(model, B, max_len)
+    arena = decode.init_paged_arena(model, nblocks, bs)
+    bt = np.full((B, max_len // bs + 1), nblocks, np.int32)
+    for r in range(B):
+        bt[r, :max_len // bs] = np.arange(max_len // bs) + r * (
+            max_len // bs)
+    bt = jnp.asarray(bt)
+    prompt = jnp.asarray(np.stack([PROMPT, PROMPT[::-1].copy()]))
+    zero = jnp.zeros((B,), jnp.int32)
+    ld, dense = decode._forward(model, params, dense, prompt, 0)
+    pv = decode.PagedView(bt, bs, max_len, floor=zero,
+                          ceil=jnp.full((B,), 4, jnp.int32),
+                          qcap=jnp.full((B,), 3, jnp.int32))
+    lp, arena = decode._forward(model, params, arena, prompt, zero,
+                                paged=pv)
+    np.testing.assert_array_equal(np.asarray(ld), np.asarray(lp))
+    tok = jnp.argmax(ld[:, -1], axis=-1).astype(jnp.int32)
+    pos = jnp.full((B,), 4, jnp.int32)
+    pvd = decode.PagedView(bt, bs, max_len)
+    for _ in range(6):
+        ld, dense = decode.decode_step(model, params, dense, tok, pos)
+        lp, arena = decode.decode_step(model, params, arena, tok, pos,
+                                       paged=pvd)
+        np.testing.assert_array_equal(np.asarray(ld), np.asarray(lp))
+        tok = jnp.argmax(ld, axis=-1).astype(jnp.int32)
+        pos = pos + 1
+
+
+@pytest.mark.paged
+def test_paged_write_floor_protects_shared_blocks():
+    """The copy-on-write safety rail: writes below a row's ``floor`` land
+    in the NULL block, so a sharer can run the full forward over a prompt
+    whose prefix blocks belong to someone else without perturbing them."""
+    fm = _fitted(seed=7)
+    model, params = fm.model, fm.params
+    bs = 4
+    arena = decode.init_paged_arena(model, 4, bs)
+    bt = jnp.asarray([[0, 1, 4]], np.int32)
+    prompt = jnp.asarray(PROMPT[None])
+    pv = decode.PagedView(bt, bs, 8, floor=jnp.full((1,), 4, jnp.int32),
+                          ceil=jnp.full((1,), 8, jnp.int32))
+    li = [i for i, c in enumerate(arena) if c is not None][0]
+    before = np.asarray(arena[li]["k"][:bs])       # block 0 (the "shared")
+    # the suffix forward starts AT the floor, exactly like a prefix-hit
+    # admission: queries at positions 4..7, floor 4
+    _, arena2 = decode._forward(model, params, arena, prompt,
+                                jnp.full((1,), 4, jnp.int32), paged=pv)
+    np.testing.assert_array_equal(np.asarray(arena2[li]["k"][:bs]), before)
+    # while positions >= floor DID write their block (block id 1)
+    assert np.abs(np.asarray(arena2[li]["k"][bs:2 * bs])).sum() > 0
+
+
+@pytest.mark.paged
+def test_paged_gather_layout():
+    """ops.attention.paged_gather: entry (r, p) of the view is arena slot
+    ``table[r, p // bs] * bs + p % bs``, null entries read the null
+    block, and the table's trailing null column absorbs out-of-range
+    logical blocks (the spec-lookahead clip)."""
+    from distkeras_tpu.ops.attention import paged_gather
+    bs, nblocks = 2, 3
+    arena = jnp.arange((nblocks + 1) * bs, dtype=jnp.float32)
+    bt = jnp.asarray([[2, 0, 3], [1, 3, 3]], np.int32)
+    view = np.asarray(paged_gather(arena, bt, bs, 6))
+    np.testing.assert_array_equal(view[0], [4, 5, 0, 1, 6, 7])
+    np.testing.assert_array_equal(view[1], [2, 3, 6, 7, 6, 7])

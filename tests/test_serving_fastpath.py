@@ -630,3 +630,336 @@ def test_batched_prefill_beats_sequential_eager_prefill(fitted):
     eager = run("eager")
     fast = run("bucketed")
     assert fast < eager, (fast, eager)
+
+
+# ---------------------------------------------------------------------------
+# paged KV pool + radix prefix sharing (PR 12)
+# ---------------------------------------------------------------------------
+
+def _assert_no_block_leaks(eng):
+    """Every retirement path must return the pool to baseline: no block
+    held by a live request, and free + cached + private == arena."""
+    assert eng.kv_blocks_in_use == 0
+    assert eng._pool.check_conservation()
+
+
+@pytest.mark.paged
+@pytest.mark.parametrize("kw", [
+    {},                                                       # greedy
+    {"temperature": 0.7, "seed": 11},                         # plain sample
+    {"temperature": 0.7, "top_k": 5, "top_p": 0.9, "seed": 11},
+])
+def test_paged_lone_request_matches_dense_and_generate(fitted, kw):
+    """The paged pool is a storage relayout, not a numerics change: a lone
+    request through block-table decode/prefill emits tokens identical to
+    the dense engine and to offline generate."""
+    eng = ServingEngine(fitted, num_slots=3, max_len=24, paged=True,
+                        block_size=4)
+    h = eng.submit(PROMPT, 8, **kw)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h.result(), _want(fitted, h, max_len=24))
+    _assert_no_block_leaks(eng)
+
+
+@pytest.mark.paged
+def test_paged_rolling_lone_request_matches_generate(windowed):
+    """Rolling paged pools: the ring lives in blocks behind the table
+    (fixed per-slot allocation, no sharing) — tokens identical to rolling
+    generate, bucketed AND chunked admission."""
+    eng = ServingEngine(windowed, num_slots=2, max_len=24, rolling=True,
+                        paged=True, block_size=4)
+    h = eng.submit(PROMPT, 10)
+    eng.run_until_idle()
+    want = np.asarray(windowed.generate(h.prompt[None], 10, max_len=24,
+                                        rolling=True))[0]
+    np.testing.assert_array_equal(h.result(), want)
+    _assert_no_block_leaks(eng)
+    eng = ServingEngine(windowed, num_slots=2, max_len=28, rolling=True,
+                        paged=True, block_size=4, prefill_chunk=4)
+    long_p = (np.arange(1, 14, dtype=np.int32) * 5) % VOCAB
+    h = eng.submit(long_p, 6, temperature=0.5, seed=7)
+    eng.run_until_idle()
+    want = np.asarray(windowed.generate(
+        h.prompt[None], 6, max_len=28, rolling=True,
+        temperature=0.5, rng=h.key))[0]
+    np.testing.assert_array_equal(h.result(), want)
+    _assert_no_block_leaks(eng)
+
+
+@pytest.mark.paged
+def test_paged_spec_greedy_identity_and_sampled_determinism(fitted):
+    """Speculation on the paged pool: greedy committed chains stay the
+    target argmax chain (== generate), and sampled rows reproduce the
+    dense speculative engine's draws exactly (same key-fold schedule —
+    the block tables change storage, not randomness)."""
+    eng = ServingEngine(fitted, num_slots=3, max_len=24, paged=True,
+                        block_size=4, spec_draft=fitted, spec_len=3)
+    g = eng.submit(PROMPT, 8)
+    s = eng.submit(np.array([5, 6, 7], np.int32), 8, temperature=0.7,
+                   seed=5)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(g.result(), _want(fitted, g, max_len=24))
+    dense = ServingEngine(fitted, num_slots=3, max_len=24,
+                          spec_draft=fitted, spec_len=3)
+    s2 = dense.submit(np.array([5, 6, 7], np.int32), 8, temperature=0.7,
+                      seed=5)
+    dense.run_until_idle()
+    np.testing.assert_array_equal(s.result(), s2.result())
+    assert eng.stats["drafted"] > 0
+    _assert_no_block_leaks(eng)
+
+
+@pytest.mark.paged
+def test_paged_prefix_sharing_reuses_blocks_exactly(fitted):
+    """The tentpole contract: a second admission sharing a full-block
+    prefix walks the trie, SHARES the matched blocks (allocation shrinks
+    by exactly the reuse — byte-accounted, not just faster), prefills
+    only its suffix, and still emits generate-identical tokens."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=28, paged=True,
+                        block_size=4)
+    prefix = (np.arange(12) % VOCAB).astype(np.int32)      # 3 full blocks
+    h1 = eng.submit(np.concatenate([prefix, [1, 2]]).astype(np.int32), 6)
+    eng.run_until_idle()
+    alloc1 = eng.stats["blocks_allocated"]
+    pf1 = eng.stats["prefill_tokens"]
+    h2 = eng.submit(np.concatenate([prefix, [5, 6]]).astype(np.int32), 6,
+                    temperature=0.5, seed=3)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h1.result(), _want(fitted, h1,
+                                                     max_len=28))
+    np.testing.assert_array_equal(h2.result(), _want(fitted, h2,
+                                                     max_len=28))
+    assert eng.stats["prefix_hits"] == 1
+    assert eng.stats["prefix_hit_tokens"] == 12
+    assert eng.stats["blocks_reused"] == 3
+    # h2 allocated 3 fewer fresh blocks than a cold admission would
+    assert (eng.stats["blocks_allocated"] - alloc1
+            == alloc1 - eng.stats["blocks_reused"])
+    # and prefilled only its 2-token suffix
+    assert eng.stats["prefill_tokens"] - pf1 == 2
+    _assert_no_block_leaks(eng)
+
+
+@pytest.mark.paged
+def test_paged_cow_copies_partial_boundary_block(fitted):
+    """A prompt matching a cached chain PARTIALLY into a block gets a
+    copy-on-write duplicate: the original stays shared/cached, the new
+    request writes its divergent suffix into its own copy — outputs
+    exact on both sides."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=28, paged=True,
+                        block_size=4)
+    p1 = (np.arange(10) % VOCAB).astype(np.int32)  # 2 full + 2 boundary
+    h1 = eng.submit(np.concatenate([p1, [1, 2]]).astype(np.int32), 4)
+    eng.run_until_idle()
+    h2 = eng.submit(np.concatenate([p1, [9, 9]]).astype(np.int32), 4)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h2.result(), _want(fitted, h2,
+                                                     max_len=28))
+    assert eng.stats["cow_copies"] == 1
+    assert eng.stats["prefix_hit_tokens"] == 10   # 8 shared + 2 copied
+    _assert_no_block_leaks(eng)
+
+
+@pytest.mark.paged
+def test_paged_chunked_prefill_and_prefix_hit_skips_chunks(fitted):
+    """Paged chunked prefill writes straight into the request's blocks
+    (no staging — they are private until the final chunk installs the
+    table), stays generate-identical, and a later admission hitting the
+    long prompt's prefix skips the chunked path entirely (suffix fits a
+    bucket)."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=32, paged=True,
+                        block_size=4, prefill_chunk=4)
+    long_p = (np.arange(1, 14, dtype=np.int32) * 3) % VOCAB  # 13 tokens
+    h = eng.submit(long_p, 8)
+    h2 = eng.submit(PROMPT, 4)
+    eng.run_until_idle()
+    assert eng.stats["prefill_chunks"] == 4
+    np.testing.assert_array_equal(h.result(), _want(fitted, h, max_len=32))
+    np.testing.assert_array_equal(h2.result(), _want(fitted, h2,
+                                                     max_len=32))
+    chunks0 = eng.stats["prefill_chunks"]
+    h3 = eng.submit(np.concatenate([long_p[:12], [9, 9]]).astype(np.int32),
+                    6)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h3.result(), _want(fitted, h3,
+                                                     max_len=32))
+    assert eng.stats["prefill_chunks"] == chunks0  # hit → bucket path
+    assert eng.stats["prefix_hits"] >= 1
+    _assert_no_block_leaks(eng)
+
+
+@pytest.mark.paged
+def test_paged_capacity_pressure_evicts_and_backpressures(fitted):
+    """A deliberately tiny arena: admissions queue when live requests
+    hold every block, cached refcount-0 chains are LRU-evicted to make
+    room, every request still completes exactly, and the pool returns to
+    baseline."""
+    eng = ServingEngine(fitted, num_slots=4, max_len=24, paged=True,
+                        block_size=4, kv_blocks=8).warmup()
+    hs = [eng.submit((np.arange(i + 1, i + 5) % VOCAB).astype(np.int32),
+                     6, seed=i) for i in range(6)]
+    eng.run_until_idle()
+    for h in hs:
+        np.testing.assert_array_equal(h.result(), _want(fitted, h,
+                                                        max_len=24))
+    assert eng.stats["blocks_evicted"] > 0
+    _assert_no_block_leaks(eng)
+
+
+@pytest.mark.paged
+def test_paged_transfer_discipline_zero_h2d_one_d2h(fitted):
+    """PR 9's decode transfer contract survives paging: block tables are
+    device-resident (installed by the prefill program, nulled by the
+    retire program), so a decode-only iteration still uploads nothing
+    and reads back exactly the sampled token row."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=24, paged=True,
+                        block_size=4).warmup()
+    h = eng.submit(PROMPT, 14)
+    eng.step()
+    orig = eng._decode_fn
+
+    def checked(*args):
+        leaves = jax.tree_util.tree_leaves(args)
+        assert all(isinstance(a, jax.Array) for a in leaves), \
+            "paged decode step received a host array (implicit h2d)"
+        return orig(*args)
+
+    eng._decode_fn = checked
+    h0, d0 = eng.stats["h2d_transfers"], eng.stats["d2h_transfers"]
+    for _ in range(6):
+        eng.step()
+    assert eng.stats["h2d_transfers"] - h0 == 0
+    assert eng.stats["d2h_transfers"] - d0 == 6
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h.result(), _want(fitted, h, max_len=24))
+
+
+@pytest.mark.paged
+def test_paged_warmup_precompiles_every_program(fitted, monkeypatch):
+    """warmup() on a paged engine compiles the block-table decode, every
+    bucket's paged prefill, the in-arena chunk programs, and the COW
+    copy — live traffic (prefix hits and COW included) re-traces
+    nothing."""
+    calls = []
+    orig = decode._forward
+
+    def counting(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(decode, "_forward", counting)
+    eng = ServingEngine(fitted, num_slots=2, max_len=24, paged=True,
+                        block_size=4, prefill_chunk=4,
+                        prefills_per_step=2).warmup()
+    traced = len(calls)
+    assert traced > 0
+    h1 = eng.submit(np.array([2, 3, 4], np.int32), 3)       # bucket batch
+    h2 = eng.submit((np.arange(1, 12, dtype=np.int32)) % VOCAB, 3)  # chunks
+    eng.run_until_idle()
+    h3 = eng.submit((np.arange(1, 11, dtype=np.int32)) % VOCAB, 3)  # COW hit
+    eng.run_until_idle()
+    assert h1.done and h2.done and h3.done
+    assert eng.stats["prefix_hits"] >= 1
+    assert len(calls) == traced, "paged live traffic re-traced a program"
+
+
+@pytest.mark.paged
+def test_paged_respawn_clone_fresh_trie_same_arena(fitted):
+    """respawn_clone() carries the paged knobs and arena SHAPE but builds
+    a FRESH trie + allocator: cached chains index the dead pool's arena
+    contents, which the clone does not share."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=24, paged=True,
+                        block_size=4, kv_blocks=10)
+    h = eng.submit(PROMPT, 4)
+    eng.run_until_idle()
+    assert h.done and eng._pool.cached_blocks() > 0
+    clone = eng.respawn_clone()
+    assert clone.paged and clone.block_size == 4 and clone.kv_blocks == 10
+    assert clone._pool is not eng._pool
+    assert clone._pool.cached_blocks() == 0
+    assert clone.stats["prefix_hits"] == 0
+    assert len(clone._pool.free) == 10
+    h2 = clone.submit(PROMPT, 4)
+    clone.run_until_idle()
+    np.testing.assert_array_equal(h2.result(), h.result())
+
+
+@pytest.mark.paged
+def test_paged_knob_validation(fitted):
+    with pytest.raises(ValueError, match="paged"):
+        ServingEngine(fitted, num_slots=1, max_len=24, paged=True,
+                      prefill_mode="eager")
+    with pytest.raises(ValueError, match="block_size"):
+        ServingEngine(fitted, num_slots=1, max_len=24, paged=True,
+                      block_size=0)
+    with pytest.raises(ValueError, match="kv_blocks"):
+        ServingEngine(fitted, num_slots=1, max_len=24, paged=True,
+                      block_size=4, kv_blocks=2)   # can't hold one request
+
+
+@pytest.mark.paged
+def test_paged_default_off_is_dense(fitted):
+    """paged=False (the default) builds the exact dense engine: no pool,
+    no trie, per-slot cache rows, and zeroed paged stats."""
+    eng = ServingEngine(fitted, num_slots=2, max_len=24)
+    assert not eng.paged and eng._pool is None and eng.kv_blocks is None
+    assert eng.kv_blocks_in_use is None
+    assert eng.caches[2]["k"].shape[0] == 2     # (num_slots, max_len, ...)
+    h = eng.submit(PROMPT, 6)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h.result(), _want(fitted, h, max_len=24))
+    assert eng.stats["blocks_allocated"] == 0
+    assert eng.stats["prefix_hits"] == 0
+
+
+@pytest.mark.paged
+def test_paged_pool_byte_accounting(fitted):
+    """kv_pool_bytes counts the arena (blocks + the null block), shrinks
+    with kv_blocks, and the int8 arena pages codes + scales identically
+    (fewer bytes than the f32 arena at the same block count)."""
+    from distkeras_tpu.core import quant as quant_mod
+    big = ServingEngine(fitted, num_slots=2, max_len=24, paged=True,
+                        block_size=4)
+    small = ServingEngine(fitted, num_slots=2, max_len=24, paged=True,
+                          block_size=4, kv_blocks=6)
+    assert small.kv_pool_bytes < big.kv_pool_bytes
+    assert small.stats["kv_pool_bytes"] == small.kv_pool_bytes
+    q8 = ServingEngine(fitted, num_slots=2, max_len=24, paged=True,
+                       block_size=4, kv_dtype="int8")
+    assert q8.kv_pool_bytes < big.kv_pool_bytes
+    blk = quant_mod.kv_block_bytes(big.caches, big.block_size)
+    assert blk * (big.kv_blocks + 1) == big.kv_pool_bytes
+    # and the int8 paged engine still decodes exactly like the dense
+    # int8 engine (lossy vs f32, but layout-exact between pools)
+    h = q8.submit(PROMPT, 6)
+    q8.run_until_idle()
+    dense8 = ServingEngine(fitted, num_slots=2, max_len=24,
+                           kv_dtype="int8")
+    h2 = dense8.submit(PROMPT, 6)
+    dense8.run_until_idle()
+    np.testing.assert_array_equal(h.result(), h2.result())
+    _assert_no_block_leaks(q8)
+
+
+@pytest.mark.paged
+def test_paged_same_iteration_batch_admissions_exact(fitted):
+    """prefills_per_step > 1: same-pass admissions sharing a prefix do
+    NOT cross-match (the epoch guard — a same-pass matcher could land in
+    a bucket group dispatched before the writer's), but every output is
+    still exact and later admissions DO hit the published chains."""
+    eng = ServingEngine(fitted, num_slots=4, max_len=28, paged=True,
+                        block_size=4, prefills_per_step=4)
+    prefix = (np.arange(8) % VOCAB).astype(np.int32)
+    hs = [eng.submit(np.concatenate([prefix, [i]]).astype(np.int32), 5,
+                     seed=i) for i in range(4)]
+    eng.run_until_idle()
+    assert eng.stats["prefix_hits"] == 0          # same pass: no matches
+    for h in hs:
+        np.testing.assert_array_equal(h.result(), _want(fitted, h,
+                                                        max_len=28))
+    h5 = eng.submit(np.concatenate([prefix, [9]]).astype(np.int32), 5)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h5.result(), _want(fitted, h5,
+                                                     max_len=28))
+    assert eng.stats["prefix_hits"] == 1          # later pass: hit
+    _assert_no_block_leaks(eng)

@@ -1136,3 +1136,194 @@ def test_soak_killed_clients_and_engine_crash_zero_leaks(fitted):
     finally:
         sup.stop()
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# paged pool under chaos (PR 12): every retirement path must return the
+# block allocator to baseline — zero leaked blocks, refcounts at zero
+# ---------------------------------------------------------------------------
+
+def _paged_engine(fitted, spec=False, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 24)
+    kw.setdefault("block_size", 4)
+    if spec:
+        kw.setdefault("spec_draft", fitted)
+        kw.setdefault("spec_len", 3)
+    return ServingEngine(fitted, paged=True, **kw)
+
+
+def _assert_no_block_leaks(eng):
+    assert eng.kv_blocks_in_use == 0, (
+        f"leaked {eng.kv_blocks_in_use} blocks")
+    assert eng._pool.check_conservation()
+    assert not eng._plans
+
+
+@pytest.mark.paged
+@pytest.mark.parametrize("spec", [False, True])
+@pytest.mark.parametrize("reason", ["cancel_running", "deadline_running",
+                                    "cancel_mid_chunk",
+                                    "deadline_mid_chunk", "cancel_queued"])
+def test_paged_retirement_matrix_zero_block_leaks(fitted, reason, spec):
+    """The full early-retirement matrix on the paged pool, speculation on
+    and off: cancel/deadline against queued, running (mid-round), and
+    mid-chunked-prefill requests — each path releases the request's
+    block plan (shared refs dropped, private blocks freed) and the next
+    occupant reuses them with generate-identical output."""
+    chunked = reason.endswith("mid_chunk")
+    eng = _paged_engine(fitted, spec=spec, num_slots=1, prefill_chunk=4)
+    if reason == "cancel_queued":
+        running = eng.submit(PROMPT, 12)
+        target = eng.submit(OTHER, 5)       # queued behind the lone slot
+        eng.step()
+        eng.cancel(target)
+        eng.run_until_idle()
+        assert running.finish == "length"
+    else:
+        prompt = LONG_PROMPT if chunked else PROMPT
+        kw = {"deadline_s": 0.05} if reason.startswith("deadline") else {}
+        target = eng.submit(prompt, 8, **kw)
+        eng.step()
+        if chunked:
+            assert eng._prefilling
+        else:
+            eng.step()                       # a round in flight
+        if reason.startswith("cancel"):
+            eng.cancel(target)
+        else:
+            time.sleep(0.06)
+        eng.run_until_idle()
+    assert target.finish == ("cancel" if reason.startswith("cancel")
+                             else "deadline")
+    _assert_slots_reclaimed(eng)
+    _assert_no_block_leaks(eng)
+    h2 = eng.submit(OTHER, 6)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h2.result(), _want(fitted, OTHER, 6))
+    _assert_no_block_leaks(eng)
+
+
+@pytest.mark.paged
+def test_paged_disconnect_and_drain_zero_block_leaks(fitted):
+    """Wire disconnect reclamation and graceful drain on the paged pool:
+    a client RST mid-stream cancels its request and frees its blocks; a
+    drain finishes in-flight work and leaves the allocator at baseline
+    (cached chains are reusable capacity, not leaks)."""
+    eng = _paged_engine(fitted)
+    with ServingServer(eng, poll_s=0.01) as srv:
+        c = ServingClient(*srv.addr)
+        rid = c.submit(PROMPT, 16)
+        gen = c.stream(rid)
+        next(gen)
+        _hard_close(c.sock)
+        assert _wait_for(lambda: eng.stats["requests_cancelled"] >= 1)
+        assert _wait_for(lambda: not eng._active.any())
+        with ServingClient(*srv.addr) as c2:
+            np.testing.assert_array_equal(c2.generate(OTHER, 10),
+                                          _want(fitted, OTHER, 10))
+        _assert_slots_reclaimed(eng)
+        _assert_no_block_leaks(eng)
+    eng = _paged_engine(fitted)
+    h = eng.submit(PROMPT, 6)
+    assert eng.drain(timeout=30.0)
+    assert h.finish == "length"
+    _assert_no_block_leaks(eng)
+
+
+@pytest.mark.paged
+@pytest.mark.parametrize("fault", [
+    ChaosFault(0, 0, "reset"),
+    ChaosFault(0, 1, "cut_stream", 2),
+])
+def test_paged_chaos_matrix_survivors_bit_identical(fitted, fault):
+    """The PR 8 chaos-matrix rows against the paged pool: the faulted
+    request's blocks free, the unaffected concurrent request stays
+    bit-identical, and the allocator returns to baseline."""
+    eng = _paged_engine(fitted)
+    want_other = _want(fitted, OTHER, 10, temperature=0.6, seed=5)
+    with ServingServer(eng, poll_s=0.01) as srv:
+        with ChaosProxy(*srv.addr, protocol="serving",
+                        faults=[fault]) as px:
+            faulted = ServingClient(*px.addr)
+            healthy = ServingClient(*srv.addr)
+            rid_h = healthy.submit(OTHER, 10, temperature=0.6, seed=5)
+            with pytest.raises((ConnectionError, OSError, ValueError,
+                                QueueFull)):
+                faulted.generate(PROMPT, 16)
+            final = None
+            for tokens, done in healthy.stream(rid_h):
+                if done is not None:
+                    final = done
+            np.testing.assert_array_equal(final["row"], want_other)
+            faulted.close()
+            healthy.close()
+        assert _wait_for(lambda: not eng._active.any())
+        assert _wait_for(lambda: srv.live_connections == 0)
+        _assert_slots_reclaimed(eng)
+        _assert_no_block_leaks(eng)
+
+
+@pytest.mark.paged
+def test_paged_supervisor_restart_carries_knobs(fitted):
+    """Engine crash under supervision: the respawned clone keeps
+    paged/block_size/kv_blocks (same arena shape) with a FRESH trie, and
+    the retried request completes generate-identically."""
+    eng = _paged_engine(fitted, kv_blocks=12).warmup()
+    with ServingServer(eng, poll_s=0.01) as srv:
+        with EngineSupervisor(srv, heartbeat_interval=0.05,
+                              liveness_deadline=2.0) as sup:
+            with ServingClient(*srv.addr) as c:
+                def boom():
+                    raise RuntimeError("chaos: decode crashed")
+
+                eng._decode_once = boom
+                row = c.generate(
+                    PROMPT, 6,
+                    retry_policy=RetryPolicy(attempts=40, backoff=0.05))
+                np.testing.assert_array_equal(row,
+                                              _want(fitted, PROMPT, 6))
+            assert len(sup.recoveries) >= 1
+            fresh = srv.engine
+            assert fresh is not eng
+            assert fresh.paged and fresh.block_size == 4
+            assert fresh.kv_blocks == 12
+            _assert_no_block_leaks(fresh)
+
+
+@pytest.mark.paged
+@pytest.mark.slow
+def test_paged_arena_pressure_soak_zero_leaks(fitted):
+    """Slow arena-pressure soak: a tight arena, shared-prefix traffic,
+    ~20% seeded client kills + deadline expiries over many rounds —
+    every surviving request exact, the allocator at baseline after the
+    storm (the `paged` marker keeps this out of tier-1 via `slow`)."""
+    rng = np.random.default_rng(0)
+    eng = _paged_engine(fitted, num_slots=3, max_len=24,
+                        kv_blocks=12).warmup().start()
+    prefix = (np.arange(8) % VOCAB).astype(np.int32)
+    try:
+        for i in range(30):
+            prompt = np.concatenate(
+                [prefix, rng.integers(0, VOCAB, 2)]).astype(np.int32)
+            kill = rng.random() < 0.2
+            h = eng.submit(prompt, 6, seed=i,
+                           deadline_s=(0.02 if rng.random() < 0.1
+                                       else None))
+            if kill:
+                eng.cancel(h)
+            else:
+                h.wait(timeout=30.0)
+                if h.finish == "length":
+                    np.testing.assert_array_equal(
+                        h.result(), _want(fitted, prompt, 6))
+        assert _wait_for(
+            lambda: eng.stats["requests_submitted"]
+            == eng.stats["requests_completed"]
+            + eng.stats["requests_failed"]
+            + eng.stats["requests_rejected"])
+    finally:
+        eng.stop()
+    _assert_slots_reclaimed(eng)
+    _assert_no_block_leaks(eng)
+    assert eng.stats["prefix_hits"] > 0
