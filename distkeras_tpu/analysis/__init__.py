@@ -1,0 +1,33 @@
+"""dklint — concurrency + JAX-discipline static analysis for distkeras_tpu.
+
+Static pass (pure ``ast``, no imports of the checked code):
+
+* ``lock-discipline`` / ``lock-guards`` / ``lock-holds`` — per-class
+  inference of which attributes are guarded by which lock, with
+  machine-checked ``# guards:`` and ``# dklint: holds`` annotations.
+* ``lock-order`` — interprocedural acquisition-order graph + cycles.
+* ``jax-host-sync`` / ``jax-traced-branch`` / ``jax-donate`` — tracing
+  and transfer discipline inside jit-reachable functions.
+* ``wire-opcode`` / ``wire-codec`` — wire-protocol exhaustiveness.
+
+Run it as ``python -m distkeras_tpu.analysis [paths] [--baseline FILE]
+[--json]`` (or ``python scripts/lint.py``).  Findings are suppressable
+only via ``analysis/baseline.toml``; the tier-1 test
+``tests/test_analysis.py::test_package_has_zero_unbaselined_findings``
+keeps the analyzer, the baseline, and the package in lockstep.
+
+Runtime complement: :class:`~distkeras_tpu.analysis.runtime.OrderedLock`
+and :func:`~distkeras_tpu.analysis.runtime.audit_locks` assert lock-order
+acyclicity live under the chaos suites (``lock_order_audit`` fixture).
+"""
+
+from .core import (Finding, Report, default_baseline_path, load_baseline,
+                   render_baseline, run_analysis)
+from .runtime import (LockOrderAuditor, LockOrderViolation, OrderedLock,
+                      audit_locks)
+
+__all__ = [
+    "Finding", "Report", "run_analysis", "load_baseline",
+    "render_baseline", "default_baseline_path",
+    "OrderedLock", "LockOrderAuditor", "LockOrderViolation", "audit_locks",
+]

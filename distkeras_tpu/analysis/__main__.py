@@ -1,0 +1,74 @@
+"""CLI: ``python -m distkeras_tpu.analysis [paths] [--baseline FILE]``.
+
+Exit code 0 — no unbaselined findings (stale baseline entries are
+reported as warnings so the ledger shrinks as fixes land); 1 — at least
+one unbaselined finding.  ``--json`` emits a machine-readable report for
+CI annotation; ``--write-baseline`` freezes the current unbaselined set
+(each entry still needs a human justification before it will load).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (default_baseline_path, load_baseline, render_baseline,
+                   run_analysis)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distkeras_tpu.analysis",
+        description="dklint: concurrency + JAX-discipline static analyzer")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to analyze "
+                         "(default: the distkeras_tpu package)")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline TOML (default: analysis/baseline.toml; "
+                         "'none' disables suppression)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the full report as JSON")
+    ap.add_argument("--write-baseline", metavar="FILE", default=None,
+                    help="write current unbaselined findings as a baseline "
+                         "skeleton (justifications left empty on purpose)")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))]
+    baseline = args.baseline
+    if baseline is None:
+        baseline = default_baseline_path()
+    elif baseline.lower() == "none":
+        baseline = None
+
+    report = run_analysis(paths, baseline=baseline)
+
+    if args.write_baseline:
+        entries = {f.ident: "" for f in report.unbaselined}
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            f.write(render_baseline(entries))
+        print(f"dklint: wrote {len(entries)} skeleton entries to "
+              f"{args.write_baseline} (fill in justifications)")
+
+    if args.as_json:
+        print(json.dumps({
+            "unbaselined": [f.as_dict() for f in report.unbaselined],
+            "suppressed": [f.as_dict() for f in report.suppressed],
+            "stale_baseline": report.stale_baseline,
+        }, indent=2))
+    else:
+        for f in report.unbaselined:
+            print(f.render())
+        for ident in report.stale_baseline:
+            print(f"warning: stale baseline entry (no longer found): "
+                  f"{ident}", file=sys.stderr)
+        n, s = len(report.unbaselined), len(report.suppressed)
+        print(f"dklint: {n} unbaselined finding(s), {s} baselined, "
+              f"{len(report.stale_baseline)} stale baseline entr(y/ies)")
+    return 1 if report.unbaselined else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

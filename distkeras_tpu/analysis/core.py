@@ -1,0 +1,226 @@
+"""dklint core: findings, file walking, baseline handling, orchestration.
+
+The analyzer is pure-``ast`` — it never imports the modules it checks, so
+it runs in milliseconds on ``JAX_PLATFORMS=cpu`` CI and cannot be confused
+by import-time side effects.  Each rule family lives in its own module
+(:mod:`.locks`, :mod:`.jaxrules`, :mod:`.wire`); this module owns the
+shared vocabulary:
+
+* :class:`Finding` — one diagnostic.  Its ``ident`` is *line-number-free*
+  (``rule:relpath:symbol``) so a baseline entry survives unrelated edits
+  to the file above it.
+* :func:`load_baseline` / :func:`render_baseline` — the only sanctioned
+  suppression channel.  A finding disappears from the exit-code path only
+  when ``analysis/baseline.toml`` carries its ``ident`` plus a one-line
+  human justification; there are no inline ``# noqa``-style escapes.
+* :func:`run_analysis` — parse once, run every family, apply the
+  baseline, report stale baseline entries.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: Rule-family identifiers (the first component of every finding ident).
+RULES = (
+    "lock-discipline",   # attr accessed both under and outside its lock
+    "lock-guards",       # drift against a ``# guards:`` annotation
+    "lock-holds",        # call to a ``# dklint: holds`` method w/o the lock
+    "lock-order",        # acquisition-order cycle
+    "jax-host-sync",     # host materialization inside jit-reachable code
+    "jax-traced-branch",  # Python if/while on a tracer-valued expression
+    "jax-donate",        # cache-threading jit callsite missing donate_argnums
+    "wire-opcode",       # opcode collision (same or cross namespace)
+    "wire-codec",        # node tag encoded but not decoded by every decoder
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic.  ``ident`` is the stable baseline key; ``line`` is
+    presentation-only (it may drift between runs without invalidating a
+    baseline entry)."""
+    rule: str
+    ident: str
+    path: str       # path as given on the command line (for display)
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}\n" \
+               f"    id: {self.ident}"
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "ident": self.ident, "path": self.path,
+                "line": self.line, "message": self.message}
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file, shared by every rule family."""
+    path: str           # filesystem path (display)
+    rel: str            # path relative to its scan root (ident component)
+    modkey: str         # dotted module key, e.g. ``core.decode``
+    tree: ast.Module = field(repr=False, default=None)
+    lines: List[str] = field(repr=False, default_factory=list)
+
+    def src_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def span_text(self, lo: int, hi: int) -> str:
+        """Source text of lines ``lo..hi`` inclusive (annotation search)."""
+        return "\n".join(self.lines[max(lo - 1, 0):hi])
+
+
+def _modkey_for(rel: str) -> str:
+    base = rel[:-3] if rel.endswith(".py") else rel
+    parts = [p for p in base.split(os.sep) if p]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or base
+
+
+def iter_py_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    """Expand CLI path arguments into ``(filesystem_path, rel)`` pairs.
+
+    ``rel`` — the ident component — is relative to the argument that
+    produced the file, so ``python -m distkeras_tpu.analysis distkeras_tpu``
+    and ``... distkeras_tpu/`` yield identical idents regardless of CWD.
+    """
+    out: List[Tuple[str, str]] = []
+    for arg in paths:
+        arg = arg.rstrip(os.sep)
+        if os.path.isfile(arg):
+            out.append((arg, os.path.basename(arg)))
+            continue
+        for root, dirs, files in os.walk(arg):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    fs = os.path.join(root, fn)
+                    out.append((fs, os.path.relpath(fs, arg)))
+    return out
+
+
+def parse_modules(paths: Sequence[str]) -> List[ModuleInfo]:
+    mods: List[ModuleInfo] = []
+    for fs, rel in iter_py_files(paths):
+        try:
+            with open(fs, "r", encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=fs)
+        except (OSError, SyntaxError) as e:  # unreadable → loud, not silent
+            raise RuntimeError(f"dklint: cannot analyze {fs}: {e}") from e
+        mods.append(ModuleInfo(path=fs, rel=rel, modkey=_modkey_for(rel),
+                               tree=tree, lines=src.splitlines()))
+    return mods
+
+
+# --------------------------------------------------------------- baseline
+def _parse_toml(text: str) -> Dict[str, object]:
+    """Parse TOML via stdlib ``tomllib`` (3.11+) or the vendored ``tomli``
+    wheel baked into this image; as a last resort a minimal line parser
+    that understands exactly the subset :func:`render_baseline` emits
+    (``[[finding]]`` tables with string keys) — no new dependencies."""
+    try:
+        import tomllib as _toml          # Python >= 3.11
+    except ImportError:
+        try:
+            import tomli as _toml        # the image ships tomli
+        except ImportError:
+            _toml = None
+    if _toml is not None:
+        return _toml.loads(text)
+    findings: List[Dict[str, str]] = []
+    cur: Optional[Dict[str, str]] = None
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[finding]]":
+            cur = {}
+            findings.append(cur)
+        elif "=" in line and cur is not None:
+            k, v = line.split("=", 1)
+            v = v.strip()
+            if v.startswith('"') and v.endswith('"'):
+                v = v[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+            cur[k.strip()] = v
+    return {"finding": findings}
+
+
+def load_baseline(path: Optional[str]) -> Dict[str, str]:
+    """``ident -> justification``.  Entries without a non-empty
+    justification are rejected: the baseline is a reviewed ledger, not a
+    mute button."""
+    if path is None or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = _parse_toml(f.read())
+    out: Dict[str, str] = {}
+    for ent in data.get("finding", []) or []:
+        ident = str(ent.get("id", "")).strip()
+        why = str(ent.get("justification", "")).strip()
+        if not ident:
+            raise ValueError(f"baseline {path}: entry missing 'id'")
+        if not why:
+            raise ValueError(
+                f"baseline {path}: entry {ident!r} missing justification")
+        out[ident] = why
+    return out
+
+
+def _toml_str(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def render_baseline(entries: Dict[str, str]) -> str:
+    """Serialize ``ident -> justification`` in the format
+    :func:`load_baseline` reads (used by tests and ``--write-baseline``)."""
+    parts = ["# dklint baseline — every entry is a reviewed suppression.",
+             "# Remove entries as the underlying finding is fixed.", ""]
+    for ident in sorted(entries):
+        parts += ["[[finding]]",
+                  f"id = {_toml_str(ident)}",
+                  f"justification = {_toml_str(entries[ident])}", ""]
+    return "\n".join(parts)
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.toml")
+
+
+# ----------------------------------------------------------- orchestrator
+@dataclass
+class Report:
+    findings: List[Finding]          # everything the rules produced
+    unbaselined: List[Finding]       # findings with no baseline entry
+    suppressed: List[Finding]        # findings covered by the baseline
+    stale_baseline: List[str]        # baseline idents that matched nothing
+
+
+def run_analysis(paths: Sequence[str],
+                 baseline: Optional[str] = None) -> Report:
+    """Run every rule family over ``paths`` and split the findings against
+    the baseline file (``None`` → no suppression)."""
+    from . import jaxrules, locks, wire
+    mods = parse_modules(paths)
+    findings: List[Finding] = []
+    findings += locks.check(mods)
+    findings += jaxrules.check(mods)
+    findings += wire.check(mods)
+    findings.sort(key=lambda f: (f.path, f.line, f.ident))
+    base = load_baseline(baseline)
+    seen = {f.ident for f in findings}
+    unb = [f for f in findings if f.ident not in base]
+    sup = [f for f in findings if f.ident in base]
+    stale = sorted(i for i in base if i not in seen)
+    return Report(findings=findings, unbaselined=unb, suppressed=sup,
+                  stale_baseline=stale)

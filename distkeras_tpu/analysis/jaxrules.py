@@ -1,0 +1,568 @@
+"""dklint rule family 3: JAX tracing / transfer discipline.
+
+Everything here keys off **jit roots** — functions wrapped by a
+``jax.jit`` / ``jit`` / ``partial(jax.jit, ...)`` callsite or decorator.
+The collector is lexical-scope aware because this codebase's dominant
+idiom is a builder method that defines a nested ``step``/``prefill``
+function and returns ``jax.jit(step, donate_argnums=...)``.
+
+From each root, reachability follows plain ``name(...)`` calls through
+the nested-scope chain, module globals, and package-local imports
+(``from .core.decode import decode_step``), plus ``self.m(...)`` within
+the defining class.  Inside every reachable function the rules are:
+
+* ``jax-host-sync`` — ``.item()`` and ``jax.device_get`` calls flag
+  unconditionally (nothing inside a traced region should synchronize);
+  ``float(x)`` / ``int(x)`` / ``bool(x)`` / ``np.asarray(x)`` /
+  ``np.array(x)`` flag only when ``x`` is **tracer-tainted**.
+* ``jax-traced-branch`` — Python ``if``/``while`` on a tracer-tainted
+  test (trace-time branching bakes one side into the compiled program,
+  the retrace-guard class of bug).
+* ``jax-donate`` — a jit callsite wrapping a function with a KV-cache
+  parameter (``cache``/``caches``/``kv_caches``/``decode_state``) and no
+  ``donate_argnums``/``donate_argnames``: cache threading without
+  donation doubles peak HBM for the pool.
+
+**Taint model** (the false-positive control): a function parameter is a
+tracer candidate unless it is ``self``/``cls``, is listed in the jit
+callsite's ``static_argnums``/``static_argnames``, carries a
+``bool``/``int``/``str`` annotation, defaults to a ``bool``/``int``/
+``str``/``None`` literal, or is one of the conventional trace-time
+constants this repo threads everywhere (``model``, ``mesh``, ``config``,
+``cfg``, ``rolling``, ``causal``, ``block_size``).  Shape math is not
+taint: ``x.shape``/``x.dtype``/``x.ndim``/``x.size``, ``len(x)``,
+``isinstance(x, ...)`` and ``x is None`` are all static under tracing.
+Locals pick up taint through straight-line assignment (two passes, so
+loop-carried taint converges).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleInfo
+
+STATIC_PARAM_NAMES = {"self", "cls", "model", "mesh", "config", "cfg",
+                      "rolling", "causal", "block_size"}
+STATIC_ANNOTATIONS = {"bool", "int", "str"}
+SHAPE_ATTRS = {"shape", "dtype", "ndim", "size"}
+#: the only attribute accesses that keep tracer taint — everything else
+#: (``mha.rope``, ``layer.use_bias``, …) is config plumbing, not data
+ARRAY_ATTRS = {"T", "mT", "real", "imag", "at"}
+#: method calls whose result stays tracer-valued when the receiver is
+ARRAY_METHODS = {"sum", "any", "all", "min", "max", "mean", "prod",
+                 "astype", "dot", "ravel", "reshape", "squeeze", "take",
+                 "round", "clip", "set", "add", "get"}
+STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type", "range",
+                "enumerate", "zip", "callable"}
+CACHE_PARAMS = {"cache", "caches", "kv_cache", "kv_caches", "decode_state"}
+CASTS = {"float", "int", "bool"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` as an expression (decorator or callee)."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit" and \
+            isinstance(node.value, ast.Name) and node.value.id == "jax":
+        return True
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_call_parts(call: ast.Call
+                    ) -> Optional[Tuple[List[ast.expr], List[ast.keyword]]]:
+    """If ``call`` is a jit application, return (args, all-keywords).
+
+    Handles ``jax.jit(f, ...)`` and ``partial(jax.jit, ...)(f)`` /
+    ``functools.partial(jax.jit, ...)(f)``.
+    """
+    if _is_jit_expr(call.func):
+        return call.args, call.keywords
+    fn = call.func
+    if isinstance(fn, ast.Call):
+        inner = fn.func
+        is_partial = (isinstance(inner, ast.Name) and inner.id == "partial") \
+            or (isinstance(inner, ast.Attribute) and inner.attr == "partial")
+        if is_partial and fn.args and _is_jit_expr(fn.args[0]):
+            return call.args, fn.keywords + call.keywords
+    return None
+
+
+@dataclass
+class FuncRec:
+    node: ast.AST                     # FunctionDef | Lambda
+    modkey: str
+    mod: ModuleInfo
+    qual: str
+    outer: Optional["FuncRec"]
+    cls: Optional[str]                # owning class name, for self.m()
+    nested: Dict[str, "FuncRec"] = field(default_factory=dict)
+    static_params: Set[str] = field(default_factory=set)  # from jit kwargs
+
+
+@dataclass
+class _ModScan:
+    mod: ModuleInfo
+    toplevel: Dict[str, FuncRec] = field(default_factory=dict)
+    methods: Dict[Tuple[str, str], FuncRec] = field(default_factory=dict)
+    imports: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    roots: List[Tuple[FuncRec, List[ast.keyword]]] = field(
+        default_factory=list)
+    jit_sites: List[Tuple[ast.Call, Optional[FuncRec]]] = field(
+        default_factory=list)
+
+
+def _rel_modkey(modkey: str, level: int, module: Optional[str]) -> str:
+    """Resolve a ``from``-import target to a scan-root-relative modkey."""
+    if level == 0:
+        if module is None:
+            return ""
+        parts = module.split(".")
+        return ".".join(parts)
+    pkg = modkey.split(".")[:-1] if modkey else []
+    pkg = pkg[:len(pkg) - (level - 1)] if level > 1 else pkg
+    tail = module.split(".") if module else []
+    return ".".join(pkg + tail)
+
+
+def _param_info(fn: ast.AST) -> Tuple[List[str], Set[str]]:
+    """(ordered param names, heuristically-static param names)."""
+    if isinstance(fn, ast.Lambda):
+        a = fn.args
+    else:
+        a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    static: Set[str] = set()
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        if p.arg in STATIC_PARAM_NAMES:
+            static.add(p.arg)
+        ann = p.annotation
+        if isinstance(ann, ast.Subscript):       # Optional[int] & friends
+            base = ann.value
+            if (isinstance(base, ast.Name) and base.id == "Optional") or \
+                    (isinstance(base, ast.Attribute)
+                     and base.attr == "Optional"):
+                ann = ann.slice
+        if isinstance(ann, ast.Name) and ann.id in STATIC_ANNOTATIONS:
+            static.add(p.arg)
+    defaults = list(a.defaults)
+    for name, d in zip(names[len(names) - len(defaults):], defaults):
+        if isinstance(d, ast.Constant) and \
+                isinstance(d.value, (bool, int, str, type(None))):
+            static.add(name)
+    for name, d in zip([p.arg for p in a.kwonlyargs], a.kw_defaults):
+        if isinstance(d, ast.Constant) and \
+                isinstance(d.value, (bool, int, str, type(None))):
+            static.add(name)
+    return names, static
+
+
+# ----------------------------------------------------------- collection
+class _Collector(ast.NodeVisitor):
+    def __init__(self, scan: _ModScan):
+        self.scan = scan
+        self.stack: List[FuncRec] = []
+        self.cls: Optional[str] = None
+
+    # imports
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        target = _rel_modkey(self.scan.mod.modkey, node.level, node.module)
+        # strip an absolute package prefix ("distkeras_tpu.core" when the
+        # scan root IS the package directory)
+        for alias in node.names:
+            name = alias.asname or alias.name
+            self.scan.imports[name] = (target, alias.name)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.scan.imports.setdefault(name, (alias.name, None))
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev, self.cls = self.cls, node.name
+        for stmt in node.body:
+            self.visit(stmt)
+        self.cls = prev
+
+    def _register(self, node: ast.AST, name: str) -> FuncRec:
+        outer = self.stack[-1] if self.stack else None
+        qual = (f"{outer.qual}.{name}" if outer
+                else (f"{self.cls}.{name}" if self.cls else name))
+        rec = FuncRec(node=node, modkey=self.scan.mod.modkey,
+                      mod=self.scan.mod, qual=qual, outer=outer,
+                      cls=self.cls)
+        if outer is not None:
+            outer.nested[name] = rec
+        elif self.cls is not None:
+            self.scan.methods[(self.cls, name)] = rec
+        else:
+            self.scan.toplevel[name] = rec
+        return rec
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        rec = self._register(node, node.name)
+        for dec in node.decorator_list:
+            kws: Optional[List[ast.keyword]] = None
+            if _is_jit_expr(dec):
+                kws = []
+            elif isinstance(dec, ast.Call):
+                if _is_jit_expr(dec.func):
+                    kws = dec.keywords
+                else:
+                    inner = dec.func
+                    is_partial = (isinstance(inner, ast.Name)
+                                  and inner.id == "partial") or \
+                        (isinstance(inner, ast.Attribute)
+                         and inner.attr == "partial")
+                    if is_partial and dec.args and _is_jit_expr(dec.args[0]):
+                        kws = dec.keywords
+            if kws is not None:
+                self.scan.roots.append((rec, kws))
+        self.stack.append(rec)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        parts = _jit_call_parts(node)
+        if parts is not None:
+            args, kws = parts
+            target: Optional[FuncRec] = None
+            if args:
+                tgt = args[0]
+                if isinstance(tgt, ast.Lambda):
+                    target = FuncRec(node=tgt, modkey=self.scan.mod.modkey,
+                                     mod=self.scan.mod,
+                                     qual=f"<lambda@{tgt.lineno}>",
+                                     outer=self.stack[-1] if self.stack
+                                     else None, cls=self.cls)
+                elif isinstance(tgt, ast.Name):
+                    target = self._resolve_local(tgt.id)
+                elif isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self" and self.cls is not None:
+                    target = self.scan.methods.get((self.cls, tgt.attr))
+            if target is not None:
+                self.scan.roots.append((target, list(kws)))
+            self.scan.jit_sites.append((node, target))
+        self.generic_visit(node)
+
+    def _resolve_local(self, name: str) -> Optional[FuncRec]:
+        for rec in reversed(self.stack):
+            if name in rec.nested:
+                return rec.nested[name]
+        return self.scan.toplevel.get(name)
+
+
+def _apply_static_kwargs(rec: FuncRec, kws: List[ast.keyword]) -> None:
+    names, _ = _param_info(rec.node)
+    for kw in kws:
+        if kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int) \
+                        and 0 <= v.value < len(names):
+                    rec.static_params.add(names[v.value])
+        elif kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    rec.static_params.add(v.value)
+
+
+# ------------------------------------------------------------ taint check
+class _Taint:
+    def __init__(self, tracers: Set[str], static_fns: Set[str] = frozenset()):
+        self.names = set(tracers)
+        self.static_fns = static_fns
+
+    def tainted(self, e: ast.AST) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.names
+        if isinstance(e, ast.Constant):
+            return False
+        if isinstance(e, ast.Attribute):
+            # only array-view attrs keep taint; `mha.rope`-style config
+            # plumbing (and .shape/.dtype/.ndim/.size) is trace-static
+            if e.attr in ARRAY_ATTRS:
+                return self.tainted(e.value)
+            return False
+        if isinstance(e, ast.Call):
+            fn = e.func
+            if isinstance(fn, ast.Name):
+                if fn.id in STATIC_CALLS or fn.id in self.static_fns:
+                    return False
+            if isinstance(fn, ast.Attribute):
+                if fn.attr in SHAPE_ATTRS:
+                    return False
+                if fn.attr in ARRAY_METHODS and self.tainted(fn.value):
+                    return True               # mask.any(), x.at[i].set(v)
+            return any(self.tainted(a) for a in e.args) or \
+                any(self.tainted(k.value) for k in e.keywords)
+        if isinstance(e, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in e.ops):
+                return False                  # identity/membership: pytree
+            return self.tainted(e.left) or \
+                any(self.tainted(c) for c in e.comparators)
+        return any(self.tainted(c) for c in ast.iter_child_nodes(e))
+
+
+def _static_predicates(tree: ast.Module) -> Set[str]:
+    """Names of module functions whose every ``return`` value is
+    trace-static even when all their params are tracers — structure
+    probes like ``_kv_quantized(cache) -> "ks" in cache`` or
+    ``_per_row(pos) -> pos.ndim == 1``.  Calls to them never carry
+    taint.  Fixpoint over 3 passes so predicates may call predicates."""
+    fns: List[ast.FunctionDef] = [
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef)]
+    static: Set[str] = set()
+    for _ in range(3):
+        nxt: Set[str] = set()
+        for fn in fns:
+            names, _ = _param_info(fn)
+            t = _Taint(set(names), static_fns=static)
+            rets = [n for n in ast.walk(fn) if isinstance(n, ast.Return)]
+            if not rets or any(isinstance(n, (ast.Yield, ast.YieldFrom))
+                               for n in ast.walk(fn)):
+                continue
+            if all(r.value is None or not t.tainted(r.value)
+                   for r in rets):
+                nxt.add(fn.name)
+        if nxt == static:
+            break
+        static = nxt
+    return static
+
+
+def _local_taint(fn: ast.AST, tracers: Set[str],
+                 static_fns: Set[str] = frozenset()) -> _Taint:
+    t = _Taint(tracers, static_fns=static_fns)
+    body = fn.body if isinstance(fn, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) else []
+    for _ in range(2):                      # loop-carried taint: 2 passes
+        for node in ast.walk(ast.Module(body=list(body),
+                                        type_ignores=[])):
+            if isinstance(node, ast.Assign) and t.tainted(node.value):
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            t.names.add(n.id)
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name) and \
+                    t.tainted(node.value):
+                t.names.add(node.target.id)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                it = node.iter
+                if t.tainted(it):
+                    tgt = node.target
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            t.names.add(n.id)
+    return t
+
+
+def _short(e: ast.AST, limit: int = 48) -> str:
+    try:
+        s = ast.unparse(e)
+    except Exception:                        # pragma: no cover
+        s = "<expr>"
+    s = " ".join(s.split())
+    return s if len(s) <= limit else s[:limit] + "…"
+
+
+# ------------------------------------------------------------ rule engine
+class _RuleScan(ast.NodeVisitor):
+    def __init__(self, rec: FuncRec, taint: _Taint, sink):
+        self.rec = rec
+        self.taint = taint
+        self.sink = sink
+        self.calls: List[Tuple[str, ...]] = []   # callee refs for reach.
+
+    def _f(self, rule: str, tag: str, line: int, msg: str) -> None:
+        rel, qual = self.rec.mod.rel, self.rec.qual
+        self.sink(Finding(rule, f"{rule}:{rel}:{qual}:{tag}",
+                          self.rec.mod.path, line, msg))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass                                  # nested defs scanned on reach
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        qual = self.rec.qual
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "item" and not node.args:
+                self._f("jax-host-sync", f"{_short(fn.value)}.item",
+                        node.lineno,
+                        f"`{_short(fn.value)}.item()` inside jit-reachable "
+                        f"`{qual}` forces a device→host sync per trace")
+            elif fn.attr == "device_get" and \
+                    isinstance(fn.value, ast.Name) and fn.value.id == "jax":
+                self._f("jax-host-sync", "device_get", node.lineno,
+                        f"`jax.device_get` inside jit-reachable `{qual}` "
+                        f"materializes on host mid-trace")
+            elif fn.attr in ("asarray", "array") and \
+                    isinstance(fn.value, ast.Name) and \
+                    fn.value.id in ("np", "numpy", "onp") and node.args and \
+                    self.taint.tainted(node.args[0]):
+                self._f("jax-host-sync",
+                        f"np.{fn.attr}({_short(node.args[0])})",
+                        node.lineno,
+                        f"`np.{fn.attr}` on tracer-valued "
+                        f"`{_short(node.args[0])}` inside jit-reachable "
+                        f"`{qual}` pulls the value to host")
+            # self.m(...) reachability
+            if isinstance(fn.value, ast.Name) and fn.value.id == "self":
+                self.calls.append(("self", fn.attr))
+            elif isinstance(fn.value, ast.Name):
+                self.calls.append(("mod", fn.value.id, fn.attr))
+        elif isinstance(fn, ast.Name):
+            if fn.id in CASTS and len(node.args) == 1 and \
+                    self.taint.tainted(node.args[0]):
+                self._f("jax-host-sync",
+                        f"{fn.id}({_short(node.args[0])})", node.lineno,
+                        f"`{fn.id}()` on tracer-valued "
+                        f"`{_short(node.args[0])}` inside jit-reachable "
+                        f"`{qual}` concretizes the tracer")
+            self.calls.append(("name", fn.id))
+        self.generic_visit(node)
+
+    def _branch(self, node, kw: str) -> None:
+        if self.taint.tainted(node.test):
+            self._f("jax-traced-branch", f"{kw}:{_short(node.test)}",
+                    node.test.lineno,
+                    f"Python `{kw}` on tracer-valued "
+                    f"`{_short(node.test)}` in jit-reachable "
+                    f"`{self.rec.qual}` — use lax.cond/select or hoist to "
+                    f"a static argument")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._branch(node, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        if self.taint.tainted(node.test):
+            self._f("jax-traced-branch", f"ifexp:{_short(node.test)}",
+                    node.lineno,
+                    f"conditional expression on tracer-valued "
+                    f"`{_short(node.test)}` in jit-reachable "
+                    f"`{self.rec.qual}`")
+        self.generic_visit(node)
+
+
+def _resolve_call(scan: _ModScan, scans: Dict[str, _ModScan],
+                  rec: FuncRec, ref: Tuple[str, ...]) -> Optional[FuncRec]:
+    if ref[0] == "self":
+        if rec.cls is not None:
+            return scan.methods.get((rec.cls, ref[1]))
+        return None
+    if ref[0] == "name":
+        cur = rec
+        while cur is not None:
+            if ref[1] in cur.nested:
+                return cur.nested[ref[1]]
+            cur = cur.outer
+        if ref[1] in scan.toplevel:
+            return scan.toplevel[ref[1]]
+        imp = scan.imports.get(ref[1])
+        if imp and imp[1] is not None:
+            return _lookup(scans, imp[0], imp[1])
+        return None
+    if ref[0] == "mod":
+        imp = scan.imports.get(ref[1])
+        if imp and imp[1] is None:           # module alias: mod.fn(...)
+            return _lookup(scans, imp[0], ref[2])
+        if imp and imp[1] is not None:       # from x import y; y.fn() — no
+            return None
+    return None
+
+
+def _lookup(scans: Dict[str, _ModScan], modkey: str,
+            fname: str) -> Optional[FuncRec]:
+    sc = scans.get(modkey)
+    if sc is None and "." in modkey:          # absolute import w/ pkg prefix
+        sc = scans.get(modkey.split(".", 1)[1])
+    if sc is None:
+        return None
+    return sc.toplevel.get(fname)
+
+
+def check(mods: Sequence[ModuleInfo]) -> List[Finding]:
+    scans: Dict[str, _ModScan] = {}
+    static_preds: Dict[str, Set[str]] = {}
+    for mod in mods:
+        sc = _ModScan(mod=mod)
+        _Collector(sc).visit(mod.tree)
+        scans[mod.modkey] = sc
+        static_preds[mod.modkey] = _static_predicates(mod.tree)
+
+    for sc in scans.values():
+        for rec, kws in sc.roots:
+            _apply_static_kwargs(rec, kws)
+
+    findings: List[Finding] = []
+    seen_idents: Set[str] = set()
+
+    def sink(f: Finding) -> None:
+        if f.ident not in seen_idents:
+            seen_idents.add(f.ident)
+            findings.append(f)
+
+    visited: Set[int] = set()
+    work: List[Tuple[FuncRec, _ModScan]] = []
+    for sc in scans.values():
+        for rec, _ in sc.roots:
+            work.append((rec, sc))
+    while work:
+        rec, sc = work.pop()
+        if id(rec.node) in visited:
+            continue
+        visited.add(id(rec.node))
+        names, static = _param_info(rec.node)
+        tracers = set(names) - static - rec.static_params
+        taint = _local_taint(rec.node, tracers,
+                             static_preds.get(rec.modkey, frozenset()))
+        rs = _RuleScan(rec, taint, sink)
+        body = rec.node.body
+        if isinstance(rec.node, ast.Lambda):
+            rs.visit(rec.node.body)
+        else:
+            for stmt in body:
+                rs.visit(stmt)
+        for ref in rs.calls:
+            nxt = _resolve_call(sc, scans, rec, ref)
+            if nxt is not None:
+                nxt_sc = scans.get(nxt.modkey, sc)
+                work.append((nxt, nxt_sc))
+
+    # donate rule: jit callsites over cache-threading functions
+    for sc in scans.values():
+        for call, target in sc.jit_sites:
+            if target is None:
+                continue
+            _, kws = _jit_call_parts(call) or ([], [])
+            if any(k.arg in ("donate_argnums", "donate_argnames")
+                   for k in kws):
+                continue
+            names, _ = _param_info(target.node)
+            hit = sorted(set(names) & CACHE_PARAMS)
+            if hit:
+                findings.append(Finding(
+                    "jax-donate",
+                    f"jax-donate:{sc.mod.rel}:{target.qual}",
+                    sc.mod.path, call.lineno,
+                    f"jit of `{target.qual}` threads KV state "
+                    f"({','.join(hit)}) without donate_argnums — the pool "
+                    f"is double-buffered every step"))
+    return findings

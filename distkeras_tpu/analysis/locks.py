@@ -1,0 +1,592 @@
+"""dklint rule families 1+2: lock discipline and lock-order cycles.
+
+Both families work from one per-class scan:
+
+* **Lock inventory** — ``self._x = threading.Lock()/RLock()/Condition()``
+  assignments anywhere in the class.  A ``Condition(self._y)`` shares the
+  identity of the lock it wraps, so acquiring ``self._not_full`` *is*
+  acquiring ``self._qlock`` (the grouping the serving admission queue
+  relies on).  Each group is keyed by its *root* attribute.
+
+* **Annotations** — two machine-checked comment forms replace free-text
+  lock prose:
+
+  - ``self._lk = threading.Lock()  # guards: _a,_b`` — the listed
+    attributes may only be touched while holding ``_lk``; any access
+    outside it (``__init__`` excepted) is a ``lock-guards`` finding, and
+    a listed attribute that no longer exists is a *stale* finding.
+  - ``def _apply(self, ...):  # dklint: holds _lock`` — asserts the
+    method is only called with ``_lock`` held.  Accesses inside then
+    count as locked, and any *visible* same-class call site that does
+    not hold the lock is a ``lock-holds`` finding.
+
+* **Discipline inference** — in a class that spawns threads
+  (``threading.Thread(...)`` anywhere in its methods, or an explicit
+  ``# dklint: threaded`` on the class line), an unannotated attribute
+  that is written somewhere outside ``__init__`` and is accessed both
+  *under* a lock group and *outside any* lock group is a candidate race
+  (``lock-discipline``).  Accesses inside nested functions/lambdas
+  inherit the lock context of their definition site — a ``wait_for``
+  predicate runs under its condition's lock; a thread target defined at
+  top level runs under none.
+
+* **Lock order** — a ``with self._a:`` nested (syntactically, or through
+  same-module calls ``self.m()`` / ``self.attr.m()`` with a resolvable
+  class) inside ``with self._b:`` adds the edge ``_b → _a`` to a global
+  acquisition graph; any cycle is a ``lock-order`` finding.  Same-group
+  re-entry is only reported for *syntactic* nesting of a non-reentrant
+  ``Lock`` (interprocedural same-lock paths are usually conditional and
+  would drown the signal in false positives — the runtime
+  :class:`~distkeras_tpu.analysis.runtime.OrderedLock` auditor covers
+  those, plus cross-object orders invisible to the AST).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleInfo
+
+LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond"}
+LOCK_METHODS = {"acquire", "release", "locked", "wait", "wait_for",
+                "notify", "notify_all"}
+#: container-method calls treated as writes to the receiving attribute
+MUTATORS = {"append", "appendleft", "pop", "popleft", "push", "add",
+            "remove", "discard", "clear", "update", "extend", "insert",
+            "setdefault", "popitem", "put", "rotate"}
+SKIP_METHODS = {"__init__", "__del__"}
+
+_GUARDS_RE = re.compile(r"#\s*guards:\s*([A-Za-z0-9_,/ \t]+)")
+_HOLDS_RE = re.compile(r"#\s*dklint:\s*holds\s+([A-Za-z0-9_,/ \t]+)")
+_THREADED_RE = re.compile(r"#\s*dklint:\s*threaded\b")
+
+
+def _split_attrs(blob: str) -> List[str]:
+    return [a for a in re.split(r"[,/\s]+", blob.strip()) if a]
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _lock_ctor(call: ast.AST) -> Optional[str]:
+    """'lock' | 'rlock' | 'cond' when ``call`` constructs a threading
+    primitive (``threading.X(...)`` or bare ``X(...)``)."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    name = None
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "threading":
+        name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    return LOCK_CTORS.get(name)
+
+
+@dataclass
+class Access:
+    attr: str
+    kind: str                  # 'r' | 'w'
+    held: FrozenSet[str]       # lock roots held at the access
+    line: int
+    method: str
+
+
+@dataclass
+class CallRec:
+    callee: Tuple[str, ...]    # ('self', m) | ('attr', X, m)
+    held: FrozenSet[str]
+    line: int
+    method: str
+
+
+@dataclass
+class ClassScan:
+    name: str
+    mod: ModuleInfo
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)
+    lock_root: Dict[str, str] = field(default_factory=dict)
+    lock_kind: Dict[str, str] = field(default_factory=dict)   # root -> kind
+    lock_line: Dict[str, int] = field(default_factory=dict)
+    guards: Dict[str, Tuple[Set[str], int]] = field(default_factory=dict)
+    holds: Dict[str, Set[str]] = field(default_factory=dict)  # method->roots
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    threaded: bool = False
+    accesses: List[Access] = field(default_factory=list)
+    calls: List[CallRec] = field(default_factory=list)
+    acquires: Dict[str, Set[str]] = field(default_factory=dict)  # method->
+    nest_edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    init_assigned: Set[str] = field(default_factory=set)
+
+    def qual(self, attr: str) -> str:
+        return f"{self.name}.{attr}"
+
+
+# ------------------------------------------------------------- class scan
+def _collect_class(mod: ModuleInfo, node: ast.ClassDef) -> ClassScan:
+    cs = ClassScan(name=node.name, mod=mod, node=node)
+    cs.bases = [b.id for b in node.bases if isinstance(b, ast.Name)]
+    header = mod.span_text(node.lineno,
+                           node.body[0].lineno if node.body else node.lineno)
+    if _THREADED_RE.search(header):
+        cs.threaded = True
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cs.methods[item.name] = item
+
+    # pass A: lock inventory + guards annotations + attr types + Thread use
+    cond_wraps: Dict[str, str] = {}     # cond attr -> wrapped attr name
+    for mname, fn in cs.methods.items():
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = _self_attr(stmt.targets[0])
+                if tgt is None:
+                    continue
+                if mname == "__init__":
+                    cs.init_assigned.add(tgt)
+                kind = _lock_ctor(stmt.value)
+                if kind is not None:
+                    cs.lock_kind[tgt] = kind
+                    cs.lock_line[tgt] = stmt.lineno
+                    if kind == "cond" and stmt.value.args:
+                        wrapped = _self_attr(stmt.value.args[0])
+                        if wrapped is not None:
+                            cond_wraps[tgt] = wrapped
+                    m = _GUARDS_RE.search(mod.span_text(
+                        stmt.lineno, stmt.end_lineno or stmt.lineno))
+                    if m:
+                        cs.guards[tgt] = (set(_split_attrs(m.group(1))),
+                                          stmt.lineno)
+                elif isinstance(stmt.value, ast.Call) and \
+                        isinstance(stmt.value.func, ast.Name):
+                    cs.attr_types[tgt] = stmt.value.func.id
+            if isinstance(stmt, ast.Call):
+                fnode = stmt.func
+                if (isinstance(fnode, ast.Attribute)
+                        and fnode.attr == "Thread") or \
+                        (isinstance(fnode, ast.Name)
+                         and fnode.id == "Thread"):
+                    cs.threaded = True
+    # resolve groups: a Condition wrapping a known lock shares its root
+    for attr in cs.lock_kind:
+        root = attr
+        seen = set()
+        while root in cond_wraps and cond_wraps[root] in cs.lock_kind \
+                and root not in seen:
+            seen.add(root)
+            root = cond_wraps[root]
+        cs.lock_root[attr] = root
+    # guards annotations keyed by root
+    cs.guards = {cs.lock_root.get(a, a): v for a, v in cs.guards.items()}
+
+    # holds annotations
+    for mname, fn in cs.methods.items():
+        body_start = fn.body[0].lineno if fn.body else fn.lineno
+        m = _HOLDS_RE.search(mod.span_text(fn.lineno, body_start))
+        if m:
+            roots = {cs.lock_root.get(a, a) for a in _split_attrs(m.group(1))}
+            cs.holds[mname] = roots
+    return cs
+
+
+class _MethodWalker(ast.NodeVisitor):
+    """Walks one method body tracking the set of held lock roots."""
+
+    def __init__(self, cs: ClassScan, mname: str,
+                 init_held: FrozenSet[str]):
+        self.cs = cs
+        self.mname = mname
+        self.held: Tuple[str, ...] = tuple(sorted(init_held))
+
+    # -- helpers
+    def _rec(self, attr: str, kind: str, line: int) -> None:
+        if attr in self.cs.lock_root:
+            return                          # lock objects are not state
+        self.cs.accesses.append(Access(attr, kind, frozenset(self.held),
+                                       line, self.mname))
+
+    def _acquire(self, root: str, line: int):
+        for h in self.held:
+            if h != root:
+                self.cs.nest_edges.append((h, root, line))
+            elif self.cs.lock_kind.get(root) == "lock" and \
+                    root not in self.cs.holds.get(self.mname, ()):
+                # syntactic re-entry of a non-reentrant Lock
+                self.cs.nest_edges.append((root, root, line))
+        self.cs.acquires.setdefault(self.mname, set()).add(root)
+        self.held = tuple(sorted(set(self.held) | {root}))
+
+    # -- lock-scoped blocks
+    def visit_With(self, node: ast.With) -> None:
+        saved = self.held
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None and attr in self.cs.lock_root:
+                self._acquire(self.cs.lock_root[attr], item.context_expr.lineno)
+            else:
+                self.visit(item.context_expr)
+                if item.optional_vars is not None:
+                    self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    # -- nested functions inherit the lock context of their definition site
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self.visit(node.body)
+
+    # -- accesses
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None:
+            kind = "w" if isinstance(node.ctx, (ast.Store, ast.Del)) else "r"
+            self._rec(attr, kind, node.lineno)
+            return
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            attr = _self_attr(node.value)
+            if attr is not None:
+                self._rec(attr, "w", node.lineno)
+                self.visit(node.slice)
+                return
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            # self.m(...)
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                self.cs.calls.append(CallRec(("self", fn.attr),
+                                             frozenset(self.held),
+                                             node.lineno, self.mname))
+                for a in node.args:
+                    self.visit(a)
+                for kw in node.keywords:
+                    self.visit(kw.value)
+                return
+            # self.X.m(...)
+            attr = _self_attr(recv)
+            if attr is not None:
+                if attr in self.cs.lock_root and fn.attr in LOCK_METHODS:
+                    pass                        # explicit lock calls: see docs
+                else:
+                    kind = "w" if fn.attr in MUTATORS else "r"
+                    self._rec(attr, kind, recv.lineno)
+                    if attr in self.cs.attr_types:
+                        self.cs.calls.append(
+                            CallRec(("attr", attr, fn.attr),
+                                    frozenset(self.held), node.lineno,
+                                    self.mname))
+                for a in node.args:
+                    self.visit(a)
+                for kw in node.keywords:
+                    self.visit(kw.value)
+                return
+        self.generic_visit(node)
+
+
+def _scan_methods(cs: ClassScan) -> None:
+    for mname, fn in cs.methods.items():
+        if mname in SKIP_METHODS:
+            continue
+        init_held = frozenset(cs.holds.get(mname, set()))
+        w = _MethodWalker(cs, mname, init_held)
+        for stmt in fn.body:
+            w.visit(stmt)
+
+
+# -------------------------------------------------- inheritance flattening
+def _flatten(classes: Dict[str, ClassScan]) -> None:
+    """Merge base-class scan data into same-module subclasses so inherited
+    state (``ParameterServer.num_updates`` under ``SocketParameterServer``'s
+    threads) is judged in the derived class's threading context."""
+    order: List[str] = []
+    seen: Set[str] = set()
+
+    def visit(name: str) -> None:
+        if name in seen or name not in classes:
+            return
+        seen.add(name)
+        for b in classes[name].bases:
+            visit(b)
+        order.append(name)
+
+    for name in classes:
+        visit(name)
+    for name in order:
+        cs = classes[name]
+        for b in cs.bases:
+            if b not in classes:
+                continue
+            base = classes[b]
+            cs.threaded = cs.threaded or base.threaded
+            for a, r in base.lock_root.items():
+                cs.lock_root.setdefault(a, r)
+                cs.lock_kind.setdefault(r, base.lock_kind.get(r, "lock"))
+            for r, g in base.guards.items():
+                cs.guards.setdefault(r, g)
+            for m, h in base.holds.items():
+                cs.holds.setdefault(m, set()).update(h)
+            for m, fn in base.methods.items():
+                cs.methods.setdefault(m, fn)
+            cs.init_assigned |= base.init_assigned
+            # bring over accesses/calls/acquires made by inherited methods
+            inherited = {m for m in base.methods
+                         if m not in {x.name for x in cs.node.body
+                                      if isinstance(x, ast.FunctionDef)}}
+            cs.accesses += [a for a in base.accesses
+                            if a.method in inherited]
+            cs.calls += [c for c in base.calls if c.method in inherited]
+            for m, acq in base.acquires.items():
+                if m in inherited:
+                    cs.acquires.setdefault(m, set()).update(acq)
+
+
+# ------------------------------------------------------------ discipline
+def _discipline(cs: ClassScan) -> List[Finding]:
+    out: List[Finding] = []
+    rel, cls = cs.mod.rel, cs.name
+    guarded: Dict[str, str] = {}     # attr -> root
+    for root, (attrs, line) in sorted(cs.guards.items()):
+        for a in sorted(attrs):
+            guarded[a] = root
+
+    by_attr: Dict[str, List[Access]] = {}
+    for a in cs.accesses:
+        by_attr.setdefault(a.attr, []).append(a)
+
+    # annotation-checked attrs: every access must hold the declared lock
+    for attr, root in sorted(guarded.items()):
+        accs = by_attr.get(attr, [])
+        if not accs and attr not in cs.init_assigned:
+            line = cs.guards[root][1]
+            out.append(Finding(
+                "lock-guards", f"lock-guards:{rel}:{cls}.{attr}:stale",
+                cs.mod.path, line,
+                f"`# guards:` on {cls}.{root} lists `{attr}`, but no such "
+                f"attribute is assigned or accessed — stale annotation"))
+            continue
+        bad = sorted({a.line for a in accs if root not in a.held})
+        if bad:
+            shown = ",".join(map(str, bad[:6]))
+            out.append(Finding(
+                "lock-guards", f"lock-guards:{rel}:{cls}.{attr}",
+                cs.mod.path, bad[0],
+                f"{cls}.{attr} is declared `# guards: ...` by {root} "
+                f"(line {cs.guards[root][1]}) but accessed without it at "
+                f"line(s) {shown}"))
+
+    if not cs.threaded:
+        return out
+
+    for attr in sorted(by_attr):
+        if attr in guarded:
+            continue
+        accs = by_attr[attr]
+        writes = [a for a in accs if a.kind == "w"]
+        if not writes:
+            continue                      # init-only / read-only state
+        locked = [a for a in accs if a.held]
+        unlocked = [a for a in accs if not a.held]
+        if not locked or not unlocked:
+            continue
+        roots = sorted({r for a in locked for r in a.held})
+        ul = sorted({a.line for a in unlocked})
+        shown = ",".join(map(str, ul[:6])) + ("…" if len(ul) > 6 else "")
+        detail = (f"under {roots[0]}" if len(roots) == 1
+                  else f"under multiple locks ({'/'.join(roots)})")
+        out.append(Finding(
+            "lock-discipline", f"lock-discipline:{rel}:{cls}.{attr}",
+            cs.mod.path, ul[0],
+            f"{cls}.{attr} is accessed {detail} in {len(locked)} place(s) "
+            f"but touched with no lock held at line(s) {shown} in a "
+            f"thread-spawning class — candidate race (annotate the lock "
+            f"with `# guards:` or take it)"))
+    return out
+
+
+# ------------------------------------------------------------- holds rule
+def _holds_check(cs: ClassScan) -> List[Finding]:
+    out: List[Finding] = []
+    rel, cls = cs.mod.rel, cs.name
+    for c in cs.calls:
+        if c.callee[0] != "self":
+            continue
+        callee = c.callee[1]
+        need = cs.holds.get(callee)
+        if not need:
+            continue
+        missing = sorted(need - c.held)
+        if missing:
+            out.append(Finding(
+                "lock-holds",
+                f"lock-holds:{rel}:{cls}.{c.method}->{callee}",
+                cs.mod.path, c.line,
+                f"{cls}.{c.method} calls {callee}() (annotated "
+                f"`# dklint: holds {','.join(sorted(need))}`) without "
+                f"holding {','.join(missing)}"))
+    return out
+
+
+# ------------------------------------------------------------- lock order
+def _order_edges(classes: Dict[str, ClassScan]
+                 ) -> Dict[Tuple[str, str], Tuple[str, int]]:
+    """Global acquisition-order edges ``(src, dst) -> (site, line)`` over
+    node labels ``modkey.Class._root``."""
+    # transitive closure of per-method acquire sets
+    acq: Dict[Tuple[str, str], Set[str]] = {}
+    for cname, cs in classes.items():
+        for m, roots in cs.acquires.items():
+            acq[(cname, m)] = {f"{cs.mod.modkey}.{cname}.{r}" for r in roots}
+        for m in cs.methods:
+            acq.setdefault((cname, m), set())
+    changed = True
+    while changed:
+        changed = False
+        for cname, cs in classes.items():
+            for c in cs.calls:
+                if c.callee[0] == "self":
+                    key = (cname, c.callee[1])
+                elif c.callee[0] == "attr":
+                    tname = cs.attr_types.get(c.callee[1])
+                    if tname not in classes:
+                        continue
+                    key = (tname, c.callee[2])
+                else:
+                    continue
+                add = acq.get(key)
+                if not add:
+                    continue
+                cur = acq.setdefault((cname, c.method), set())
+                if not add <= cur:
+                    cur |= add
+                    changed = True
+
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def put(src: str, dst: str, path: str, line: int) -> None:
+        edges.setdefault((src, dst), (path, line))
+
+    for cname, cs in classes.items():
+        label = lambda r: f"{cs.mod.modkey}.{cname}.{r}"   # noqa: E731
+        for src, dst, line in cs.nest_edges:
+            put(label(src), label(dst), cs.mod.path, line)
+        for c in cs.calls:
+            if not c.held:
+                continue
+            if c.callee[0] == "self":
+                key = (cname, c.callee[1])
+            elif c.callee[0] == "attr":
+                tname = cs.attr_types.get(c.callee[1])
+                if tname not in classes:
+                    continue
+                key = (tname, c.callee[2])
+            else:
+                continue
+            for dst in acq.get(key, ()):
+                for h in c.held:
+                    src = label(h)
+                    if src != dst:      # interprocedural same-lock: runtime's
+                        put(src, dst, cs.mod.path, c.line)
+    return edges
+
+
+def _cycles(edges: Dict[Tuple[str, str], Tuple[str, int]]
+            ) -> List[List[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    # Tarjan SCC
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    stack: List[str] = []
+    on: Set[str] = set()
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strong(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in sorted(graph[v]):
+            if w not in index:
+                strong(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1 or (len(comp) == 1
+                                 and comp[0] in graph[comp[0]]):
+                out.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strong(v)
+    return out
+
+
+def check(mods: Sequence[ModuleInfo]) -> List[Finding]:
+    classes: Dict[str, ClassScan] = {}
+    for mod in mods:
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                cs = _collect_class(mod, node)
+                _scan_methods(cs)
+                # first scan wins on (unlikely) cross-module name clashes
+                classes.setdefault(node.name, cs)
+    _flatten(classes)
+
+    out: List[Finding] = []
+    for cname in sorted(classes):
+        cs = classes[cname]
+        if not cs.lock_root:
+            continue
+        out += _discipline(cs)
+        out += _holds_check(cs)
+
+    edges = _order_edges(classes)
+    for comp in _cycles(edges):
+        sites = sorted({f"{p}:{ln}" for (a, b), (p, ln) in edges.items()
+                        if a in comp and b in comp})
+        ident = "lock-order:" + "<->".join(comp)
+        first = min((ln for (a, b), (p, ln) in edges.items()
+                     if a in comp and b in comp), default=0)
+        path = next((p for (a, b), (p, ln) in edges.items()
+                     if a in comp and b in comp), "?")
+        if len(comp) == 1:
+            msg = (f"non-reentrant lock {comp[0]} is acquired while "
+                   f"already held (self-deadlock) at {', '.join(sites)}")
+        else:
+            msg = (f"lock acquisition-order cycle between "
+                   f"{' and '.join(comp)} — inversion sites: "
+                   f"{', '.join(sites)}")
+        out.append(Finding("lock-order", ident, path, first, msg))
+    return out

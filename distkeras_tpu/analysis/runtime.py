@@ -1,0 +1,236 @@
+"""dklint runtime complement: live lock-order auditing.
+
+The static pass (:mod:`.locks`) sees ``self._x`` locks inside one class;
+it cannot see orders that run *across objects* reached through locals
+(the serving engine touching a ``RequestHandle``'s condition while its
+admission queue is involved, a supervisor probing a shard's apply lock).
+:class:`OrderedLock` closes that gap at test time:
+
+* every instrumented lock gets a stable name (its creation site),
+* every acquire records ``held → new`` edges into a process-global
+  acquisition-order graph **before** blocking (so a genuine inversion is
+  reported instead of deadlocking the suite),
+* any edge that closes a cycle is a :class:`LockOrderViolation` —
+  collected on the auditor by default so swallowed-exception paths in
+  product threads can't hide it; the chaos-suite fixture asserts
+  ``auditor.violations == []`` at teardown.
+
+:func:`audit_locks` patches ``threading.Lock`` / ``RLock`` /
+``Condition`` with instrumented factories for the duration of a block,
+so production modules are audited **unmodified** — locks created while
+the patch is active are tracked, pre-existing locks are simply not.
+``threading.Condition(some_ordered_lock)`` shares the wrapped lock's
+identity, which reproduces the static pass's condition-owned-lock
+grouping (``_not_full``/``_have_work`` are ``_qlock``).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+import _thread
+
+_REAL_LOCK = _thread.allocate_lock          # un-patchable originals
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+
+class LockOrderViolation(RuntimeError):
+    """A lock acquisition closed a cycle in the runtime order graph."""
+
+
+def _creation_site(skip_prefixes: Tuple[str, ...]) -> str:
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.endswith(skip_prefixes) and "threading" not in fn:
+            short = "/".join(fn.split("/")[-2:])
+            return f"{short}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+class LockOrderAuditor:
+    """Process-wide acquisition-order graph with on-the-fly cycle check."""
+
+    def __init__(self, raise_on_violation: bool = False):
+        self.raise_on_violation = raise_on_violation
+        self._mu = _REAL_LOCK()
+        self._edges: Dict[str, Dict[str, str]] = {}   # a -> b -> first site
+        self._tls = threading.local()
+        self.violations: List[str] = []
+
+    # -- per-thread held stack
+    def _held(self) -> List["OrderedLock"]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    # -- graph
+    def _reachable(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS path src→dst in the edge graph (cycle witness), or None."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            for nxt in self._edges.get(node, ()):  # insertion order: stable
+                if nxt == dst:
+                    return path + [dst]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def before_acquire(self, lock: "OrderedLock") -> None:
+        held = self._held()
+        if not held:
+            return
+        # Fast path: every held→new edge already recorded means no new
+        # bookkeeping.  The unlocked dict reads are a benign race — a miss
+        # just sends us through the slow path, which re-checks under _mu.
+        # Keeping stack formatting off this path matters: hot scheduler
+        # loops nest acquires thousands of times a second.
+        name, edges = lock.name, self._edges
+        if all(h.name == name or name in edges.get(h.name, ())
+               for h in held):
+            return
+        caller = sys._getframe(2)
+        site = None
+        with self._mu:
+            for h in held:
+                if h.name == lock.name:
+                    continue                      # re-entry of the same lock
+                row = self._edges.setdefault(h.name, {})
+                if lock.name in row:
+                    continue
+                if site is None:                  # format once, only if new
+                    site = "".join(traceback.format_stack(caller, limit=3))
+                back = self._reachable(lock.name, h.name)
+                row[lock.name] = site.strip().splitlines()[-1].strip() \
+                    if site else "?"
+                if back is not None:
+                    cyc = " -> ".join(back + [lock.name]) \
+                        if back[-1] != lock.name else " -> ".join(back)
+                    msg = (f"lock-order inversion: acquiring {lock.name} "
+                           f"while holding {h.name}, but the reverse order "
+                           f"{cyc} was already observed\n  at:\n{site}")
+                    self.violations.append(msg)
+                    if self.raise_on_violation:
+                        raise LockOrderViolation(msg)
+
+    def on_acquired(self, lock: "OrderedLock") -> None:
+        self._held().append(lock)
+
+    def on_release(self, lock: "OrderedLock") -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):    # non-LIFO release is legal
+            if held[i] is lock:
+                del held[i]
+                return
+
+    def edges(self) -> Dict[str, Dict[str, str]]:
+        with self._mu:
+            return {a: dict(bs) for a, bs in self._edges.items()}
+
+
+#: auditor used by instrumented locks that are not given one explicitly
+_default_auditor: Optional[LockOrderAuditor] = None
+
+
+class OrderedLock:
+    """Drop-in ``threading.Lock``/``RLock`` wrapper feeding an auditor.
+
+    The underlying primitive is real (``_thread.allocate_lock`` or a real
+    ``RLock``), so blocking/timeout semantics are untouched; the wrapper
+    only adds order bookkeeping around ``acquire``/``release``.
+    """
+
+    def __init__(self, name: Optional[str] = None,
+                 auditor: Optional[LockOrderAuditor] = None,
+                 reentrant: bool = False):
+        self._inner = _REAL_RLOCK() if reentrant else _REAL_LOCK()
+        self.name = name or _creation_site(("runtime.py",))
+        self.auditor = auditor if auditor is not None else _default_auditor
+        self.reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        aud = self.auditor
+        if aud is not None and blocking:
+            aud.before_acquire(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got and aud is not None:
+            aud.on_acquired(self)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        if self.auditor is not None:
+            self.auditor.on_release(self)
+
+    def locked(self) -> bool:
+        if self.reentrant:                      # pragma: no cover - parity
+            raise AttributeError("locked() on an RLock wrapper")
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<OrderedLock {self.name}>"
+
+
+def _make_condition(auditor: Optional[LockOrderAuditor],
+                    lock=None) -> "threading.Condition":
+    """A real ``threading.Condition`` over an :class:`OrderedLock`.
+
+    ``Condition.wait`` releases/reacquires through the wrapper, so the
+    held-stack stays truthful across waits; a condition built over an
+    existing ordered lock shares that lock's name (group identity).
+    """
+    if lock is None:
+        lock = OrderedLock(auditor=auditor,
+                           name=_creation_site(("runtime.py",)))
+    return _REAL_CONDITION(lock)
+
+
+@contextmanager
+def audit_locks(auditor: Optional[LockOrderAuditor] = None,
+                raise_on_violation: bool = False):
+    """Patch ``threading.Lock``/``RLock``/``Condition`` with instrumented
+    factories for the duration of the block; yields the auditor.
+
+    Opt-in by design: the chaos/resilience suites use the
+    ``lock_order_audit`` conftest fixture, which wraps the test body in
+    this context and asserts no violations at teardown.
+    """
+    global _default_auditor
+    aud = auditor or LockOrderAuditor(raise_on_violation=raise_on_violation)
+    saved = (threading.Lock, threading.RLock, threading.Condition,
+             _default_auditor)
+    _default_auditor = aud
+
+    def _lock():
+        return OrderedLock(auditor=aud)
+
+    def _rlock():
+        return OrderedLock(auditor=aud, reentrant=True)
+
+    def _condition(lock=None):
+        return _make_condition(aud, lock)
+
+    threading.Lock = _lock
+    threading.RLock = _rlock
+    threading.Condition = _condition
+    try:
+        yield aud
+    finally:
+        (threading.Lock, threading.RLock, threading.Condition,
+         _default_auditor) = saved

@@ -1,0 +1,139 @@
+"""dklint rule family 4: wire-protocol exhaustiveness.
+
+Two cheap-but-load-bearing audits over the framed socket protocols:
+
+* ``wire-opcode`` — every module-level ``<NS>_OP_<NAME> = b"?"`` constant
+  is collected into its ``<NS>`` namespace (``SERVING_OP_*``,
+  ``PS_OP_*``, ...).  Two different names bound to the same byte within
+  one namespace is always an error (one dispatch table cannot tell them
+  apart); the same byte appearing in *different* namespaces is flagged
+  too, because the only thing keeping it safe is the guarantee that the
+  two protocols never share a socket — if that is true it belongs in
+  ``baseline.toml`` with exactly that sentence as justification.
+
+* ``wire-codec`` — the pytree codec marks node kinds with ``"__xx__"``
+  dict tags.  Any function that *builds* a dict literal keyed by such a
+  tag is an encoder; any function that *tests or subscripts* at least
+  two distinct tags is a decoder path (the two-tag floor keeps
+  ``__main__``-style incidental strings out).  Every tag any encoder in
+  the module emits must be handled by **every** decoder path in that
+  module — a node kind added to ``_encode_node`` but not to
+  ``_expected_buffer_sizes`` is exactly the desync this rule exists for.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Sequence, Set, Tuple
+
+from .core import Finding, ModuleInfo
+
+_OPCONST_RE = re.compile(r"^([A-Z][A-Z0-9]*(?:_[A-Z0-9]+)*?)_OP_([A-Z0-9_]+)$")
+_TAG_RE = re.compile(r"^__\w+__$")
+
+
+def _opcode_findings(mods: Sequence[ModuleInfo]) -> List[Finding]:
+    # (namespace, name) -> (value, mod, line)
+    consts: Dict[Tuple[str, str], Tuple[bytes, ModuleInfo, int]] = {}
+    for mod in mods:
+        for node in mod.tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            m = _OPCONST_RE.match(node.targets[0].id)
+            if not m:
+                continue
+            if isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, bytes):
+                consts[(m.group(1), node.targets[0].id)] = (
+                    node.value.value, mod, node.lineno)
+    out: List[Finding] = []
+    items = sorted(consts.items())
+    for i, ((ns_a, name_a), (val_a, mod_a, line_a)) in enumerate(items):
+        for (ns_b, name_b), (val_b, mod_b, line_b) in items[i + 1:]:
+            if val_a != val_b:
+                continue
+            ident = f"wire-opcode:{name_a}<->{name_b}"
+            if ns_a == ns_b:
+                msg = (f"opcode collision inside namespace {ns_a}: "
+                       f"{name_a} ({mod_a.rel}:{line_a}) and {name_b} "
+                       f"({mod_b.rel}:{line_b}) are both {val_a!r} — one "
+                       f"dispatch table cannot tell them apart")
+            else:
+                msg = (f"cross-namespace opcode collision: {name_a} "
+                       f"({ns_a}, {mod_a.rel}:{line_a}) and {name_b} "
+                       f"({ns_b}, {mod_b.rel}:{line_b}) are both {val_a!r} "
+                       f"— safe only while the protocols never share a "
+                       f"socket")
+            out.append(Finding("wire-opcode", ident, mod_a.path, line_a,
+                               msg))
+    return out
+
+
+def _func_iter(tree: ast.Module):
+    """Yield (qualname, FunctionDef) for every function, nested included."""
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                yield qual, child
+                yield from walk(child, qual)
+            elif isinstance(child, ast.ClassDef):
+                cq = f"{prefix}.{child.name}" if prefix else child.name
+                yield from walk(child, cq)
+            else:
+                yield from walk(child, prefix)
+    yield from walk(tree, "")
+
+
+def _codec_findings(mods: Sequence[ModuleInfo]) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in mods:
+        encoded: Dict[str, Tuple[str, int]] = {}   # tag -> (encoder, line)
+        decoders: List[Tuple[str, int, Set[str]]] = []
+        for qual, fn in _func_iter(mod.tree):
+            emits: Set[str] = set()
+            handles: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Dict):
+                    for k in node.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str) and \
+                                _TAG_RE.match(k.value):
+                            emits.add(k.value)
+                elif isinstance(node, ast.Compare) and \
+                        len(node.ops) == 1 and \
+                        isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                        isinstance(node.left, ast.Constant) and \
+                        isinstance(node.left.value, str) and \
+                        _TAG_RE.match(node.left.value):
+                    handles.add(node.left.value)
+                elif isinstance(node, ast.Subscript):
+                    sl = node.slice
+                    if isinstance(sl, ast.Constant) and \
+                            isinstance(sl.value, str) and \
+                            _TAG_RE.match(sl.value):
+                        handles.add(sl.value)
+            for t in emits:
+                encoded.setdefault(t, (qual, fn.lineno))
+            if len(handles) >= 2 and not emits:
+                decoders.append((qual, fn.lineno, handles))
+        if not encoded or not decoders:
+            continue
+        for dq, dline, handles in decoders:
+            for tag in sorted(encoded):
+                if tag not in handles:
+                    eq, eline = encoded[tag]
+                    out.append(Finding(
+                        "wire-codec",
+                        f"wire-codec:{mod.rel}:{dq}:{tag}",
+                        mod.path, dline,
+                        f"codec node tag `{tag}` is emitted by {eq}() "
+                        f"(line {eline}) but decoder path {dq}() never "
+                        f"handles it — encode/decode desync"))
+    return out
+
+
+def check(mods: Sequence[ModuleInfo]) -> List[Finding]:
+    return _opcode_findings(mods) + _codec_findings(mods)

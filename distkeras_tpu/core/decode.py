@@ -925,9 +925,12 @@ def speculative_generate(model, params, draft_model, draft_params, prompt,
     else:
         cur = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)  # (B,)
 
-    # model closes over (it shapes the program); params stay a traced arg
+    # model closes over (it shapes the program); params stay a traced arg.
+    # caches are donated: every call rebinds t_caches to the output and the
+    # input buffer is dead — rejection never rolls back (rejected positions
+    # are simply overwritten by the next round), so no alias survives
     verify = jax.jit(lambda p, caches, toks, pos: _forward(
-        model, p, caches, toks, pos))
+        model, p, caches, toks, pos), donate_argnums=(1,))
     d_step = jit_decode_step(draft_model)
 
     # eos stopping, same semantics as generate: a row that emitted eos

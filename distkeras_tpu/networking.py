@@ -911,6 +911,19 @@ SERVING_OP_ENQUEUE = b"q"
 SERVING_OP_STREAM = b"r"
 SERVING_OP_CANCEL = b"x"
 
+#: PS-protocol opcodes (``parameter_servers.*SocketParameterServer`` —
+#: reference protocol ``'p'`` pull / ``'c'`` commit, plus ``'u'`` update
+#: (commit+pull in one round trip), ``'h'`` heartbeat, ``'q'`` quit.
+#: ``PS_OP_QUIT`` and ``SERVING_OP_ENQUEUE`` share the byte ``'q'``: safe
+#: only because the two protocols never share a socket (each server owns
+#: its port) — dklint's wire-opcode rule flags the collision and
+#: analysis/baseline.toml records exactly that justification.
+PS_OP_PULL = b"p"
+PS_OP_COMMIT = b"c"
+PS_OP_UPDATE = b"u"
+PS_OP_HEARTBEAT = b"h"
+PS_OP_QUIT = b"q"
+
 
 def send_opcode(sock: socket.socket, op: bytes) -> None:
     """Send a 1-byte action opcode (reference protocol: ``'p'`` pull /
@@ -1103,8 +1116,11 @@ class ChaosProxy:
             self._pairs.append((client, upstream))
         rng = random.Random((self.seed << 20) ^ idx)
         serving = self.protocol == "serving"
-        frame_ops = (b"q", b"r", b"x") if serving else (b"c", b"u")
-        reply_ops = (b"q", b"x") if serving else (b"p", b"u", b"h")
+        frame_ops = ((SERVING_OP_ENQUEUE, SERVING_OP_STREAM,
+                      SERVING_OP_CANCEL) if serving
+                     else (PS_OP_COMMIT, PS_OP_UPDATE))
+        reply_ops = ((SERVING_OP_ENQUEUE, SERVING_OP_CANCEL) if serving
+                     else (PS_OP_PULL, PS_OP_UPDATE, PS_OP_HEARTBEAT))
         op_index = 0
         try:
             while True:

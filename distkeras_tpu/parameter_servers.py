@@ -423,7 +423,7 @@ class ThreadedSocketParameterServer:
         self._conn_threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
         self._conn_of: Dict[threading.Thread, socket.socket] = {}
-        self._conn_lock = threading.Lock()  # guards _conns/_conn_threads/_running
+        self._conn_lock = threading.Lock()  # guards: _conns, _conn_threads, _conn_of, _running
         self._running = False
 
     # -- lifecycle (reference: initialize/start/stop) ------------------------
@@ -434,7 +434,8 @@ class ThreadedSocketParameterServer:
         self._server.bind((self.host, self.port))
         self.port = self._server.getsockname()[1]
         self._server.listen(128)
-        self._running = True
+        with self._conn_lock:
+            self._running = True
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="dkt-ps-accept")
         self._accept_thread.start()
@@ -741,7 +742,7 @@ class SocketParameterServer:
         #: on either core.
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: Dict[socket.socket, _EventConn] = {}
-        self._conn_lock = threading.Lock()  # guards _conns/_running
+        self._conn_lock = threading.Lock()  # guards: _conns, _running
         self._conn_threads: List[threading.Thread] = []  # event core: none
         # server-level pool for the drain's SHARED 'u' reply frame (every
         # connection in a drain queues a view of the same encoded bytes)
@@ -784,7 +785,8 @@ class SocketParameterServer:
         self._selector = selectors.DefaultSelector()
         self._selector.register(self._server, selectors.EVENT_READ, None)
         self._selector.register(r, selectors.EVENT_READ, None)
-        self._running = True
+        with self._conn_lock:
+            self._running = True
         self._accept_thread = threading.Thread(
             target=self._io_loop, daemon=True, name="dkt-ps-io")
         self._accept_thread.start()

@@ -282,7 +282,7 @@ class ShardSupervisor:
         self.recoveries: List[Dict[str, Any]] = []
         self._running = False
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()  # serializes respawn vs. stop
+        self._lock = threading.Lock()  # guards: recoveries (and serializes respawn_shard bodies vs. chaos hooks)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
@@ -951,7 +951,8 @@ class WorkerSupervisor:
         self.active.add(nid)
         self.respawns += 1
         self._start(nid)
-        t_death = self.death_times.get(died)
+        with self._lock:
+            t_death = self.death_times.get(died)
         rec = {"died": died, "replacement": nid, "reason": reason,
                "recovery_ms": (round((time.monotonic() - t_death) * 1e3, 1)
                                if t_death is not None else None)}
@@ -963,8 +964,11 @@ class WorkerSupervisor:
 
     def _declare_dead(self, wid: int, note: str, reason: str):
         self.active.discard(wid)
-        self.failures.setdefault(wid, note)
-        self.death_times.setdefault(wid, time.monotonic())
+        with self._lock:
+            # first cause wins against the worker's own unwind path, which
+            # setdefaults the same keys from its thread (_thread_main)
+            self.failures.setdefault(wid, note)
+            self.death_times.setdefault(wid, time.monotonic())
         self.ledger.revoke_worker(wid)
         self.events.append({"kind": "death", "worker": wid,
                             "reason": reason})
@@ -991,22 +995,25 @@ class WorkerSupervisor:
                         f"wedged: lease {lease.lease_id} deadline expired "
                         f"with no renewal (epoch {epoch})",
                         reason="wedged")
-            # deaths: threads that raised out of their lease loop
+            # deaths: threads that raised out of their lease loop (error and
+            # note captured under the lock so a racing worker unwind cannot
+            # tear the pair)
             with self._lock:
-                dead = [w for w in sorted(self.active) if w in self.errors]
-            for wid in dead:
-                err = self.errors[wid]
+                dead = [(w, self.errors[w], self.failures[w])
+                        for w in sorted(self.active) if w in self.errors]
+            for wid, err, note in dead:
                 if isinstance(err, KeyboardInterrupt):
                     raise err
                 from .ps_sharding import PSShardDown
                 if isinstance(err, PSShardDown):
                     raise err  # a lost center partition is not a worker death
-                self._declare_dead(wid, self.failures[wid], reason="died")
+                self._declare_dead(wid, note, reason="died")
             # liveness: leases remain but nobody is working on them
             if not self.ledger.epoch_done() \
                     and not any(self._alive(w) for w in self.active):
-                restartable = [w for w in sorted(self.active)
-                               if w in self.results]
+                with self._lock:
+                    restartable = [w for w in sorted(self.active)
+                                   if w in self.results]
                 if restartable:
                     # finished workers rejoin to drain revoked leases
                     self._start(restartable[0])

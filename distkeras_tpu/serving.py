@@ -1891,7 +1891,8 @@ class ServingEngine:
                 # so it must not contribute a (near-zero) sample to the
                 # slot_reclaim_ms reclamation-latency metric
                 self._account_terminal(h, reason, now, held_slot=False)
-                self.stats["requests_completed"] += 1
+                with self._qlock:  # drain()'s busy() sums this cross-thread
+                    self.stats["requests_completed"] += 1
         did = bool(shed)
         for slot in np.flatnonzero(self._active):
             h = self._handles[slot]
@@ -1925,7 +1926,8 @@ class ServingEngine:
         self._free.append(slot)
         self._release_blocks(slot)
         if h._finish(reason):
-            self.stats["requests_completed"] += 1
+            with self._qlock:  # drain()'s busy() sums this cross-thread
+                self.stats["requests_completed"] += 1
             self._account_terminal(h, reason, time.perf_counter())
 
     def _release_blocks(self, slot: int) -> None:
@@ -2355,7 +2357,8 @@ class ServingEngine:
             else:
                 self._dev_act = self._deact_fn(self._dev_act, slot)
         if h._finish(reason):  # no-op when _declare_dead already failed it
-            self.stats["requests_completed"] += 1
+            with self._qlock:  # drain()'s busy() sums this cross-thread
+                self.stats["requests_completed"] += 1
             self._account_terminal(h, reason, time.perf_counter())
 
     # ------------------------------------------------------------ schedule
@@ -2505,7 +2508,8 @@ class ServingEngine:
         mode); idles on the work condition when nothing is queued/active."""
         if self._thread is not None:
             return self
-        self._running = True
+        with self._qlock:
+            self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="dkt-serving-engine")
         self._thread.start()
@@ -2520,8 +2524,8 @@ class ServingEngine:
         ``result()`` waiter blocks on a thread that will never answer),
         and the thread is detached — the same leak contract as
         ``SocketParameterServer.stop(join_timeout)``."""
-        self._running = False
         with self._qlock:
+            self._running = False
             self._have_work.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=join_timeout)
@@ -2565,10 +2569,11 @@ class ServingEngine:
             # rejected requests ARE terminal (incremented before the
             # QueueFull/EngineDead/Draining raise) — without them a single
             # backpressure shed would leave busy() True forever
-            s = self.stats
-            return (s["requests_submitted"]
-                    > s["requests_completed"] + s["requests_failed"]
-                    + s["requests_rejected"])
+            with self._qlock:
+                s = self.stats
+                return (s["requests_submitted"]
+                        > s["requests_completed"] + s["requests_failed"]
+                        + s["requests_rejected"])
 
         def timed_out() -> bool:
             return (timeout is not None
@@ -2614,8 +2619,8 @@ class ServingEngine:
                else EngineDead(f"serving engine died: {cause!r}"))
         if exc is not cause:
             exc.__cause__ = cause
-        self._running = False
         with self._qlock:
+            self._running = False
             if self._dead is not None:
                 return
             self._dead = exc
@@ -2630,7 +2635,8 @@ class ServingEngine:
             # no-op — only a true transition counts (a request must never
             # land in both requests_completed and requests_failed)
             if h._fail(EngineDead(str(exc)), reason=reason):
-                self.stats["requests_failed"] += 1
+                with self._qlock:  # drain()'s busy() sums this cross-thread
+                    self.stats["requests_failed"] += 1
 
     @property
     def dead(self) -> Optional[BaseException]:

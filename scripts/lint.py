@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Repo lint entry point: run dklint (the concurrency + JAX-discipline
+static analyzer) over the package with the checked-in baseline.
+
+Equivalent to ``python -m distkeras_tpu.analysis``; exists so CI and
+humans share one obvious command.  Exit 0 = no unbaselined findings.
+
+    python scripts/lint.py                 # analyze distkeras_tpu/
+    python scripts/lint.py path/ --json    # any paths, JSON report
+    python scripts/lint.py --baseline none # show everything, ignore baseline
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distkeras_tpu.analysis.__main__ import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,3 +59,21 @@ def pytest_configure(config):
         "(tier-1 ones run small seeded traces inline — no sleeps; the "
         "arena-pressure soaks and timing comparisons are additionally "
         "marked slow, mirroring the stream marker's tiering)")
+    config.addinivalue_line(
+        "markers",
+        "analysis: dklint static-analysis contract tests (pure-ast over "
+        "fixture strings plus the tier-1 zero-unbaselined gate over the "
+        "package — no JAX imports of checked code, no sleeps)")
+
+
+@pytest.fixture()
+def lock_order_audit():
+    """Opt-in runtime lock-order auditing: locks created inside the test
+    body (engine/supervisor construction included) are instrumented, and
+    teardown asserts the acquisition-order graph stayed acyclic.  See
+    distkeras_tpu/analysis/runtime.py."""
+    from distkeras_tpu.analysis.runtime import audit_locks
+    with audit_locks() as auditor:
+        yield auditor
+    assert auditor.violations == [], \
+        "runtime lock-order violations:\n" + "\n".join(auditor.violations)

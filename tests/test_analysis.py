@@ -1,0 +1,479 @@
+"""dklint contract tests: every rule family must (a) catch its planted
+defect and (b) stay silent on the clean twin, plus the baseline
+round-trip and the tier-1 gate that runs the analyzer over the real
+package.  Fixtures are source strings analyzed from tmp_path — the
+analyzer never imports checked code, so neither do these tests."""
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from distkeras_tpu.analysis import (LockOrderAuditor, LockOrderViolation,
+                                    OrderedLock, audit_locks,
+                                    default_baseline_path, load_baseline,
+                                    render_baseline, run_analysis)
+
+pytestmark = pytest.mark.analysis
+
+
+def analyze(tmp_path, files):
+    for name, src in files.items():
+        (tmp_path / name).write_text(textwrap.dedent(src))
+    return run_analysis([str(tmp_path)], baseline=None)
+
+
+def idents(report, rule=None):
+    return [f.ident for f in report.unbaselined
+            if rule is None or f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# rule family 1: lock discipline
+# ---------------------------------------------------------------------------
+
+RACY = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            with self._lock:
+                self._count += 1
+
+        def snapshot(self):
+            return self._count
+"""
+
+CLEAN_LOCKED = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            with self._lock:
+                self._count += 1
+
+        def snapshot(self):
+            with self._lock:
+                return self._count
+"""
+
+
+def test_unlocked_attr_in_threaded_class_is_flagged(tmp_path):
+    report = analyze(tmp_path, {"mod.py": RACY})
+    assert "lock-discipline:mod.py:Worker._count" in idents(report)
+
+
+def test_consistently_locked_attr_is_clean(tmp_path):
+    report = analyze(tmp_path, {"mod.py": CLEAN_LOCKED})
+    assert idents(report, "lock-discipline") == []
+
+
+def test_guards_annotation_catches_unlocked_access(tmp_path):
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()  # guards: _count
+                self._count = 0
+
+            def bump(self):
+                self._count += 1
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert any(i.startswith("lock-guards:mod.py:Worker._count")
+               for i in idents(report, "lock-guards")), report.unbaselined
+
+
+def test_guards_annotation_flags_stale_attr(tmp_path):
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()  # guards: _ghost
+                self._count = 0
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert any("_ghost" in i for i in idents(report, "lock-guards"))
+
+
+def test_guards_annotation_clean_when_honored(tmp_path):
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()  # guards: _count
+                self._count = 0
+
+            def bump(self):
+                with self._lock:
+                    self._count += 1
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert idents(report, "lock-guards") == []
+
+
+# ---------------------------------------------------------------------------
+# rule family 2: lock-order cycles
+# ---------------------------------------------------------------------------
+
+TWO_LOCK_CYCLE = """
+    import threading
+
+    class AB:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def rev(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+TWO_LOCK_CLEAN = """
+    import threading
+
+    class AB:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def also_fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+"""
+
+
+def test_two_lock_cycle_is_flagged(tmp_path):
+    report = analyze(tmp_path, {"mod.py": TWO_LOCK_CYCLE})
+    assert idents(report, "lock-order"), report.unbaselined
+
+
+def test_consistent_lock_order_is_clean(tmp_path):
+    report = analyze(tmp_path, {"mod.py": TWO_LOCK_CLEAN})
+    assert idents(report, "lock-order") == []
+
+
+def test_interprocedural_cycle_is_flagged(tmp_path):
+    src = """
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def _take_a(self):
+                with self._a:
+                    pass
+
+            def fwd(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def rev(self):
+                with self._b:
+                    self._take_a()
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert idents(report, "lock-order"), report.unbaselined
+
+
+# ---------------------------------------------------------------------------
+# rule family 3: JAX tracing / transfer discipline
+# ---------------------------------------------------------------------------
+
+def test_item_inside_jitted_fn_is_flagged(tmp_path):
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert idents(report, "jax-host-sync"), report.unbaselined
+
+
+def test_host_sync_reachable_through_helper_is_flagged(tmp_path):
+    src = """
+        import jax
+
+        def helper(x):
+            return float(x)
+
+        @jax.jit
+        def f(x):
+            return helper(x)
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert idents(report, "jax-host-sync"), report.unbaselined
+
+
+def test_python_branch_on_tracer_is_flagged(tmp_path):
+    src = """
+        import jax
+
+        @jax.jit
+        def g(x):
+            if x > 0:
+                return x
+            return -x
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert idents(report, "jax-traced-branch"), report.unbaselined
+
+
+def test_shape_branch_and_unjitted_item_are_clean(tmp_path):
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def g(x):
+            if x.shape[0] > 1:
+                return jnp.sum(x)
+            return x
+
+        def host_side(x):
+            return x.item()
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert idents(report, "jax-host-sync") == []
+    assert idents(report, "jax-traced-branch") == []
+
+
+def test_cache_threading_jit_without_donation_is_flagged(tmp_path):
+    src = """
+        import jax
+
+        def make_step(model):
+            def step(params, cache, tok):
+                return tok, cache
+            return jax.jit(step)
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert idents(report, "jax-donate"), report.unbaselined
+
+
+def test_cache_threading_jit_with_donation_is_clean(tmp_path):
+    src = """
+        import jax
+
+        def make_step(model):
+            def step(params, cache, tok):
+                return tok, cache
+            return jax.jit(step, donate_argnums=(1,))
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert idents(report, "jax-donate") == []
+
+
+# ---------------------------------------------------------------------------
+# rule family 4: wire-protocol exhaustiveness
+# ---------------------------------------------------------------------------
+
+def test_same_namespace_opcode_collision_is_flagged(tmp_path):
+    src = """
+        PS_OP_PULL = b"p"
+        PS_OP_PUSH = b"p"
+        PS_OP_QUIT = b"q"
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert "wire-opcode:PS_OP_PULL<->PS_OP_PUSH" in idents(report)
+
+
+def test_distinct_opcodes_are_clean(tmp_path):
+    src = """
+        PS_OP_PULL = b"p"
+        PS_OP_QUIT = b"q"
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert idents(report, "wire-opcode") == []
+
+
+def test_codec_tag_missing_from_decoder_is_flagged(tmp_path):
+    src = """
+        def encode(node):
+            return {"__sp__": 1, "__nd__": 2, "__tuple__": 3}
+
+        def decode(msg):
+            if "__sp__" in msg:
+                return msg["__sp__"]
+            return msg["__nd__"]
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert any(i.endswith(":__tuple__")
+               for i in idents(report, "wire-codec")), report.unbaselined
+
+
+def test_exhaustive_codec_is_clean(tmp_path):
+    src = """
+        def encode(node):
+            return {"__sp__": 1, "__nd__": 2}
+
+        def decode(msg):
+            if "__sp__" in msg:
+                return msg["__sp__"]
+            return msg["__nd__"]
+    """
+    report = analyze(tmp_path, {"mod.py": src})
+    assert idents(report, "wire-codec") == []
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+def test_baseline_suppresses_and_reports_stale(tmp_path):
+    (tmp_path / "mod.py").write_text(textwrap.dedent(RACY))
+    first = run_analysis([str(tmp_path)], baseline=None)
+    assert first.unbaselined
+    entries = {f.ident: "known benign: fixture" for f in first.unbaselined}
+    entries["lock-discipline:mod.py:Worker._gone"] = "stale on purpose"
+    bl = tmp_path / "baseline.toml"
+    bl.write_text(render_baseline(entries))
+    second = run_analysis([str(tmp_path)], baseline=str(bl))
+    assert second.unbaselined == []
+    assert len(second.suppressed) == len(first.unbaselined)
+    assert second.stale_baseline == ["lock-discipline:mod.py:Worker._gone"]
+
+
+def test_baseline_rejects_empty_justification(tmp_path):
+    bl = tmp_path / "baseline.toml"
+    bl.write_text('[[finding]]\nid = "x:y:z"\njustification = ""\n')
+    with pytest.raises(ValueError):
+        load_baseline(str(bl))
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the package itself stays clean
+# ---------------------------------------------------------------------------
+
+def test_package_has_zero_unbaselined_findings():
+    import distkeras_tpu
+    pkg = Path(distkeras_tpu.__file__).parent
+    report = run_analysis([str(pkg)], baseline=default_baseline_path())
+    assert not report.unbaselined, "unbaselined dklint findings:\n" + \
+        "\n".join(f.render() for f in report.unbaselined)
+    assert not report.stale_baseline, \
+        f"stale baseline entries (delete them): {report.stale_baseline}"
+    for f in report.suppressed:
+        assert f.ident in load_baseline(default_baseline_path())
+
+
+# ---------------------------------------------------------------------------
+# runtime complement: OrderedLock / audit_locks
+# ---------------------------------------------------------------------------
+
+def test_ordered_lock_consistent_order_is_clean():
+    aud = LockOrderAuditor()
+    a = OrderedLock(name="a", auditor=aud)
+    b = OrderedLock(name="b", auditor=aud)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert aud.violations == []
+    assert "b" in aud.edges().get("a", {})
+
+
+def test_ordered_lock_inversion_is_reported_not_deadlocked():
+    aud = LockOrderAuditor()
+    a = OrderedLock(name="a", auditor=aud)
+    b = OrderedLock(name="b", auditor=aud)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # inversion: must report, must NOT block
+            pass
+    assert len(aud.violations) == 1
+    assert "inversion" in aud.violations[0]
+
+
+def test_ordered_lock_raise_on_violation():
+    aud = LockOrderAuditor(raise_on_violation=True)
+    a = OrderedLock(name="a", auditor=aud)
+    b = OrderedLock(name="b", auditor=aud)
+    with a:
+        with b:
+            pass
+    with pytest.raises(LockOrderViolation):
+        with b:
+            with a:
+                pass
+
+
+def test_reentry_of_same_lock_is_not_an_edge():
+    aud = LockOrderAuditor()
+    a = OrderedLock(name="a", auditor=aud, reentrant=True)
+    with a:
+        with a:
+            pass
+    assert aud.violations == []
+    assert aud.edges() == {}
+
+
+def test_audit_locks_patches_and_restores_threading():
+    import threading
+    real = (threading.Lock, threading.RLock, threading.Condition)
+    with audit_locks() as aud:
+        lk = threading.Lock()
+        assert isinstance(lk, OrderedLock)
+        cv = threading.Condition(threading.Lock())
+        with cv:
+            cv.notify_all()
+        with lk:
+            pass
+    assert (threading.Lock, threading.RLock, threading.Condition) == real
+    assert aud.violations == []
+
+
+def test_audit_locks_catches_cross_object_inversion():
+    # NOTE: locks are classed by creation site (lockdep-style), so the two
+    # locks must come from distinct lines to be distinct graph nodes
+    with audit_locks() as aud:
+        import threading
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    assert len(aud.violations) == 1

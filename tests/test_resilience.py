@@ -240,7 +240,7 @@ def test_shard_journal_roundtrip_and_retention(tmp_path):
     assert j.latest(0)["clock"] == 30
 
 
-def test_bounded_loss_contract_across_respawn():
+def test_bounded_loss_contract_across_respawn(lock_order_audit):
     """ACCEPTANCE: commit d1 → snapshot → commit d2 → crash → respawn.
     The restored center is exactly w0+d1 (d2, committed after the last
     snapshot, is dropped — the same loss class as worker staleness), the
@@ -295,7 +295,7 @@ def test_bounded_loss_contract_across_respawn():
 # the supervisor — crash and wedge detection, same-address respawn
 # ---------------------------------------------------------------------------
 
-def test_supervisor_detects_crash_and_respawns_same_port():
+def test_supervisor_detects_crash_and_respawns_same_port(lock_order_audit):
     group = _group(num_shards=2)
     sup = _supervisor(group)
     sup.start()

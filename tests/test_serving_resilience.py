@@ -399,7 +399,7 @@ def test_stalled_engine_sends_typed_error_frame(fitted):
 # graceful drain
 # ---------------------------------------------------------------------------
 
-def test_drain_finishes_inflight_then_stops(fitted):
+def test_drain_finishes_inflight_then_stops(fitted, lock_order_audit):
     eng = ServingEngine(fitted, num_slots=1, max_len=24).start()
     h1 = eng.submit(PROMPT, 8)
     h2 = eng.submit(OTHER, 5)  # queued behind h1 on the lone slot
@@ -616,7 +616,7 @@ def test_stop_join_timeout_surfaces_wedged_thread(fitted):
 # EngineSupervisor: detect crash + wedge, restart, client retry
 # ---------------------------------------------------------------------------
 
-def test_supervisor_restarts_crashed_engine_and_client_retries(fitted):
+def test_supervisor_restarts_crashed_engine_and_client_retries(fitted, lock_order_audit):
     eng = ServingEngine(fitted, num_slots=2, max_len=24).warmup()
     want = _want(fitted, PROMPT, 6)
     with ServingServer(eng, poll_s=0.01) as srv:
@@ -642,7 +642,7 @@ def test_supervisor_restarts_crashed_engine_and_client_retries(fitted):
             _assert_slots_reclaimed(srv.engine)
 
 
-def test_supervisor_detects_wedged_engine_via_heartbeat(fitted):
+def test_supervisor_detects_wedged_engine_via_heartbeat(fitted, lock_order_audit):
     eng = ServingEngine(fitted, num_slots=2, max_len=24).warmup()
     want = _want(fitted, PROMPT, 6)
     release = _wedge(eng)

@@ -49,7 +49,7 @@ def _run_clean(code: str, timeout: float = 420.0, skip_on_timeout=False):
 def tpu_available():
     out = _run_clean(
         "import jax; d = jax.devices()[0]; print('PLATFORM=' + d.platform)",
-        timeout=120.0, skip_on_timeout=True)
+        timeout=45.0, skip_on_timeout=True)
     if out.returncode != 0 or "PLATFORM=" not in out.stdout:
         pytest.skip("no jax backend reachable for the smoke subprocess")
     platform = out.stdout.rsplit("PLATFORM=", 1)[1].strip()
