@@ -439,6 +439,80 @@ def host_ps_stream_bench(budget_s: float = 90.0):
             t.stream_stats.get("examples_per_sec")}
 
 
+def online_deployment_bench(budget_s: float = 120.0):
+    """The train-while-serve loop (deployment_online.py): a drifting
+    token-mapping stream trains under DOWNPOUR while an inline engine
+    hot-reloads from the live PS and answers probe traffic each horizon,
+    with served feedback riding the stream.  The observables are the
+    freshness percentiles (stream entry → commit → served pull, row-
+    weighted) and the FINAL served accuracy against the drifted world —
+    accuracy-tracks-drift on the served path.  Returns
+    ``{"freshness_p50_s", "freshness_p99_s", "online_served_accuracy"}``
+    — None on overrun/failure, never fatal to the north-star artifact.
+    """
+    import numpy as np
+
+    import jax
+
+    from distkeras_tpu import DOWNPOUR, OnlineDeployment
+    from distkeras_tpu.models import transformer_lm
+    from distkeras_tpu.serving import ServingEngine
+    from distkeras_tpu.streaming import StreamSource
+
+    vocab, seq = 16, 8
+    rng = np.random.default_rng(0)
+    mapping = rng.permutation(vocab).astype(np.int32)
+    drifted = mapping.copy()
+    flip = rng.permutation(vocab)[: vocab // 2]
+    drifted[flip] = np.roll(mapping[flip], 1)
+
+    def gen():
+        for i in range(6):
+            m = drifted if i >= 3 else mapping
+            x = rng.integers(0, vocab, (128, seq)).astype(np.int32)
+            yield x, m[x]
+
+    def make_model():
+        return transformer_lm(vocab_size=vocab, seq_len=seq + 2,
+                              d_model=32, num_heads=4, num_layers=1,
+                              mlp_dim=64, compute_dtype="float32")
+
+    trainer = DOWNPOUR(
+        make_model(), num_workers=2, batch_size=16, num_epoch=1,
+        communication_window=2, execution="host_ps",
+        loss="sparse_categorical_crossentropy_from_logits",
+        worker_optimizer="adam", learning_rate=3e-3, stream=True,
+        horizon_windows=4, seed=0, max_horizons=12)
+    serve_model = make_model()
+    params = serve_model.init(jax.random.PRNGKey(1), (seq + 2,))
+    engine = ServingEngine((serve_model, params), num_slots=4, max_len=4)
+    dep = OnlineDeployment(trainer, StreamSource(generator=gen()),
+                           engine, reload_every=1)
+    probe = np.arange(vocab, dtype=np.int32).reshape(-1, 1)
+    acc = {"last": None}
+
+    def on_horizon(h, fitted):
+        rows, _ = dep.serve(list(probe), num_steps=1)
+        pred = np.array([r[1] for r in rows])
+        acc["last"] = float(np.mean(pred == drifted[probe[:, 0]]))
+        if h < 8:
+            fx = np.repeat(probe, seq, axis=1)
+            dep.feed(fx, (drifted if h >= 3 else mapping)[fx])
+
+    trainer.on_horizon = on_horizon
+    t0 = time.perf_counter()
+    dep.start()
+    dep.join(timeout=max(budget_s, 30.0))
+    dep.stop()
+    s = dep.stats()
+    if time.perf_counter() - t0 > budget_s:
+        return {"freshness_p50_s": None, "freshness_p99_s": None,
+                "online_served_accuracy": None}
+    return {"freshness_p50_s": s["freshness_p50_s"],
+            "freshness_p99_s": s["freshness_p99_s"],
+            "online_served_accuracy": acc["last"]}
+
+
 def host_ps_recovery_bench(budget_s: float = 60.0):
     """Client-observed shard recovery latency: a 2-shard group under a
     ``ShardSupervisor``; one shard is crash-killed and the measured number
@@ -1061,6 +1135,20 @@ def main():
         except Exception as e:
             print(f"[bench] serving bench failed: {e}", file=sys.stderr)
     result.update(serving_fields)
+    # the train-while-serve loop (deployment_online.py): freshness
+    # percentiles + served accuracy under drift on the live deployment
+    stage("online deployment")
+    online_fields = {"freshness_p50_s": None, "freshness_p99_s": None,
+                     "online_served_accuracy": None}
+    online_remaining = budget - (time.perf_counter() - t_start)
+    if online_remaining > 60:
+        try:
+            online_fields = online_deployment_bench(
+                budget_s=online_remaining)
+        except Exception as e:
+            print(f"[bench] online deployment bench failed: {e}",
+                  file=sys.stderr)
+    result.update(online_fields)
     if real_platform == "cpu":
         # CPU fallback: carry the hardware signal instead of erasing it
         result["probe_history"] = probe_history

@@ -30,6 +30,8 @@ from . import utils
 from . import networking
 from . import streaming
 from .streaming import StreamBuffer, StreamSource
+from . import deployment_online
+from .deployment_online import FreshnessTracker, OnlineDeployment
 from . import workers
 from . import ps_sharding
 from . import parameter_servers

@@ -1258,6 +1258,27 @@ PS_CLASSES = {
     "eamsgd": DeltaParameterServer,
 }
 
+#: bind addresses that are listenable but not dialable — an advertise
+#: host must never default to one of these
+_WILDCARD_HOSTS = ("0.0.0.0", "::", "")
+
+
+def resolve_ps_hosts(trainer) -> tuple:
+    """The (bind, advertise) PS address pair for one training run.
+
+    ``ps_bind_host`` is where the socket server listens; ``ps_advertise_host``
+    is what the workers' config (and any ``attach_ps`` engine) dials.
+    Advertise defaults to the bind host — except when the bind is a
+    wildcard, which is listenable but not dialable, so the default falls
+    back to loopback (multi-host callers bind ``"0.0.0.0"`` and advertise
+    ``networking.determine_host_address()`` — docs/DEPLOY.md).  Both
+    default to the historical loopback, bit for bit."""
+    bind = getattr(trainer, "ps_bind_host", None) or "127.0.0.1"
+    advertise = getattr(trainer, "ps_advertise_host", None)
+    if advertise is None:
+        advertise = "127.0.0.1" if bind in _WILDCARD_HOSTS else bind
+    return bind, advertise
+
 
 def allocate_parameter_server(algorithm: str, model_blob: dict,
                               num_workers: int,
@@ -1346,6 +1367,10 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     # tests/test_ps_sharding.py) so there is exactly one supervised
     # lifecycle: servers held in a mutable list the supervisor can respawn
     # into.  recovery=False keeps the PR 2 paths untouched.
+    # PS address pair (docs/DEPLOY.md): bind where the server listens,
+    # advertise what the workers dial — both loopback unless the trainer's
+    # ps_bind_host/ps_advertise_host knobs say otherwise
+    bind_host, advertise_host = resolve_ps_hosts(trainer)
     sharded = ps_shards > 1 or recovery
     if sharded:
         # PS sharding (ps_sharding.py): partition the center weight vector
@@ -1354,13 +1379,15 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
         # so staleness semantics are per-shard identical to the single-PS
         # path and PS CPU/NIC bandwidth scales with the shard count
         server = ShardedServerGroup(algorithm, blob, n, ps_shards,
+                                    host=bind_host,
                                     ps_core=ps_core, coalesce=coalesce,
                                     apply_kernel=apply_kernel)
         server.start()
     else:
         ps = allocate_parameter_server(algorithm, blob, n,
                                        apply_kernel=apply_kernel)
-        server = make_socket_server(ps, ps_core=ps_core, coalesce=coalesce)
+        server = make_socket_server(ps, host=bind_host, ps_core=ps_core,
+                                    coalesce=coalesce)
         server.start()
     supervisor = None
     if recovery:
@@ -1387,7 +1414,7 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
     worker_cls = WORKER_CLASSES[algorithm]
     kw = _worker_kwargs(trainer, n, len(x))
     kw.update(worker_optimizer=trainer.worker_optimizer,
-              ps_host="127.0.0.1",
+              ps_host=advertise_host,
               ps_port=(server.ports[0] if sharded else server.port))
     rs = getattr(trainer, "row_sparse", None)
     if rs:
@@ -1403,7 +1430,7 @@ def run_host_ps_training(trainer, dataset, shuffle: bool = False,
         # lets chaos tests interpose a networking.ChaosProxy per shard — the
         # workers then drive the real socket stack through the proxy while
         # the supervisor heartbeats the shards directly.
-        addrs = server.addrs
+        addrs = [(advertise_host, int(p)) for _, p in server.addrs]
         hook = getattr(trainer, "_shard_addr_hook", None)
         if hook is not None:
             addrs = [(str(h), int(p)) for h, p in hook(list(addrs))]

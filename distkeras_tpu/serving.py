@@ -113,6 +113,20 @@ from .core.model import FittedModel, Sequential
 
 logger = logging.getLogger("distkeras_tpu.serving")
 
+from .resilience import RetryPolicy as _RetryPolicy  # noqa: E402 (no cycle:
+# resilience imports networking only — and serving needs the policy type at
+# module scope for the reload default below)
+
+#: re-dial budget for ``attach_ps`` hot-reload pulls.  Deliberately TIGHT:
+#: the pull runs on the decode thread between steps, so the policy's worst
+#: case (attempts x backoff, deadline-capped) is the longest serving stall
+#: a dead PS can cause — long enough to ride out a ``ShardSupervisor``
+#: same-address respawn, short enough that serving p99 survives a PS that
+#: is simply gone.  Override per-engine via ``attach_ps(retry_policy=...)``.
+DEFAULT_RELOAD_POLICY = _RetryPolicy(attempts=4, backoff=0.02,
+                                     max_backoff=0.1, jitter=0.0,
+                                     deadline=0.5)
+
 tmap = jax.tree_util.tree_map
 
 
@@ -957,6 +971,11 @@ class ServingEngine:
         self._reload_every = 0
         self._reload_sock: Optional[socket.socket] = None
         self._reload_pool = networking.BufferPool()
+        self._reload_policy = None          # resilience.RetryPolicy or None
+        #: optional (t_monotonic, center_clock) callback fired after every
+        #: SUCCESSFUL pull — the freshness seam deployment_online.py hooks
+        #: (called on the decode thread; must be cheap and non-raising)
+        self._reload_listener = None
 
         # -- scheduler thread + stats + failure state
         self._thread: Optional[threading.Thread] = None
@@ -973,6 +992,15 @@ class ServingEngine:
             "prefills": 0, "decode_steps": 0, "active_slot_steps": 0,
             "queue_peak": 0, "slot_requests": [0] * self.num_slots,
             "weight_reloads": 0,
+            # hot-reload hardening observables (docs/serving.md): reloads
+            # mirrors weight_reloads (successful pulls — both kept so
+            # pre-existing consumers and the online-deployment stats agree),
+            # reload_failures counts pulls abandoned after the retry
+            # policy's re-dial budget, center_generation is the PS center's
+            # update clock stamped on the last successful pull (None until
+            # one lands) — the commit→pull→decode generation chain
+            # deployment_online.py tracks freshness through
+            "reloads": 0, "reload_failures": 0, "center_generation": None,
             # failure-semantics observables (this PR's contract surface):
             # cancelled/expired count retirements by reason; failed counts
             # handles the engine abandoned with EngineDead; reclaim_ms is
@@ -2670,7 +2698,13 @@ class ServingEngine:
         if self._fp_skel is not None:
             eng._fp_skel = self._fp_skel
         if self._ps_addr is not None:
-            eng.attach_ps(*self._ps_addr, every=self._reload_every)
+            eng.attach_ps(*self._ps_addr, every=self._reload_every,
+                          retry_policy=self._reload_policy)
+        # the freshness listener is engine-agnostic (a (time, clock)
+        # callback) — carrying it over keeps the online deployment's
+        # freshness chain intact across supervised restarts and
+        # blue/green swaps without re-registration
+        eng._reload_listener = self._reload_listener
         return eng
 
     @property
@@ -2860,23 +2894,41 @@ class ServingEngine:
         self.stop()
 
     # ------------------------------------------------- hot reload (stretch)
-    def attach_ps(self, host: str, port: int, every: int = 1) -> None:
+    def attach_ps(self, host: str, port: int, every: int = 1,
+                  retry_policy=None) -> None:
         """Hot weight reload: pull a fresh center from a live parameter
         server (the PS stack's ``'p'`` opcode — same wire the training
         workers speak) every ``every`` decode steps, so a training run and
         this engine share one deployment.  The pull happens BETWEEN decode
         steps — in-flight requests simply continue on the new weights (the
         KV cache keeps old-weight k/v until those positions roll out, the
-        standard live-reload tradeoff)."""
+        standard live-reload tradeoff).
+
+        ``retry_policy`` (a ``resilience.RetryPolicy``) governs the
+        RE-DIAL when the reload socket is down — a PS shard respawning on
+        the same address (``ShardSupervisor``) comes back within a few
+        tens of milliseconds, so a short bounded policy rides out the
+        blip without abandoning the pull.  The default
+        (:data:`DEFAULT_RELOAD_POLICY`) is deliberately tight: the pull
+        runs on the decode thread between steps, so its worst case is a
+        bounded serving stall, never an unbounded one.  A pull that fails
+        past the policy counts ``stats["reload_failures"]`` and KEEPS the
+        current weights — hot reload stays best-effort by design; the
+        engine never dies on its PS."""
         if every < 1:
             raise ValueError(f"every must be >= 1, got {every}")
         self._ps_addr = (host, int(port))
+        self._reload_policy = retry_policy
         self._reload_every = int(every)
 
     def _pull_weights(self) -> None:
         try:
             if self._reload_sock is None:
-                self._reload_sock = networking.connect(*self._ps_addr)
+                from . import resilience
+                policy = (self._reload_policy if self._reload_policy
+                          is not None else DEFAULT_RELOAD_POLICY)
+                self._reload_sock = resilience.dial(*self._ps_addr,
+                                                    policy=policy)
             networking.send_opcode(self._reload_sock, b"p")
             msg = networking.recv_data(self._reload_sock,
                                        pool=self._reload_pool)
@@ -2896,7 +2948,20 @@ class ServingEngine:
                 # zero-upload contract must survive a reload
                 self.params = jax.device_put(self.params)
             self.stats["weight_reloads"] += 1
+            self.stats["reloads"] += 1
+            clock = msg.get("clock") if isinstance(msg, dict) else None
+            if clock is not None:
+                self.stats["center_generation"] = int(clock)
+            listener = self._reload_listener
+            if listener is not None:
+                try:
+                    listener(time.monotonic(),
+                             self.stats["center_generation"])
+                except Exception:   # freshness is observability, not
+                    logger.exception(  # control flow — never kill decode
+                        "hot-reload listener raised")
         except (ConnectionError, OSError, ValueError) as e:
+            self.stats["reload_failures"] += 1
             logger.warning("serving hot-reload pull failed (%s); keeping "
                            "current weights", e)
             if self._reload_sock is not None:

@@ -485,16 +485,22 @@ def run_stream_training(trainer, source, on_horizon: Optional[
     ps_core = getattr(trainer, "ps_core", "event") or "event"
     coalesce = bool(getattr(trainer, "coalesce", True))
     apply_kernel = getattr(trainer, "apply_kernel", None)
+    # PS address pair (docs/DEPLOY.md): bind where the server listens,
+    # advertise what workers — and any attach_ps serving engine — dial
+    from .parameter_servers import resolve_ps_hosts
+    bind_host, advertise_host = resolve_ps_hosts(trainer)
     sharded = ps_shards > 1 or recovery
     if sharded:
         server = ShardedServerGroup(algorithm, blob, n, ps_shards,
+                                    host=bind_host,
                                     ps_core=ps_core, coalesce=coalesce,
                                     apply_kernel=apply_kernel)
         server.start()
     else:
         ps = allocate_parameter_server(algorithm, blob, n,
                                        apply_kernel=apply_kernel)
-        server = make_socket_server(ps, ps_core=ps_core, coalesce=coalesce)
+        server = make_socket_server(ps, host=bind_host, ps_core=ps_core,
+                                    coalesce=coalesce)
         server.start()
     supervisor = None
     if recovery:
@@ -502,14 +508,25 @@ def run_stream_training(trainer, source, on_horizon: Optional[
         supervisor = ShardSupervisor(server, algorithm, n)
         supervisor.start()
     trainer._ps_supervisor = supervisor
+    #: the live server object + the address a co-deployed serving engine
+    #: should dial — observability for deployment_online.py and tests
+    trainer._ps_server = server
+    trainer._ps_advertise_addr = (
+        advertise_host, server.ports[0] if sharded else server.port)
+    ready_cb = getattr(trainer, "_on_ps_ready", None)
+    if ready_cb is not None:
+        # the online-deployment seam: the PS exists only inside this run,
+        # so a co-deployed ServingEngine attaches here (attach_ps), once
+        # the address is live and before any worker commits
+        ready_cb(server, trainer._ps_advertise_addr)
 
     worker_cls = WORKER_CLASSES[algorithm]
     kw = _worker_kwargs(trainer, n, horizon_rows)
     kw.update(worker_optimizer=trainer.worker_optimizer,
-              ps_host="127.0.0.1",
+              ps_host=advertise_host,
               ps_port=(server.ports[0] if sharded else server.port))
     if sharded:
-        addrs = server.addrs
+        addrs = [(advertise_host, int(p)) for _, p in server.addrs]
         hook = getattr(trainer, "_shard_addr_hook", None)
         if hook is not None:
             addrs = [(str(h), int(p)) for h, p in hook(list(addrs))]

@@ -683,6 +683,15 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     staleness the async algorithms already tolerate.  ``PSShardDown`` is
     raised only after the recovery deadline.  ``recovery=False`` (default)
     keeps the fail-fast PR 2 behavior bit for bit.
+
+    ``ps_bind_host`` / ``ps_advertise_host`` (``execution='host_ps'``):
+    where the socket PS listens and what the workers (and any
+    ``attach_ps`` serving engine) dial.  Both default to loopback —
+    the historical single-host behavior, bit for bit.  Multi-host runs
+    bind ``"0.0.0.0"`` and advertise a routable interface
+    (``networking.determine_host_address()`` — docs/DEPLOY.md); a
+    wildcard bind with no explicit advertise falls back to advertising
+    loopback, since a wildcard is listenable but not dialable.
     """
 
     #: algorithms whose per-algorithm comm_overlap default is ON
@@ -700,6 +709,8 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                  horizon_windows: Optional[int] = None,
                  max_horizons: Optional[int] = None,
                  row_sparse=None,
+                 ps_bind_host: Optional[str] = None,
+                 ps_advertise_host: Optional[str] = None,
                  **kw):
         super().__init__(keras_model, **kw)
         self.parallelism_factor = int(parallelism_factor)
@@ -818,6 +829,31 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                     "row_sparse is the exact sparse profile and does not "
                     "compose with lossy wire_dtype codings — use "
                     "wire_dtype=None")
+        # PS address knobs (docs/DEPLOY.md): the driver historically wrote
+        # loopback into both the server bind and the worker config —
+        # correct single-host, wrong the moment workers live on another
+        # host (ROADMAP item 1).  ps_bind_host is the interface the socket
+        # PS listens on ("0.0.0.0" for all); ps_advertise_host is the
+        # address workers (and attach_ps engines) dial — defaults to the
+        # bind host, falling back to loopback when the bind is a wildcard
+        # (a wildcard is not dialable).  None/None keeps the loopback
+        # behavior bit for bit.
+        self.ps_bind_host = (None if ps_bind_host is None
+                             else str(ps_bind_host))
+        self.ps_advertise_host = (None if ps_advertise_host is None
+                                  else str(ps_advertise_host))
+        if self.ps_bind_host == "" or self.ps_advertise_host == "":
+            raise ValueError(
+                "ps_bind_host/ps_advertise_host must be a host string or "
+                "None (empty string is neither bindable nor dialable)")
+        if (self.ps_bind_host is not None
+                or self.ps_advertise_host is not None) and \
+                self.execution != "host_ps":
+            raise ValueError(
+                "ps_bind_host/ps_advertise_host configure the socket PS "
+                "address (execution='host_ps'); the SPMD engine has no "
+                "socket server and process_ps renders addresses through "
+                "the job layer")
         #: per-run streaming observability: horizons, rows ingested,
         #: examples/sec, buffer counters (run_stream_training)
         self.stream_stats: dict = {}

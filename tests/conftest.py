@@ -64,6 +64,12 @@ def pytest_configure(config):
         "analysis: dklint static-analysis contract tests (pure-ast over "
         "fixture strings plus the tier-1 zero-unbaselined gate over the "
         "package — no JAX imports of checked code, no sleeps)")
+    config.addinivalue_line(
+        "markers",
+        "online: train-while-serve deployment tests (tier-1 ones are "
+        "generator-backed and seeded with inline-pumped engines — no "
+        "sleeps on the fast path; the chaos soak with live engine kills "
+        "and supervised restarts is additionally marked slow)")
 
 
 @pytest.fixture()

@@ -1,0 +1,588 @@
+"""Online deployment (PR 15): train-while-serve under one lifecycle.
+
+The contract pinned here (docs/DEPLOY.md, "Online deployment"):
+
+ - **Freshness** is exact accounting: rows are stamped at stream entry
+   (``feed()`` time for served-traffic feedback, read arrival for base
+   chunks), horizons stamp their commit, and a successful ``attach_ps``
+   pull closes committed horizons into row-weighted ``freshness_p50/p99``
+   samples — unit-tested against hand-computed instants.
+ - **attach_ps hardening**: the reload socket dials under a
+   ``RetryPolicy``, a failed pull counts ``reload_failures`` and keeps
+   the current weights bit for bit, a successful pull counts ``reloads``
+   and stamps ``center_generation`` from the PS clock — and a PS killed
+   between a center commit and the next pull leaves the engine on the
+   OLD generation with untorn weights.
+ - **bind/advertise**: the socket PS binds ``ps_bind_host`` and workers/
+   engines dial ``ps_advertise_host``; a wildcard bind advertises
+   loopback; defaults keep the historical loopback pair.
+ - **OnlineDeployment**: the process graph runs end to end — serving
+   during training horizons (reload-during-horizon keeps serving), served
+   accuracy improves on the SERVED path, blue/green swaps are atomic
+   (contiguous generation tags, every response attributed to exactly one
+   generation), engine death loses zero requests, and constructing no
+   deployment changes nothing.
+
+Tier-1 legs are generator-backed, seeded, and inline-pumped (no live
+decode threads); the chaos soak (worker exit + PS shard kill + engine
+kill + blue/green in one run) is additionally marked slow.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+
+from distkeras_tpu import DOWNPOUR
+from distkeras_tpu.core.model import FittedModel, serialize_model
+from distkeras_tpu.deployment_online import (FreshnessTracker,
+                                             OnlineDeployment,
+                                             _weighted_percentile)
+from distkeras_tpu.models import transformer_lm
+from distkeras_tpu.parameter_servers import (DeltaParameterServer,
+                                             make_socket_server,
+                                             resolve_ps_hosts)
+from distkeras_tpu.resilience import RetryPolicy
+from distkeras_tpu.serving import EngineDead, ServingEngine
+from distkeras_tpu.streaming import StreamSource
+
+from test_streaming import (click_chunks, make_embedding_model,
+                            make_mapping)
+
+pytestmark = pytest.mark.online
+
+V, L = 16, 4  # vocab / context of the tiny next-item LM
+
+
+def make_lm(seed=0):
+    model = transformer_lm(vocab_size=V, seq_len=L + 2, d_model=16,
+                           num_heads=2, num_layers=1, mlp_dim=32,
+                           compute_dtype="float32")
+    params = model.init(jax.random.PRNGKey(seed), (L + 2,))
+    return FittedModel(model, params)
+
+
+def make_engine(seed=1, **kw):
+    f = make_lm(seed)
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_len", 3)
+    return ServingEngine((f.model, f.params), **kw)
+
+
+def make_stream_trainer(**kw):
+    kw.setdefault("num_workers", 2)
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("num_epoch", 1)
+    kw.setdefault("communication_window", 2)
+    kw.setdefault("execution", "host_ps")
+    kw.setdefault("loss", "sparse_categorical_crossentropy_from_logits")
+    kw.setdefault("worker_optimizer", "adam")
+    kw.setdefault("learning_rate", 3e-3)
+    kw.setdefault("stream", True)
+    kw.setdefault("horizon_windows", 4)
+    kw.setdefault("seed", 0)
+    return DOWNPOUR(make_lm().model, **kw)
+
+
+def mapping_chunks(mapping, num_chunks, rows=128, seed=0):
+    """Token-mapping LM stream: y = mapping[x] per position — prompt
+    ``[item]`` + one greedy step recommends ``mapping[item]``."""
+    rng = np.random.default_rng(seed)
+    for _ in range(num_chunks):
+        x = rng.integers(0, V, (rows, L)).astype(np.int32)
+        yield x, mapping[x]
+
+
+PROBE = np.arange(V, dtype=np.int32).reshape(-1, 1)
+
+
+def served_accuracy(dep, mapping):
+    rows, gens = dep.serve(list(PROBE), num_steps=1)
+    pred = np.array([r[1] for r in rows])
+    return float(np.mean(pred == mapping[PROBE[:, 0]])), gens
+
+
+# ---------------------------------------------------------------------------
+# the freshness tracker (pure, hand-computed instants)
+# ---------------------------------------------------------------------------
+
+def test_weighted_percentile_exact():
+    assert _weighted_percentile([], 50) is None
+    assert _weighted_percentile([(3.0, 7)], 50) == 3.0
+    s = [(2.0, 10), (3.0, 10)]
+    assert _weighted_percentile(s, 50) == 2.0   # 10 rows reach the median
+    assert _weighted_percentile(s, 99) == 3.0
+    assert _weighted_percentile([(5.0, 1), (1.0, 99)], 50) == 1.0
+
+
+def test_freshness_tracker_exact_samples():
+    tr = FreshnessTracker()
+    h = tr.note_horizon([(10, 0.0), (10, 1.0)])  # two stamped chunks
+    tr.note_commit(h, t=2.0)
+    tr.note_commit(h, t=9.0)  # idempotent: first commit instant wins
+    tr.note_pull(3.0, generation=5)
+    s = tr.stats()
+    # samples: (3-0, 10 rows) and (3-1, 10 rows), row-weighted
+    assert s["freshness_p50_s"] == 2.0
+    assert s["freshness_p99_s"] == 3.0
+    assert s["freshness_rows"] == 20
+    assert s["freshness_horizons_served"] == 1
+    assert s["freshness_horizons_committed"] == 1
+    assert s["reload_pulls"] == 1
+    assert s["center_generation"] == 5
+
+
+def test_freshness_pull_serves_only_prior_commits():
+    tr = FreshnessTracker()
+    a = tr.note_horizon([(4, 0.0)])
+    b = tr.note_horizon([(4, 0.5)])
+    tr.note_pull(1.0, generation=1)       # nothing committed yet
+    assert tr.stats()["freshness_rows"] == 0
+    tr.note_commit(a, t=2.0)
+    tr.note_commit(b, t=5.0)
+    tr.note_pull(3.0, generation=2)       # serves a, NOT b (commit 5 > 3)
+    s = tr.stats()
+    assert s["freshness_horizons_served"] == 1
+    assert s["freshness_rows"] == 4
+    tr.note_pull(6.0, generation=3)       # now b, sample stays per-chunk
+    s = tr.stats()
+    assert s["freshness_horizons_served"] == 2
+    assert s["freshness_rows"] == 8
+    assert s["center_generation"] == 3
+    # a's sample closed at ITS pull (3.0), not re-stamped by later pulls
+    assert s["freshness_p50_s"] == 3.0
+
+
+def test_freshness_empty_stats():
+    s = FreshnessTracker().stats()
+    assert s["freshness_p50_s"] is None and s["freshness_p99_s"] is None
+    assert s["freshness_rows"] == 0 and s["reload_pulls"] == 0
+    assert s["center_generation"] is None
+
+
+# ---------------------------------------------------------------------------
+# bind/advertise resolution (satellite 2)
+# ---------------------------------------------------------------------------
+
+def test_resolve_ps_hosts_matrix():
+    def t(bind, adv):
+        return SimpleNamespace(ps_bind_host=bind, ps_advertise_host=adv)
+
+    # defaults: the historical loopback pair, bit for bit
+    assert resolve_ps_hosts(t(None, None)) == ("127.0.0.1", "127.0.0.1")
+    assert resolve_ps_hosts(object()) == ("127.0.0.1", "127.0.0.1")
+    # a wildcard bind is listenable but not dialable -> advertise loopback
+    assert resolve_ps_hosts(t("0.0.0.0", None)) == ("0.0.0.0", "127.0.0.1")
+    assert resolve_ps_hosts(t("::", None)) == ("::", "127.0.0.1")
+    # a concrete bind advertises itself
+    assert resolve_ps_hosts(t("10.0.0.5", None)) == ("10.0.0.5", "10.0.0.5")
+    # an explicit advertise always wins
+    assert resolve_ps_hosts(t("0.0.0.0", "10.0.0.5")) == \
+        ("0.0.0.0", "10.0.0.5")
+
+
+def test_ps_host_knobs_validated_eagerly():
+    with pytest.raises(ValueError, match="empty string"):
+        make_stream_trainer(ps_bind_host="")
+    with pytest.raises(ValueError, match="empty string"):
+        make_stream_trainer(ps_advertise_host="")
+    with pytest.raises(ValueError, match="host_ps"):
+        DOWNPOUR(make_embedding_model(), num_workers=2, batch_size=8,
+                 num_epoch=1, communication_window=2,
+                 ps_bind_host="0.0.0.0")  # SPMD engine: no socket server
+
+
+def test_stream_trains_on_wildcard_bind_loopback_advertise():
+    """The PS binds 0.0.0.0 while workers dial the advertised loopback —
+    the multi-host address split, exercised end to end on one host.  Also
+    pins no-deployment-no-change: a plain stream run grows no freshness
+    keys."""
+    mapping = make_mapping()
+    tr = DOWNPOUR(make_embedding_model(), num_workers=2, batch_size=16,
+                  num_epoch=1, communication_window=2, learning_rate=0.5,
+                  execution="host_ps", stream=True, horizon_windows=8,
+                  seed=0, ps_bind_host="0.0.0.0",
+                  ps_advertise_host="127.0.0.1")
+    fitted = tr.train(StreamSource(
+        generator=click_chunks(mapping, num_chunks=6, rows=64, seed=1)))
+    assert fitted is not None
+    assert tr.stream_stats["rows"] == 6 * 64   # every row trained
+    assert "freshness_p50_s" not in tr.stream_stats
+
+
+# ---------------------------------------------------------------------------
+# attach_ps hardening (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_attach_ps_failed_pull_counts_and_keeps_weights():
+    """No PS behind the address: the retry-policy dial fails, the pull
+    counts a reload_failure, and serving continues bit-identically on the
+    current weights."""
+    import socket as _socket
+    probe = _socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()  # nothing listens there now
+
+    f = make_lm(seed=3)
+    eng = ServingEngine((f.model, f.params), num_slots=1, max_len=3)
+    eng.attach_ps("127.0.0.1", dead_port, every=1,
+                  retry_policy=RetryPolicy(attempts=1, backoff=0.0,
+                                           jitter=0.0, deadline=0.05))
+    want = np.asarray(f.generate(PROBE[3][None], 1, max_len=3))[0]
+    h = eng.submit(PROBE[3], 1)
+    eng.run_until_idle()
+    assert eng.stats["reload_failures"] >= 1
+    assert eng.stats["reloads"] == 0
+    assert eng.stats["center_generation"] is None
+    np.testing.assert_array_equal(h.result(), want)
+
+
+def test_attach_ps_center_generation_tracks_ps_clock():
+    """A successful pull stamps center_generation from the PS clock and
+    fires the reload listener; the clock advances with commits."""
+    center = make_lm(seed=9)
+    ps = DeltaParameterServer(
+        serialize_model(center.model, center.params))
+    server = make_socket_server(ps)
+    server.start()
+    seen = []
+    try:
+        eng = make_engine(seed=1)
+        eng.attach_ps("127.0.0.1", server.port, every=1)
+        eng._reload_listener = lambda t, g: seen.append(g)
+        h = eng.submit(PROBE[0], 1)
+        eng.run_until_idle()
+        assert h.done
+        assert eng.stats["reloads"] >= 1
+        assert eng.stats["center_generation"] == ps.num_updates == 0
+        # the engine now serves the pulled center's numerics
+        want = np.asarray(center.generate(PROBE[5][None], 1, max_len=3))[0]
+        h2 = eng.submit(PROBE[5], 1)
+        eng.run_until_idle()
+        np.testing.assert_array_equal(h2.result(), want)
+        # a commit advances the clock; the next pull observes it
+        delta = [np.zeros_like(w) for w in ps.center]
+        ps.handle_commit({"delta": delta, "worker_id": 0, "clock": 0})
+        h3 = eng.submit(PROBE[6], 1)
+        eng.run_until_idle()
+        assert h3.done
+        assert eng.stats["center_generation"] == ps.num_updates == 1
+        assert seen[-1] == 1 and seen[0] == 0  # listener saw each pull
+    finally:
+        server.stop()
+
+
+def test_ps_kill_between_commit_and_pull_keeps_old_generation():
+    """The PS dies after a center commit but before the engine's next
+    pull: the pull fails, the engine stays on the OLD generation with
+    untorn weights — never a half-applied center."""
+    center = make_lm(seed=9)
+    ps = DeltaParameterServer(
+        serialize_model(center.model, center.params))
+    server = make_socket_server(ps)
+    server.start()
+    eng = make_engine(seed=1)
+    eng.attach_ps("127.0.0.1", server.port, every=1,
+                  retry_policy=RetryPolicy(attempts=1, backoff=0.0,
+                                           jitter=0.0, deadline=0.05))
+    h = eng.submit(PROBE[0], 1)
+    eng.run_until_idle()
+    assert h.done and eng.stats["reloads"] == 1
+    assert eng.stats["center_generation"] == 0
+    frozen = [np.asarray(w).copy() for w in
+              eng.model.get_weights(eng.params)]
+    # the center commits generation 1... and the PS dies before the pull
+    ps.handle_commit({"delta": [np.ones_like(w) for w in ps.center],
+                      "worker_id": 0, "clock": 0})
+    server.stop()
+    h2 = eng.submit(PROBE[7], 1)
+    eng.run_until_idle()
+    assert h2.done
+    assert eng.stats["reload_failures"] >= 1
+    assert eng.stats["center_generation"] == 0  # still the old generation
+    for a, b in zip(eng.model.get_weights(eng.params), frozen):
+        np.testing.assert_array_equal(np.asarray(a), b)  # untorn
+
+
+def test_respawn_clone_carries_reload_policy_and_listener():
+    pol = RetryPolicy(attempts=2, backoff=0.01, jitter=0.0, deadline=0.2)
+    seen = []
+    eng = make_engine(seed=1)
+    eng.attach_ps("127.0.0.1", 1, every=3, retry_policy=pol)
+    eng._reload_listener = seen.append
+    clone = eng.respawn_clone()
+    assert clone._ps_addr == eng._ps_addr
+    assert clone._reload_every == 3
+    assert clone._reload_policy is pol
+    assert clone._reload_listener is eng._reload_listener
+
+
+# ---------------------------------------------------------------------------
+# OnlineDeployment: construction contract
+# ---------------------------------------------------------------------------
+
+def test_online_deployment_validation():
+    eng = make_engine()
+    src = StreamSource(generator=iter(()))
+    with pytest.raises(ValueError, match="stream=True"):
+        OnlineDeployment(
+            DOWNPOUR(make_lm().model, num_workers=2, batch_size=8,
+                     num_epoch=1, execution="host_ps"), src, eng)
+    with pytest.raises(ValueError, match="ps_shards=1"):
+        OnlineDeployment(make_stream_trainer(ps_shards=2), src, eng)
+    with pytest.raises(ValueError, match="StreamSource"):
+        OnlineDeployment(make_stream_trainer(), [1, 2], eng)
+    with pytest.raises(ValueError, match="ServingEngine"):
+        OnlineDeployment(make_stream_trainer(), src, object())
+    with pytest.raises(ValueError, match="reload_every"):
+        OnlineDeployment(make_stream_trainer(), src, eng, reload_every=0)
+    attached = make_engine()
+    attached.attach_ps("127.0.0.1", 1)
+    with pytest.raises(ValueError, match="already attach_ps-ed"):
+        OnlineDeployment(make_stream_trainer(), src, attached)
+
+
+def test_no_deployment_no_behavior_change():
+    """Constructing no OnlineDeployment leaves every seam at its default:
+    the hooks are None, the engine counters zero, and CONSTRUCTING one
+    mutates neither the base source nor the engine until start()."""
+    tr = make_stream_trainer()
+    assert getattr(tr, "_on_ps_ready", None) is None
+    assert tr.on_horizon is None
+    assert tr.ps_bind_host is None and tr.ps_advertise_host is None
+    eng = make_engine()
+    assert eng._reload_listener is None and eng._reload_policy is None
+    assert eng.stats["reloads"] == 0
+    assert eng.stats["reload_failures"] == 0
+    assert eng.stats["center_generation"] is None
+    base = StreamSource(generator=iter(()))
+    dep = OnlineDeployment(make_stream_trainer(), base, eng)
+    assert dep.source._base is base       # wrapped, not mutated
+    assert eng._ps_addr is None           # attachment waits for start()
+    assert eng._reload_listener is None
+    assert dep.generation == 0 and dep.swaps == []
+
+
+# ---------------------------------------------------------------------------
+# the process graph end to end (tier-1: inline engine, natural drain)
+# ---------------------------------------------------------------------------
+
+def test_online_deployment_serves_during_horizons_and_tracks_freshness():
+    """The tentpole loop: training horizons commit to the live PS, the
+    inline engine hot-reloads BETWEEN decode steps while serving probe
+    traffic from on_horizon (reload-during-horizon keeps serving), served
+    traffic feeds back, and the run drains naturally once the base stream
+    and feedback end.  Freshness is populated and mirrored."""
+    rng = np.random.default_rng(0)
+    mapping = rng.permutation(V).astype(np.int32)
+    trainer = make_stream_trainer()
+    dep = OnlineDeployment(
+        trainer, StreamSource(generator=mapping_chunks(mapping, 3)),
+        make_engine(), reload_every=1)
+    curve, gen_tags = [], []
+
+    def on_horizon(h, fitted):
+        acc, gens = served_accuracy(dep, mapping)
+        curve.append(acc)
+        gen_tags.extend(gens)
+        if h < 3:  # feedback rides along while the base stream lives
+            fx = np.repeat(PROBE, L, axis=1)
+            dep.feed(fx, mapping[fx])
+
+    trainer.on_horizon = on_horizon
+    dep.start()
+    assert dep.wait_ps_ready(timeout=60.0)
+    fitted = dep.join(timeout=300.0)
+    dep.stop()
+    assert fitted is not None and dep.done
+    s = dep.stats()
+    # zero lost examples: base + feedback rows all trained
+    assert s["stream_stats"]["rows"] == 3 * 128 + s["rows_fed_back"]
+    assert s["rows_fed_back"] > 0
+    # the engine kept serving through every reload
+    assert len(curve) == s["stream_stats"]["horizons"]
+    assert s["engine_requests_failed"] == 0
+    assert s["engine_requests_completed"] == len(gen_tags)
+    assert all(g == 0 for g in gen_tags)  # no swaps: one generation
+    # reload + freshness observables, populated and mirrored
+    assert s["engine_reloads"] > 0
+    assert s["engine_center_generation"] is not None
+    assert s["freshness_p50_s"] is not None
+    assert s["freshness_p99_s"] >= s["freshness_p50_s"]
+    assert s["freshness_rows"] > 0
+    assert trainer.stream_stats["freshness_p50_s"] == s["freshness_p50_s"]
+    eng = dep.engine
+    assert eng.stats["freshness_p50_s"] == s["freshness_p50_s"]
+    # the served model LEARNED the mapping on the served path
+    assert curve[-1] >= curve[0]
+    assert curve[-1] >= 0.5
+
+
+def test_blue_green_swaps_atomic_attribution():
+    """Three blue/green swaps mid-run: generation tags stay contiguous,
+    every response is attributed to exactly one generation, the old
+    engine drains clean, and g+1 pulled the freshest center."""
+    rng = np.random.default_rng(1)
+    mapping = rng.permutation(V).astype(np.int32)
+    trainer = make_stream_trainer(seed=1)
+    dep = OnlineDeployment(
+        trainer, StreamSource(generator=mapping_chunks(mapping, 3,
+                                                       seed=1)),
+        make_engine(), reload_every=1)
+    records, by_gen, by_gen_horizons = [], {}, []
+
+    def on_horizon(h, fitted):
+        if h in (0, 1, 2):
+            records.append(dep.blue_green_swap())
+        acc, gens = served_accuracy(dep, mapping)
+        assert len(set(gens)) == 1  # one serve batch, one generation
+        by_gen.setdefault(gens[0], 0)
+        by_gen[gens[0]] += len(gens)
+        by_gen_horizons.append(h)
+
+    trainer.on_horizon = on_horizon
+    dep.start()
+    dep.join(timeout=300.0)
+    dep.stop()
+    s = dep.stats()
+    assert len(records) == 3
+    assert all(r["blue_green"] for r in records)
+    assert all(r["old_drained_clean"] for r in records)
+    assert all(r["pulled"] for r in records)  # warmed on the live center
+    # atomic: swap generations are exactly 1, 2, 3 — no gaps, no tears
+    assert [r["generation"] for r in records] == [1, 2, 3]
+    assert s["generation"] == 3
+    # every probe is attributed to exactly one generation, and the stats
+    # snapshot counts the CURRENT engine's share of them (earlier
+    # generations retired their requests before draining)
+    assert sum(by_gen.values()) == len(PROBE) * len(by_gen_horizons)
+    assert s["engine_requests_completed"] == by_gen[s["generation"]]
+    assert s["engine_requests_failed"] == 0
+
+
+def test_serve_resubmits_lost_requests_after_engine_kill():
+    """Requests in flight at an engine kill fail with EngineDead; serve()
+    resubmits them to the swapped-in replacement — zero lost requests."""
+    trainer = make_stream_trainer()
+    eng = make_engine()
+    dep = OnlineDeployment(
+        trainer, StreamSource(generator=iter(())), eng, reload_every=1)
+    # in-flight handles die loudly...
+    h, g = dep.submit(PROBE[2], 1)
+    assert g == 0
+    dep.kill_engine()
+    with pytest.raises(EngineDead):
+        h.result(timeout=1.0)
+    # ...and serve() rides the atomic swap to the replacement
+    clone = eng.respawn_clone()
+    threading.Timer(0.05, lambda: setattr(dep, "engine", clone)).start()
+    rows, gens = dep.serve(list(PROBE[:4]), num_steps=1, retry_wait_s=5.0)
+    assert all(r is not None for r in rows)
+    assert gens == [1, 1, 1, 1]  # all on the replacement's generation
+    assert dep.swaps[-1]["old_dead"] is True
+
+
+def test_serve_raises_when_no_replacement_arrives():
+    dep = OnlineDeployment(make_stream_trainer(),
+                           StreamSource(generator=iter(())),
+                           make_engine(), reload_every=1)
+    dep.kill_engine()
+    with pytest.raises(EngineDead, match="lost|replacement"):
+        dep.serve(list(PROBE[:2]), num_steps=1, retries=1,
+                  retry_wait_s=0.05)
+
+
+def test_kill_ps_shard_requires_recovery():
+    dep = OnlineDeployment(make_stream_trainer(),
+                           StreamSource(generator=iter(())),
+                           make_engine())
+    with pytest.raises(RuntimeError, match="recovery=True"):
+        dep.kill_ps_shard()
+
+
+def test_source_stop_ends_self_sustaining_feedback_loop():
+    """stop() must terminate a SELF-SUSTAINING stream: feedback pending
+    at close is abandoned and the read returns None — otherwise a run
+    whose on_horizon feeds every horizon would never end."""
+    dep = OnlineDeployment(make_stream_trainer(),
+                           StreamSource(generator=iter(())),
+                           make_engine())
+    dep.source.feed(np.zeros((4, L), np.int32), np.zeros((4, L), np.int32))
+    assert dep.source.rows_fed_back == 4
+    dep.source.stop()
+    assert dep.source.read(64) is None  # pending feedback abandoned
+
+
+def test_start_is_one_shot():
+    rng = np.random.default_rng(3)
+    mapping = rng.permutation(V).astype(np.int32)
+    dep = OnlineDeployment(
+        make_stream_trainer(),
+        StreamSource(generator=mapping_chunks(mapping, 1, seed=3)),
+        make_engine())
+    dep.start()
+    assert dep.join(timeout=120.0) is not None
+    with pytest.raises(RuntimeError, match="one-shot"):
+        dep.start()
+    dep.stop()
+
+
+# ---------------------------------------------------------------------------
+# the chaos soak: every seam killed in one run (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_online_chaos_soak_every_seam():
+    """One run, every seam: a worker exits mid-horizon (lease re-lease), a
+    PS shard is crash-stopped and respawned same-address (journal), the
+    engine is declared dead and supervised back (atomic swap), and a
+    blue/green swap lands — zero lost examples, zero lost requests, and
+    the served model still learns."""
+    rng = np.random.default_rng(2)
+    mapping = rng.permutation(V).astype(np.int32)
+    trainer = make_stream_trainer(
+        seed=2, recovery=True,
+        fault_injection={1: ("exit", 2)})
+    dep = OnlineDeployment(
+        trainer, StreamSource(generator=mapping_chunks(mapping, 4,
+                                                       seed=2)),
+        make_engine(), reload_every=1, supervise=True,
+        supervisor_kw={"heartbeat_interval": 0.05,
+                       "liveness_deadline": 15.0})
+    curve = []
+
+    def on_horizon(h, fitted):
+        if h == 1:
+            dep.kill_engine()          # EngineSupervisor swaps a clone in
+        if h == 2:
+            dep.kill_ps_shard(0)       # ShardSupervisor same-addr respawn
+        if h == 3:
+            dep.blue_green_swap()
+        acc, gens = served_accuracy(dep, mapping)
+        assert all(g is not None for g in gens)
+        curve.append(acc)
+        if h < 4:
+            fx = np.repeat(PROBE, L, axis=1)
+            dep.feed(fx, mapping[fx])
+
+    trainer.on_horizon = on_horizon
+    dep.start()
+    dep.join(timeout=300.0)
+    dep.stop()
+    s = dep.stats()
+    assert s["stream_stats"]["rows"] == 4 * 128 + s["rows_fed_back"]
+    assert s["elastic_stats"]["respawns"] >= 1        # the worker seam
+    assert any(r["restarted"]
+               for r in s["engine_recoveries"])       # the engine seam
+    assert trainer._ps_supervisor.restarts  # the PS seam
+    assert [r["generation"] for r in s["swaps"]] == \
+        list(range(1, len(s["swaps"]) + 1))           # atomic swaps
+    assert any(r.get("blue_green") for r in s["swaps"])
+    assert s["engine_reloads"] > 0
+    assert s["freshness_p50_s"] is not None
+    assert curve[-1] >= curve[0]
