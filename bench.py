@@ -647,6 +647,14 @@ def serving_bench(budget_s: float = 90.0):
     int8 KV pool sustains inside the full-precision pool's HBM budget
     (>= 1.5× ``num_slots`` is the acceptance bar).
 
+    Disaggregation observables (PR 16): a bimodal long-prompt +
+    decode-heavy trace through a unified paged engine vs a ``DisaggPair``
+    (prefill-role engine shipping KV blocks to a decode-role engine):
+    ``serving_unified_decode_p99_ms`` vs ``serving_disagg_decode_p99_ms``
+    (per-token decode latency p99 of the decode-heavy requests — the
+    interference disaggregation eliminates) and
+    ``serving_kv_transfer_bytes`` (byte-accounted shipped blocks).
+
     Paged KV + prefix sharing observables (PR 12): one shared-prefix
     trace (8 users over a single 128-token prefix, steady state — the
     prefix is warmed once first) through the paged pool AND the PR 9
@@ -681,7 +689,10 @@ def serving_bench(budget_s: float = 90.0):
             "serving_prefix_hit_rate": None,
             "serving_prefix_prefill_tokens_per_sec": None,
             "serving_prefix_prefill_dense_tokens_per_sec": None,
-            "serving_paged_capacity_slots": None}
+            "serving_paged_capacity_slots": None,
+            "serving_unified_decode_p99_ms": None,
+            "serving_disagg_decode_p99_ms": None,
+            "serving_kv_transfer_bytes": None}
     if budget_s < 5.0:  # not enough budget to even warm the engine up
         return none
     t0 = time.perf_counter()
@@ -829,6 +840,48 @@ def serving_bench(budget_s: float = 90.0):
     finally:
         engine.stop()
     out["serving_shed_rate"] = flood["shed_rate"]
+    if time.perf_counter() - t0 > budget_s * 0.9:
+        return out
+    # disaggregation leg (PR 16): the DistServe/Splitwise interference
+    # scenario — a bimodal trace (long-prompt prefill-heavy bursts mixed
+    # into short-prompt decode-heavy requests) through a unified paged
+    # engine and through a DisaggPair with the same knobs.  The
+    # observable is per-token DECODE latency p99 of the decode-heavy
+    # requests only ((latency - ttft) / (tokens - 1): prefill and
+    # queueing excluded by construction) — on the unified engine the
+    # long prefills stall the token loop; the pair's decode engine never
+    # runs a prefill.  serving_kv_transfer_bytes byte-accounts the
+    # shipped blocks (the transfer-discipline counter family)
+    dg_trace = loadgen.make_trace(16, num_steps=12, seed=3,
+                                  prompt_lengths=(4, 24),
+                                  pattern="bimodal", long_fraction=0.3)
+    short_len = 4
+
+    def _decode_p99(eng) -> object:
+        eng.warmup()  # measure scheduling interference, not jit compiles
+        eng.start()
+        try:
+            hs = [(req, eng.submit(**req)) for req in dg_trace]
+            per_tok = []
+            for req, h in hs:
+                if not h.wait(timeout=budget_s):
+                    raise TimeoutError(f"request {h.id} incomplete")
+                if (len(req["prompt"]) == short_len
+                        and h.finish in ("eos", "length")
+                        and len(h.tokens) >= 2 and h.ttft_s is not None):
+                    per_tok.append((h.latency_s - h.ttft_s)
+                                   / (len(h.tokens) - 1))
+            return loadgen._percentile_ms(per_tok, 99)
+        finally:
+            eng.stop()
+
+    _, uni_eng = loadgen.build_engine(num_slots=4, max_len=40, paged=True)
+    out["serving_unified_decode_p99_ms"] = _decode_p99(uni_eng)
+    _, pair = loadgen.build_engine(num_slots=4, max_len=40,
+                                   disaggregate=True, prefill_engines=1)
+    out["serving_disagg_decode_p99_ms"] = _decode_p99(pair)
+    out["serving_kv_transfer_bytes"] = int(
+        pair.stats["kv_block_bytes_shipped"])
     return out
 
 
@@ -1127,7 +1180,10 @@ def main():
                       "serving_prefix_hit_rate": None,
                       "serving_prefix_prefill_tokens_per_sec": None,
                       "serving_prefix_prefill_dense_tokens_per_sec": None,
-                      "serving_paged_capacity_slots": None}
+                      "serving_paged_capacity_slots": None,
+                      "serving_unified_decode_p99_ms": None,
+                      "serving_disagg_decode_p99_ms": None,
+                      "serving_kv_transfer_bytes": None}
     serving_remaining = budget - (time.perf_counter() - t_start)
     if serving_remaining > 45:
         try:

@@ -246,6 +246,31 @@ def _kv_read(cache, dtype):
     return cache["k"], cache["v"]
 
 
+def gather_blocks(caches, rows):
+    """Pull the arena slots named by ``rows`` (a flat (n,) int32 vector of
+    PHYSICAL slot indices — block table rows expanded by ``block_size``)
+    out of a flat paged arena (``init_paged_arena``): per TransformerBlock
+    a dict of ``(n, Hkv, Dh)`` payloads (int8 arenas also gather their
+    ``(n, Hkv)`` scales).  The prefill half of a disaggregated transfer —
+    read-only, so gathering a radix-shared prefix block is safe.  Shape is
+    static in ``rows.shape``: callers pad ``rows`` with null-block slots
+    to a fixed length to keep one trace."""
+    return [None if c is None else
+            {k: jnp.take(v, rows, axis=0) for k, v in c.items()}
+            for c in caches]
+
+
+def scatter_blocks(caches, rows, payload):
+    """The decode half: write ``payload`` (the ``gather_blocks`` layout)
+    into this arena's slots ``rows`` — the receiver's OWN physical slots
+    for the shipped logical blocks.  Junk rows in a fixed-shape transfer
+    are padded to the null block on the caller's side, where the write is
+    harmless by the arena contract."""
+    return [c if c is None else
+            {k: v.at[rows].set(payload[i][k]) for k, v in c.items()}
+            for i, c in enumerate(caches)]
+
+
 def _per_row(pos) -> bool:
     """True when ``pos`` is a (B,) per-row position vector (the serving
     engine's slot pool) rather than the scalar all-rows-share-one-position

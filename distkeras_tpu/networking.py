@@ -245,6 +245,116 @@ class RowSparseDelta:
             self.values[lo:hi], stop - start)
 
 
+class KVBlocks:
+    """ONE request's paged-KV blocks in flight between a prefill engine and
+    a decode engine (disaggregated serving, ``SERVING_OP_KVBLOCKS``).
+
+    ``layers`` mirrors the model's layer list: ``None`` for layers without
+    a KV cache, else a dict of flat arena slices in LOGICAL block order —
+    ``{"k", "v"}`` of shape ``(num_blocks * block_size, Hkv, Dh)`` (plus
+    ``{"ks", "vs"}`` per-entry scales of shape ``(num_blocks * block_size,
+    Hkv)`` when the arena is int8-quantized, PR 11).  Logical order
+    replaces the sender's block table on the wire: the receiver allocates
+    its OWN physical blocks (``_PagedKVPool.admit``) and scatters row i of
+    the payload into its i-th block — physical ids never cross engines.
+    ``positions`` is the number of valid prompt tokens written (the decode
+    engine resumes at this position) and ``key`` the request's RNG key
+    data (uint32), so sampling folds identically on both engines.
+
+    Like :class:`RowSparseDelta` this is a dedicated payload node
+    (``__kvb__``): the codecs frame buffers, the tree layer interprets
+    them — the native codec needs no change.  ``validate()`` is the
+    transport-boundary contract: a hostile/torn frame raises
+    :class:`ProtocolError` BEFORE the receiving pool allocates or any
+    arena write happens.
+    """
+
+    __slots__ = ("layers", "block_size", "num_blocks", "positions", "key")
+
+    def __init__(self, layers, block_size: int, num_blocks: int,
+                 positions: int, key):
+        self.layers = list(layers)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.positions = int(positions)
+        self.key = np.asarray(key)
+
+    @property
+    def nbytes(self) -> int:
+        """Payload bytes shipped (the bench's transfer accounting)."""
+        return sum(a.nbytes for c in self.layers if c is not None
+                   for a in c.values())
+
+    def validate(self) -> "KVBlocks":
+        """The wire contract: raises :class:`ProtocolError` unless every
+        layer's arrays agree with the declared block geometry — the
+        receiver rejects the frame at the transport boundary instead of
+        scattering a lie into its arena."""
+        if self.block_size < 1 or self.num_blocks < 1:
+            raise ProtocolError(
+                f"kv-block transfer declares block_size={self.block_size}, "
+                f"num_blocks={self.num_blocks}")
+        rows = self.num_blocks * self.block_size
+        if not (0 < self.positions <= rows):
+            raise ProtocolError(
+                f"kv-block transfer positions={self.positions} outside "
+                f"(0, {rows}]")
+        if (not np.issubdtype(self.key.dtype, np.unsignedinteger)
+                or self.key.size == 0 or self.key.size > 4):
+            raise ProtocolError(
+                f"kv-block transfer RNG key must be a small unsigned "
+                f"array, got dtype={self.key.dtype} size={self.key.size}")
+        if not any(c is not None for c in self.layers):
+            raise ProtocolError("kv-block transfer carries no KV layers")
+        for i, c in enumerate(self.layers):
+            if c is None:
+                continue
+            if not isinstance(c, dict) or "k" not in c or "v" not in c:
+                raise ProtocolError(
+                    f"kv-block transfer layer {i} missing k/v payloads")
+            extra = set(c) - {"k", "v", "ks", "vs"}
+            if extra:
+                raise ProtocolError(
+                    f"kv-block transfer layer {i} carries unknown "
+                    f"payloads {sorted(extra)}")
+            k, v = c["k"], c["v"]
+            if k.ndim != 3 or k.shape != v.shape or k.dtype != v.dtype:
+                raise ProtocolError(
+                    f"kv-block transfer layer {i} k/v disagree: "
+                    f"{k.shape}/{k.dtype} vs {v.shape}/{v.dtype}")
+            if k.shape[0] != rows:
+                raise ProtocolError(
+                    f"kv-block transfer layer {i} carries {k.shape[0]} "
+                    f"arena rows, geometry declares {rows}")
+            if ("ks" in c) != ("vs" in c):
+                raise ProtocolError(
+                    f"kv-block transfer layer {i} ships one of ks/vs "
+                    "without the other")
+            if "ks" in c:
+                if k.dtype != np.int8:
+                    raise ProtocolError(
+                        f"kv-block transfer layer {i} ships scales for "
+                        f"non-int8 codes ({k.dtype})")
+                for s in ("ks", "vs"):
+                    if c[s].shape != k.shape[:2]:
+                        raise ProtocolError(
+                            f"kv-block transfer layer {i} {s} shape "
+                            f"{c[s].shape} != {k.shape[:2]}")
+        return self
+
+    def decoded(self) -> "KVBlocks":
+        """A defensive copy with owned buffers — pooled receives hand out
+        VIEWS into a reusable recv buffer (the :class:`RowSparseDelta`
+        precedent), so anything queued past the next ``recv_data`` must
+        copy first."""
+        return KVBlocks(
+            [None if c is None
+             else {k: np.array(v, copy=True) for k, v in c.items()}
+             for c in self.layers],
+            self.block_size, self.num_blocks, self.positions,
+            np.array(self.key, copy=True))
+
+
 def _dtype_str(dt: np.dtype) -> str:
     """Wire name for a dtype.  ml_dtypes types (bfloat16 & friends) print as
     opaque void strs ('<V2'), so ship their registered *name* instead."""
@@ -273,6 +383,16 @@ def _encode_node(obj: Any, buffers: List[np.ndarray]):
             "r": _encode_node(np.ascontiguousarray(obj.rows), buffers),
             "v": _encode_node(np.ascontiguousarray(obj.values), buffers),
             "n": int(obj.num_rows)}}
+    if isinstance(obj, KVBlocks):
+        return {"__kvb__": {
+            "p": int(obj.block_size),
+            "n": int(obj.num_blocks),
+            "q": int(obj.positions),
+            "k": _encode_node(np.ascontiguousarray(obj.key), buffers),
+            "L": [None if c is None else
+                  {k: _encode_node(np.ascontiguousarray(c[k]), buffers)
+                   for k in sorted(c)}
+                  for c in obj.layers]}}
     if isinstance(obj, np.ndarray):
         idx = len(buffers)
         buffers.append(np.ascontiguousarray(obj))
@@ -313,6 +433,15 @@ def _decode_node(node: Any, buffers: List[bytes], copy: bool = True):
             return RowSparseDelta(_decode_node(rsp["r"], buffers, copy),
                                   _decode_node(rsp["v"], buffers, copy),
                                   int(rsp["n"]))
+        if "__kvb__" in node:
+            kvb = node["__kvb__"]
+            layers = [None if c is None else
+                      {k: _decode_node(v, buffers, copy)
+                       for k, v in c.items()}
+                      for c in kvb["L"]]
+            return KVBlocks(layers, int(kvb["p"]), int(kvb["n"]),
+                            int(kvb["q"]),
+                            _decode_node(kvb["k"], buffers, copy))
         if "__dict__" in node:
             return {k: _decode_node(v, buffers, copy)
                     for k, v in node["__dict__"].items()}
@@ -387,6 +516,12 @@ def _expected_buffer_sizes(tree: Any, out: dict):
         elif "__rsp__" in tree:
             _expected_buffer_sizes(tree["__rsp__"]["r"], out)
             _expected_buffer_sizes(tree["__rsp__"]["v"], out)
+        elif "__kvb__" in tree:
+            _expected_buffer_sizes(tree["__kvb__"]["k"], out)
+            for c in tree["__kvb__"]["L"]:
+                if c is not None:
+                    for v in c.values():
+                        _expected_buffer_sizes(v, out)
         elif "__dict__" in tree:
             for v in tree["__dict__"].values():
                 _expected_buffer_sizes(v, out)
@@ -910,6 +1045,13 @@ class FrameParser:
 SERVING_OP_ENQUEUE = b"q"
 SERVING_OP_STREAM = b"r"
 SERVING_OP_CANCEL = b"x"
+#: ``'k'`` kv-block transfer (disaggregated serving): a prefill engine —
+#: or a ``DisaggPair`` router on its behalf — ships one request's filled
+#: paged-KV blocks (a ``KVBlocks`` node + the request metadata) to a
+#: decode-role engine, which admits it straight into the token loop; the
+#: server acks ``{"ok", "id"}`` exactly like an enqueue and the reply
+#: stream rides the ordinary ``'r'`` opcode.
+SERVING_OP_KVBLOCKS = b"k"
 
 #: PS-protocol opcodes (``parameter_servers.*SocketParameterServer`` —
 #: reference protocol ``'p'`` pull / ``'c'`` commit, plus ``'u'`` update
@@ -1012,8 +1154,10 @@ class ChaosProxy:
 
     ``protocol="serving"`` relays the serving wire
     (``serving.ServingServer``): every client opcode (``'q'`` enqueue,
-    ``'r'`` stream, ``'x'`` cancel) carries a request frame; ``'q'``/``'x'``
-    get one reply frame, ``'r'`` a STREAM of chunk frames relayed
+    ``'r'`` stream, ``'x'`` cancel, ``'k'`` kv-block transfer) carries a
+    request frame; ``'q'``/``'x'``/``'k'`` get one reply frame (so
+    tear/delay/reset scripts compose with a mid-transfer block frame
+    exactly as with an enqueue), ``'r'`` a STREAM of chunk frames relayed
     full-duplex (a mid-stream client cancel or EOF still reaches the
     server) until the ``done`` frame — plus the serving-only
     ``"cut_stream"`` action for a deterministic client reset mid-stream.
@@ -1117,9 +1261,10 @@ class ChaosProxy:
         rng = random.Random((self.seed << 20) ^ idx)
         serving = self.protocol == "serving"
         frame_ops = ((SERVING_OP_ENQUEUE, SERVING_OP_STREAM,
-                      SERVING_OP_CANCEL) if serving
+                      SERVING_OP_CANCEL, SERVING_OP_KVBLOCKS) if serving
                      else (PS_OP_COMMIT, PS_OP_UPDATE))
-        reply_ops = ((SERVING_OP_ENQUEUE, SERVING_OP_CANCEL) if serving
+        reply_ops = ((SERVING_OP_ENQUEUE, SERVING_OP_CANCEL,
+                      SERVING_OP_KVBLOCKS) if serving
                      else (PS_OP_PULL, PS_OP_UPDATE, PS_OP_HEARTBEAT))
         op_index = 0
         try:

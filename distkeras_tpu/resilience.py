@@ -599,6 +599,86 @@ class EngineSupervisor:
                     logger.exception("serving engine restart failed")
 
 
+class _PairSlot:
+    """Adapter giving :class:`EngineSupervisor` its ``target.engine``
+    swap seam over ONE engine inside a ``serving.DisaggPair``: the setter
+    routes through ``pair.replace_engine`` so the pair's round-robin /
+    hand-off state tracks the replacement atomically."""
+
+    __slots__ = ("_pair", "_engine")
+
+    def __init__(self, pair, engine):
+        self._pair = pair
+        self._engine = engine
+
+    @property
+    def engine(self):
+        return self._engine
+
+    @engine.setter
+    def engine(self, new):
+        self._pair.replace_engine(self._engine, new)
+        self._engine = new
+
+
+class PairSupervisor:
+    """Supervise every engine of a disaggregated ``serving.DisaggPair`` —
+    one :class:`EngineSupervisor` per prefill engine and (for in-process
+    pairs) the decode engine, each restarting through ``respawn_clone``
+    and swapping the replacement into the pair via ``replace_engine``.
+
+    The division of labor mirrors the pair's failure matrix: a dead
+    prefill engine's in-flight requests re-route THROUGH THE PAIR to the
+    surviving prefill engines while the supervisor restores capacity in
+    the background; a dead decode engine fails its requests with the
+    typed ``EngineDead`` (clients resubmit — all live KV state died with
+    the arena) and the supervisor brings up a fresh decode engine for
+    subsequent traffic."""
+
+    def __init__(self, pair, **supervisor_kw):
+        self.pair = pair
+        self.supervisors: List[EngineSupervisor] = [
+            EngineSupervisor(_PairSlot(pair, e), **supervisor_kw)
+            for e in pair.engines]
+
+    @property
+    def restarts(self) -> int:
+        return sum(s.restarts for s in self.supervisors)
+
+    @property
+    def recoveries(self) -> List[Dict[str, Any]]:
+        return [r for s in self.supervisors for r in s.recoveries]
+
+    def check_all(self) -> List[Optional[str]]:
+        """One synchronous liveness probe per supervised engine (the
+        loop-free form tier-1 tests drive)."""
+        return [s.check() for s in self.supervisors]
+
+    def recover_all(self) -> List[Dict[str, Any]]:
+        """Probe + recover every unhealthy engine once, synchronously."""
+        out = []
+        for s in self.supervisors:
+            reason = s.check()
+            if reason is not None:
+                out.append(s._recover(reason))
+        return out
+
+    def start(self) -> "PairSupervisor":
+        for s in self.supervisors:
+            s.start()
+        return self
+
+    def stop(self) -> None:
+        for s in self.supervisors:
+            s.stop()
+
+    def __enter__(self) -> "PairSupervisor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
 # ---------------------------------------------------------------------------
 # elastic workers: the lease ledger
 # ---------------------------------------------------------------------------

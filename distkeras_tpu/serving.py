@@ -166,7 +166,10 @@ class RequestHandle:
     for normal completion, ``"deadline"`` (per-request deadline expired —
     the partial row is still returned, padded), ``"cancel"`` (explicit
     cancel or client disconnect), ``"drain"`` (drain timeout), ``"error"``
-    (the engine died — ``result()`` raises the stored :class:`EngineDead`).
+    (the engine died — ``result()`` raises the stored :class:`EngineDead`),
+    ``"prefilled"`` (a ``role="prefill"`` engine finished its half: the
+    first token is pushed and ``kvblocks`` holds the request's extracted
+    KV blocks for the decode engine — disaggregated serving's hand-off).
     ``deadline`` is an absolute ``time.perf_counter()`` instant or None.
     """
 
@@ -174,7 +177,7 @@ class RequestHandle:
                  "top_p", "eos_id", "pad_id", "key", "tokens", "finish",
                  "slot", "submitted_at", "started_at", "first_token_at",
                  "finished_at", "deadline", "error", "cancelled_at",
-                 "_cond", "_chunk_read")
+                 "kvblocks", "_cond", "_chunk_read")
 
     def __init__(self, rid: int, prompt: np.ndarray, num_steps: int,
                  temperature: float, top_k: Optional[int],
@@ -201,6 +204,9 @@ class RequestHandle:
                          else self.submitted_at + float(deadline_s))
         self.error: Optional[BaseException] = None
         self.cancelled_at: Optional[float] = None
+        #: networking.KVBlocks on a "prefilled" handle (prefill role's
+        #: extraction output) or on a decode-role ingest before admission
+        self.kvblocks = None
         self._cond = threading.Condition()
         self._chunk_read = 0            # tokens already handed out as chunks
 
@@ -741,7 +747,8 @@ class ServingEngine:
                  quantize: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
                  paged: bool = False, block_size: int = 16,
-                 kv_blocks: Optional[int] = None):
+                 kv_blocks: Optional[int] = None,
+                 role: str = "unified"):
         if isinstance(model, FittedModel):
             self.model, self.params = model.model, model.params
         else:
@@ -749,6 +756,32 @@ class ServingEngine:
         _check_supported(self.model)
         if rolling:
             _validate_rolling(self.model)
+        # -- disaggregation role (default "unified": the engine is exactly
+        #    its pre-disaggregation self).  "prefill": admissions run the
+        #    ordinary paged prefill programs but STOP before the token
+        #    loop — the request retires "prefilled" with its KV blocks
+        #    extracted onto the handle.  "decode": admission comes from
+        #    submit_prefilled (a shipped block set scattered into this
+        #    engine's own arena blocks); plain submit is rejected.
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"role must be 'unified', 'prefill' or "
+                             f"'decode', got {role!r}")
+        if role != "unified":
+            if not paged:
+                raise ValueError(
+                    f"role={role!r} needs the paged block arena "
+                    "(paged=True): block transfer is defined over "
+                    "fixed-size arena blocks")
+            if rolling:
+                raise ValueError(
+                    f"role={role!r} does not compose with rolling pools — "
+                    "ring-laid blocks are not positionally addressable on "
+                    "the receiving side")
+            if spec_draft is not None:
+                raise ValueError(
+                    f"role={role!r} does not compose with spec_draft: the "
+                    "draft arena is engine-private and never shipped")
+        self.role = role
         # -- speculation + quantization knobs (all default OFF: the engine
         #    is bit-identical to its pre-speculation self until asked)
         if prefill_mode == "eager" and (spec_draft is not None
@@ -957,6 +990,15 @@ class ServingEngine:
                 else:
                     self._dev_dbt = None
                 self._copy_fn = self._build_copy_fn()
+                if self.role == "prefill":
+                    # read-only arena gather (the extraction half of a
+                    # disaggregated transfer) — fixed (blocks_per_slot ×
+                    # block_size) row vector, so one trace serves every
+                    # prompt length (junk rows gather the null block and
+                    # are sliced off on host)
+                    self._gather_fn = jax.jit(_dec.gather_blocks)
+                if self.role == "decode":
+                    self._ingest_fn = self._build_ingest_fn()
             self._decode_fn = self._build_device_step_fn()
             self._deact_fn = self._build_deact_fn()
             self._bucket_fns: Dict[int, Any] = {}
@@ -1037,6 +1079,16 @@ class ServingEngine:
             "blocks_allocated": 0, "blocks_reused": 0, "blocks_evicted": 0,
             "prefix_hits": 0, "prefix_hit_tokens": 0, "cow_copies": 0,
             "kv_pool_bytes": _quant.kv_cache_bytes(self.caches),
+            # disaggregation transfer accounting (charged against the
+            # PR 9 transfer-discipline counters — gather fetches and
+            # scatter uploads land in d2h/h2d_transfers too):
+            # kv_blocks_shipped/_bytes count blocks a prefill-role engine
+            # extracted, kv_blocks_ingested/_bytes blocks a decode-role
+            # engine admitted from a shipped set; transfer_ms is one
+            # sample per extraction/ingest (device dispatch + host copy)
+            "kv_blocks_shipped": 0, "kv_block_bytes_shipped": 0,
+            "kv_blocks_ingested": 0, "kv_block_bytes_ingested": 0,
+            "transfer_ms": [],
         }
         if self.paged:
             self._pool = _PagedKVPool(self.kv_blocks, self.block_size,
@@ -1182,6 +1234,36 @@ class ServingEngine:
             return copy_one(caches, src, dst), copy_one(dcaches, src, dst)
 
         return jax.jit(copy_both, donate_argnums=(0, 1))
+
+    def _build_ingest_fn(self):
+        """Decode-role admission program, ONE jitted dispatch per shipped
+        request: scatter the transferred block payload into this engine's
+        own arena slots (``rows`` — junk rows padded to the null block, so
+        the shape is fixed at ``blocks_per_slot × block_size``) and
+        install the slot's device row (block table, current token at the
+        shipped position, sampling params, RNG key) exactly as a bucket
+        prefill program would have.  ``mode="drop"`` on every install
+        lets warmup target slot ``num_slots``."""
+        def ingest(caches, bt, tok, pos, act, temp, topk, topp, keys,
+                   rows, payload, slot, row_bt, r_tok, r_pos, r_temp,
+                   r_topk, r_topp, r_keys):
+            caches = _dec.scatter_blocks(caches, rows, payload)
+            bt = bt.at[slot].set(row_bt, mode="drop")
+            tok = tok.at[slot].set(r_tok, mode="drop")
+            pos = pos.at[slot].set(r_pos, mode="drop")
+            act = act.at[slot].set(True, mode="drop")
+            temp = temp.at[slot].set(r_temp, mode="drop")
+            topk = topk.at[slot].set(r_topk, mode="drop")
+            topp = topp.at[slot].set(r_topp, mode="drop")
+            keys = keys.at[slot].set(r_keys, mode="drop")
+            return caches, bt, tok, pos, act, temp, topk, topp, keys
+
+        # tok (argnum 2) is NOT donated: with one-step lookahead the live
+        # ``_dev_tok`` IS the previous decode step's still-pending output
+        # array — donating it would delete the buffer ``_drain_pending``
+        # has yet to fetch (the same reason no decode/prefill program
+        # donates its token state)
+        return jax.jit(ingest, donate_argnums=(0, 1, 3, 4, 5, 6, 7, 8))
 
     def _build_spec_fn(self):
         """The speculative decode round — ONE jitted program replacing the
@@ -1801,6 +1883,11 @@ class ServingEngine:
         while ``drain`` is in progress and :class:`EngineDead` on a dead
         engine.
         """
+        if self.role == "decode":
+            raise ValueError(
+                "role='decode' engines admit only shipped block sets "
+                "(submit_prefilled) — route plain submissions to the "
+                "prefill engine or a DisaggPair")
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1:
             raise ValueError(f"prompt must be 1-D tokens, got shape "
@@ -1854,6 +1941,125 @@ class ServingEngine:
                     self.stats["requests_rejected"] += 1
                     raise Draining("serving engine is draining; admission "
                                    "stopped")
+            self._queue.append(handle)
+            self.stats["queue_peak"] = max(self.stats["queue_peak"],
+                                           len(self._queue))
+            self._have_work.notify()
+        return handle
+
+    def submit_prefilled(self, blocks, prompt, first_token: int,
+                         num_steps: int, temperature: float = 0.0,
+                         top_k: Optional[int] = None,
+                         top_p: Optional[float] = None,
+                         eos_id: Optional[int] = None,
+                         pad_id: Optional[int] = None,
+                         block: bool = True, timeout: Optional[float] = None,
+                         deadline_s: Optional[float] = None
+                         ) -> RequestHandle:
+        """Decode-role admission: enqueue a request whose prefill already
+        ran elsewhere.  ``blocks`` is the shipped
+        :class:`networking.KVBlocks` (prompt KV in logical block order +
+        the request's RNG key), ``first_token`` the token the prefill
+        engine sampled at the prompt boundary — pushed into the handle
+        immediately, so the client-visible stream is unchanged.
+        ``num_steps`` counts TOTAL generated tokens, the shipped first one
+        included (the unified-engine contract).  The scheduler scatters
+        the payload into this engine's OWN arena blocks
+        (``_PagedKVPool.admit`` plain allocation — physical ids never
+        cross engines) and the slot enters the token loop at the shipped
+        position.  Geometry lies (wrong arena shape/dtype for this model)
+        raise ``ValueError``; torn/hostile payloads should be rejected by
+        ``blocks.validate()`` at the transport boundary BEFORE this call.
+        Backpressure/death semantics mirror :meth:`submit` exactly."""
+        if self.role != "decode":
+            raise ValueError("submit_prefilled needs role='decode' — "
+                             f"this engine is role={self.role!r}")
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or len(prompt) < 1:
+            raise ValueError(f"prompt must be 1-D tokens (>= 1), got "
+                             f"shape {prompt.shape}")
+        if num_steps < 1:
+            raise ValueError(f"num_steps must be >= 1 (it counts the "
+                             f"shipped first token), got {num_steps}")
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        elif deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        kvb = blocks
+        if kvb.block_size != self.block_size:
+            raise ValueError(
+                f"shipped blocks are {kvb.block_size}-token, this arena "
+                f"pages {self.block_size}-token blocks")
+        if kvb.positions != len(prompt):
+            raise ValueError(
+                f"shipped positions ({kvb.positions}) disagree with the "
+                f"prompt length ({len(prompt)})")
+        total = len(prompt) + int(num_steps)
+        if total > self.max_len:
+            raise ValueError(f"prompt ({len(prompt)}) + num_steps "
+                             f"({num_steps}) = {total} exceeds the engine's "
+                             f"max_len {self.max_len}")
+        if len(kvb.layers) != len(self.caches):
+            raise ValueError(
+                f"shipped payload spans {len(kvb.layers)} layers, this "
+                f"model has {len(self.caches)}")
+        for i, (c, mine) in enumerate(zip(kvb.layers, self.caches)):
+            if (c is None) != (mine is None):
+                raise ValueError(f"layer {i} cache presence disagrees "
+                                 "with this model")
+            if c is None:
+                continue
+            if ("ks" in c) != ("ks" in mine):
+                raise ValueError(
+                    f"layer {i} quantization disagrees: shipped "
+                    f"{'int8' if 'ks' in c else 'dense'} KV, this arena is "
+                    f"{'int8' if 'ks' in mine else 'dense'}")
+            if c["k"].shape[1:] != mine["k"].shape[1:] \
+                    or c["k"].dtype != mine["k"].dtype:
+                raise ValueError(
+                    f"layer {i} shipped rows are {c['k'].shape[1:]} "
+                    f"{c['k'].dtype}, this arena holds "
+                    f"{mine['k'].shape[1:]} {mine['k'].dtype}")
+        _validate_stopping(eos_id, pad_id, self._vocab)
+        key = np.asarray(kvb.key, np.uint32)
+        with self._qlock:
+            if self._dead is not None:
+                raise EngineDead(str(self._dead)) from self._dead
+            if self._draining:
+                raise Draining("serving engine is draining; admission "
+                               "stopped")
+            self._next_id += 1
+            handle = RequestHandle(self._next_id, prompt, num_steps,
+                                   temperature, top_k, top_p, eos_id,
+                                   pad_id, key, deadline_s=deadline_s)
+            handle.kvblocks = kvb
+            self.stats["requests_submitted"] += 1
+            # the shipped first token IS this request's first generated
+            # token: push it now (TTFT on this engine is the hand-off
+            # instant) and complete in place when it already terminates
+            handle._push(int(first_token))
+            if (eos_id is not None and int(first_token) == int(eos_id)) \
+                    or num_steps == 1:
+                reason = ("eos" if eos_id is not None
+                          and int(first_token) == int(eos_id) else "length")
+                handle._finish(reason)
+                self.stats["requests_completed"] += 1
+                self.stats["tokens_generated"] += 1
+                return handle
+            while len(self._queue) >= self.queue_capacity:
+                if not block or not self._not_full.wait(timeout=timeout):
+                    self.stats["requests_rejected"] += 1
+                    raise QueueFull(
+                        f"admission queue at capacity "
+                        f"({self.queue_capacity}); request {handle.id} shed")
+                if self._dead is not None:
+                    self.stats["requests_rejected"] += 1
+                    raise EngineDead(str(self._dead)) from self._dead
+                if self._draining:
+                    self.stats["requests_rejected"] += 1
+                    raise Draining("serving engine is draining; admission "
+                                   "stopped")
+            self.stats["tokens_generated"] += 1
             self._queue.append(handle)
             self.stats["queue_peak"] = max(self.stats["queue_peak"],
                                            len(self._queue))
@@ -2052,6 +2258,23 @@ class ServingEngine:
         budget = self.prefills_per_step
         if self.paged:
             self._pool.next_epoch()
+        if self.role == "decode":
+            # disaggregated ingest replaces prefill entirely: each queued
+            # handle carries a shipped block set; admission is one plain
+            # block allocation + one jitted scatter/install dispatch.
+            # Block exhaustion requeues at the FRONT and stops, exactly
+            # like the paged prefill path (FIFO fairness contract).
+            while budget > 0 and self._free:
+                h = self._pop_queued()
+                if h is None:
+                    break
+                if not self._ingest(h):
+                    with self._qlock:
+                        self._queue.appendleft(h)
+                    break
+                budget -= 1
+                did = True
+            return did
         for slot in list(self._prefilling):
             if budget <= 0:
                 break
@@ -2093,7 +2316,11 @@ class ServingEngine:
     def _admit_blocks(self, h: RequestHandle) -> Optional[_BlockPlan]:
         """Reserve a request's block chain (trie walk + allocation) and
         dispatch its copy-on-write block copy, if any."""
-        total = len(h.prompt) + h.num_steps
+        # a prefill-role engine writes ONLY the prompt's KV (the first
+        # sampled token's write happens on the decode engine at position
+        # p_len), so its chain stops at ceil(p_len / bs)
+        total = len(h.prompt) + (0 if self.role == "prefill"
+                                 else h.num_steps)
         if self.rolling:
             plan = self._pool.admit(None, self._blocks_per_slot)
         else:
@@ -2124,6 +2351,66 @@ class ServingEngine:
         n = min(len(plan.blocks), self._d_tbl - 1)
         dbt[:n] = plan.blocks[:n]
         return bt, dbt
+
+    def _ingest(self, h: RequestHandle) -> bool:
+        """Admit ONE shipped block set (decode role): allocate this
+        engine's own private chain (``admit(None, ...)`` — no trie, so
+        release is a plain refund and the zero-leak contract is the
+        standard retirement path), scatter the payload into those blocks,
+        and install the slot's device row at the shipped position.
+        Returns False when blocks are unavailable (the caller requeues at
+        the front and waits for retirements)."""
+        kvb = h.kvblocks
+        bs = self.block_size
+        total = len(h.prompt) + h.num_steps
+        plan = self._pool.admit(None, -(-total // bs))
+        if plan is None:
+            return False
+        t0 = time.perf_counter()
+        slot = self._free.pop()
+        h.slot = slot
+        h.started_at = t0
+        self._handles[slot] = h
+        self._plans[slot] = plan
+        self.stats["slot_requests"][slot] += 1
+        n_src = kvb.num_blocks
+        rows = np.full((self._blocks_per_slot,), self.kv_blocks, np.int32)
+        rows[:n_src] = plan.blocks[:n_src]
+        phys = (rows[:, None] * bs
+                + np.arange(bs, dtype=np.int32)[None, :]).reshape(-1)
+        pad = (self._blocks_per_slot - n_src) * bs
+        payload = []
+        for c in kvb.layers:
+            if c is None:
+                payload.append(None)
+                continue
+            payload.append({
+                k: self._put(np.concatenate(
+                    [np.asarray(v),
+                     np.zeros((pad,) + v.shape[1:], v.dtype)])
+                    if pad else np.ascontiguousarray(v))
+                for k, v in c.items()})
+        bt, _ = self._row_tables(plan)
+        (self.caches, self._dev_bt, self._dev_tok, self._dev_pos,
+         self._dev_act, self._dev_temp, self._dev_topk, self._dev_topp,
+         self._dev_keys) = self._ingest_fn(
+            self.caches, self._dev_bt, self._dev_tok, self._dev_pos,
+            self._dev_act, self._dev_temp, self._dev_topk,
+            self._dev_topp, self._dev_keys,
+            self._put(phys), payload, self._put(np.int32(slot)),
+            self._put(bt), self._put(np.int32(h.tokens[0])),
+            self._put(np.int32(len(h.prompt))),
+            self._put(np.float32(h.temperature)),
+            self._put(np.int32(0 if h.top_k is None else h.top_k)),
+            self._put(np.float32(0.0 if h.top_p is None else h.top_p)),
+            self._put(np.asarray(h.key, np.uint32)))
+        self._mirror_admit(slot, h)
+        self._cur_tok[slot] = h.tokens[0]
+        self.stats["kv_blocks_ingested"] += n_src
+        self.stats["kv_block_bytes_ingested"] += kvb.nbytes
+        self.stats["transfer_ms"].append(
+            (time.perf_counter() - t0) * 1000.0)
+        return True
 
     def _batch_prefill(self, batch: List[RequestHandle],
                        plans: Optional[Dict[int, _BlockPlan]] = None
@@ -2341,6 +2628,40 @@ class ServingEngine:
         self._topp[slot] = 0.0 if h.top_p is None else float(h.top_p)
         self._keys[slot] = np.asarray(h.key, np.uint32)
 
+    def _finish_prefilled(self, slot: int, token: int) -> None:
+        """Prefill role's hand-off: the drained first token means this
+        request's prompt KV is fully written, so gather its blocks out of
+        the arena (read-only — shared prefix blocks gather safely), hang
+        a :class:`networking.KVBlocks` on the handle, push the token, and
+        retire ``"prefilled"`` through the STANDARD path — blocks release
+        via ``_release_blocks`` exactly like any retirement, so the
+        zero-leak contract holds without a special case."""
+        h = self._handles[slot]
+        t0 = time.perf_counter()
+        plan = self._plans[slot]
+        bs = self.block_size
+        p_len = len(h.prompt)
+        n_src = -(-p_len // bs)
+        rows = np.full((self._blocks_per_slot,), self.kv_blocks, np.int32)
+        rows[:n_src] = plan.blocks[:n_src]
+        phys = (rows[:, None] * bs
+                + np.arange(bs, dtype=np.int32)[None, :]).reshape(-1)
+        dev = self._gather_fn(self.caches, self._put(phys))
+        keep = n_src * bs
+        layers = [None if c is None else
+                  {k: np.ascontiguousarray(self._fetch(v)[:keep])
+                   for k, v in c.items()}
+                  for c in dev]
+        h.kvblocks = networking.KVBlocks(
+            layers, bs, n_src, p_len, np.asarray(h.key, np.uint32))
+        h._push(token)
+        self.stats["tokens_generated"] += 1
+        self.stats["kv_blocks_shipped"] += n_src
+        self.stats["kv_block_bytes_shipped"] += h.kvblocks.nbytes
+        self.stats["transfer_ms"].append(
+            (time.perf_counter() - t0) * 1000.0)
+        self._retire(slot, "prefilled")
+
     # ---------------------------------------------------------- retirement
     def _emit(self, slot: int, token: int) -> None:
         """Record one produced token for the request in ``slot``; retire on
@@ -2408,6 +2729,14 @@ class ServingEngine:
         steps_before = self.stats["decode_steps"]
         did = self._reap()
         did = self._schedule_prefills() or did
+        if self.role == "prefill":
+            # no token loop at all: drain every dispatched prefill NOW
+            # (the drained first token triggers extraction + hand-off —
+            # with decode gated off, nothing else would ever push a
+            # lookahead entry out of the pipeline)
+            if self._pending:
+                did = self._drain_pending(flush=True) or did
+            return did
         if self._active.any():
             self._decode_once()
             did = True
@@ -2496,7 +2825,10 @@ class ServingEngine:
                 if kind == "decode":
                     self._positions[slot] += 1
                 self._cur_tok[slot] = token
-                self._emit(slot, token)
+                if self.role == "prefill":
+                    self._finish_prefilled(slot, token)
+                else:
+                    self._emit(slot, token)
             did = True
         return did
 
@@ -2690,7 +3022,7 @@ class ServingEngine:
             # FRESH trie + allocator — cached prefix chains belong to the
             # dead pool's arena contents, which the clone does not share
             paged=self.paged, block_size=self.block_size,
-            kv_blocks=self.kv_blocks)
+            kv_blocks=self.kv_blocks, role=self.role)
         # quantized clones re-quantize idempotently; the f32 skeleton the
         # hot-reload path maps pulled weights onto carries over as-is
         # (the clone's params are already quantized, so it could not
@@ -2762,7 +3094,15 @@ class ServingEngine:
         # round — draft steps + verify + back-fill — when a draft is
         # attached: a respawn under live traffic must pay zero jit on its
         # first real round)...
-        if self._draft_model is not None:
+        if self.role == "prefill":
+            # the token loop never runs on a prefill-role engine: skip
+            # the decode-step warmup and warm the extraction gather
+            # instead (all-null rows read the null block)
+            rows = jnp.full((self._blocks_per_slot * self.block_size,),
+                            self.kv_blocks * self.block_size, jnp.int32)
+            jax.block_until_ready(jax.tree_util.tree_leaves(
+                self._gather_fn(self.caches, rows))[0])
+        elif self._draft_model is not None:
             (_, self.caches, self.d_caches, self._dev_tok,
              self._dev_pos) = self._spec_fn(
                 self.params, self._draft_params, *self._state_args())
@@ -2772,6 +3112,32 @@ class ServingEngine:
                 self.params, *self._state_args())
             self._dev_tok = out
             jax.block_until_ready(out)
+        if self.role == "decode":
+            # ingest program only: the bucket/chunk prefill programs are
+            # never dispatched on a decode-role engine (admission is
+            # submit_prefilled), so warming them would compile dead code.
+            # Slot num_slots + mode="drop" installs nothing; the scatter
+            # lands in the null block.
+            n = self._blocks_per_slot * self.block_size
+            rows = jnp.full((n,), self.kv_blocks * self.block_size,
+                            jnp.int32)
+            payload = [None if c is None else
+                       {k: jnp.zeros((n,) + v.shape[1:], v.dtype)
+                        for k, v in c.items()}
+                       for c in self.caches]
+            (self.caches, self._dev_bt, self._dev_tok, self._dev_pos,
+             self._dev_act, self._dev_temp, self._dev_topk,
+             self._dev_topp, self._dev_keys) = self._ingest_fn(
+                self.caches, self._dev_bt, self._dev_tok, self._dev_pos,
+                self._dev_act, self._dev_temp, self._dev_topk,
+                self._dev_topp, self._dev_keys, rows, payload,
+                jnp.int32(self.num_slots),
+                jnp.full((self._t_tbl,), self.kv_blocks, jnp.int32),
+                jnp.int32(0), jnp.int32(0), jnp.float32(0.0),
+                jnp.int32(0), jnp.float32(0.0),
+                jnp.zeros((2,), jnp.uint32))
+            jax.block_until_ready(jax.tree_util.tree_leaves(self.caches)[0])
+            return self
         # ...every bucket's batched prefill program (all rows dropped;
         # quantized pools and draft-pool prefill compile here too — the
         # commit/quantize paths live inside these same programs; paged
@@ -2985,6 +3351,7 @@ class ServingEngine:
 OP_ENQUEUE = networking.SERVING_OP_ENQUEUE
 OP_STREAM = networking.SERVING_OP_STREAM
 OP_CANCEL = networking.SERVING_OP_CANCEL
+OP_KVBLOCKS = networking.SERVING_OP_KVBLOCKS
 
 
 class ServingServer:
@@ -3184,6 +3551,59 @@ class ServingServer:
                         self._owner[h.id] = conn
                     networking.send_data(conn, {"ok": True, "id": h.id},
                                          pool=send_pool)
+                elif op == OP_KVBLOCKS:
+                    # disaggregated hand-off: a prefill engine (via
+                    # DisaggPair) ships a request's filled KV blocks.
+                    # validate() runs BEFORE any engine call — a
+                    # hostile/torn payload raises ProtocolError (a
+                    # ValueError) out to the shed path below with the
+                    # receiving pool untouched; decoded() copies the
+                    # pooled recv views before they die on the next recv.
+                    msg = networking.recv_data(conn, pool=recv_pool)
+                    kvb = msg.get("blocks")
+                    if not isinstance(kvb, networking.KVBlocks):
+                        raise networking.ProtocolError(
+                            "kv-block frame carries no KVBlocks payload")
+                    kvb = kvb.validate().decoded()
+                    try:
+                        h = self.engine.submit_prefilled(
+                            kvb,
+                            np.array(msg["prompt"], np.int32, copy=True),
+                            int(msg["first_token"]),
+                            int(msg["num_steps"]),
+                            temperature=float(msg.get("temperature", 0.0)),
+                            top_k=msg.get("top_k"),
+                            top_p=msg.get("top_p"),
+                            eos_id=msg.get("eos_id"),
+                            pad_id=msg.get("pad_id"),
+                            deadline_s=msg.get("deadline_s"),
+                            block=False)
+                    except QueueFull:
+                        networking.send_data(
+                            conn, {"ok": False, "error": "queue full",
+                                   "kind": "backpressure"},
+                            pool=send_pool)
+                        continue
+                    except Draining as e:
+                        networking.send_data(
+                            conn, {"ok": False, "error": str(e),
+                                   "kind": "draining"}, pool=send_pool)
+                        continue
+                    except EngineDead as e:
+                        networking.send_data(
+                            conn, {"ok": False, "error": str(e),
+                                   "kind": "engine_dead"}, pool=send_pool)
+                        continue
+                    except ValueError as e:
+                        networking.send_data(
+                            conn, {"ok": False, "error": str(e),
+                                   "kind": "bad_request"}, pool=send_pool)
+                        continue
+                    with self._hlock:
+                        self._handles[h.id] = h
+                        self._owner[h.id] = conn
+                    networking.send_data(conn, {"ok": True, "id": h.id},
+                                         pool=send_pool)
                 elif op == OP_STREAM:
                     msg = networking.recv_data(conn, pool=recv_pool)
                     rid = int(msg["id"])
@@ -3355,7 +3775,7 @@ class ServingServer:
                 if target is not None:
                     self.engine.cancel(target)
                 return "ok"
-            if op in (OP_ENQUEUE, OP_STREAM):
+            if op in (OP_ENQUEUE, OP_STREAM, OP_KVBLOCKS):
                 return op  # pipelined next request, not a dead client
         except (ConnectionError, OSError, ValueError):
             return "dead"
@@ -3411,6 +3831,25 @@ class ServingClient:
         req = {"prompt": np.asarray(prompt, np.int32),
                "num_steps": int(num_steps), **kw}
         networking.send_opcode(self.sock, OP_ENQUEUE)
+        networking.send_data(self.sock, req, pool=self._send_pool)
+        ack = networking.recv_data(self.sock, pool=self._pool)
+        if not ack.get("ok"):
+            _raise_typed(ack.get("kind"), str(ack.get("error", "rejected")))
+        return int(ack["id"])
+
+    def submit_prefilled(self, blocks, prompt, first_token: int,
+                         num_steps: int, **kw) -> int:
+        """Ship a prefilled request's KV blocks to a decode-role server
+        (``SERVING_OP_KVBLOCKS``) — the wire half of the disaggregated
+        hand-off.  ``blocks`` is a :class:`networking.KVBlocks`; the block
+        payloads ride the frame codec's zero-copy buffer path.  Returns
+        the server-assigned id; raises the same typed rejections as
+        :meth:`submit`."""
+        req = {"blocks": blocks,
+               "prompt": np.asarray(prompt, np.int32),
+               "first_token": int(first_token),
+               "num_steps": int(num_steps), **kw}
+        networking.send_opcode(self.sock, OP_KVBLOCKS)
         networking.send_data(self.sock, req, pool=self._send_pool)
         ack = networking.recv_data(self.sock, pool=self._pool)
         if not ack.get("ok"):
@@ -3482,3 +3921,431 @@ class ServingClient:
         return retry_policy.call(
             redialing_attempt,
             retry_on=(EngineDead, ConnectionError, OSError))
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode (PR 16)
+# ---------------------------------------------------------------------------
+
+class _DisaggRequest:
+    """One in-flight request's routing record inside a :class:`DisaggPair`:
+    the client-facing proxy handle, the current upstream handle it mirrors
+    (prefill first, decode after the hand-off), and a cancel relay that
+    always points at whichever engine owns the upstream right now."""
+
+    __slots__ = ("proxy", "upstream", "cancel_fn", "cancelled", "thread")
+
+    def __init__(self, proxy: RequestHandle):
+        self.proxy = proxy
+        self.upstream: Optional[RequestHandle] = None
+        self.cancel_fn = None
+        self.cancelled = False
+        self.thread: Optional[threading.Thread] = None
+
+
+class DisaggPair:
+    """Disaggregated serving: N ``role="prefill"`` engines feeding ONE
+    ``role="decode"`` engine, behind the unified engine's client surface
+    (``submit`` → :class:`RequestHandle` → ``next_chunk``/``result``).
+
+    Admissions route to a prefill engine (round-robin); when its half
+    retires (``finish="prefilled"``), the request's filled KV blocks ship
+    to the decode engine — in-process via ``submit_prefilled`` when
+    ``decode`` is an engine, or over the serving wire
+    (``SERVING_OP_KVBLOCKS`` through :class:`ServingClient`) when
+    ``decode_addr`` names a remote decode-role :class:`ServingServer`.
+    The client-visible stream is unchanged: tokens relay into the proxy
+    handle as the decode engine emits them, and greedy output is
+    token-identical to a unified engine (the decode engine resumes from
+    bit-exact shipped KV at the shipped position with the same RNG key).
+
+    Failure matrix (docs/serving.md):
+
+     - **prefill death** mid-prefill or mid-transfer re-routes: the
+       request resubmits to the next live prefill engine with its
+       ORIGINAL rng key (deterministic, so the retry is idempotent),
+       bounded by one attempt per engine; blocks the dead engine held are
+       reclaimed by its own death path, and the decode pool never saw the
+       torn transfer (``kv_blocks_in_use == 0`` on both sides).
+     - **decode death** is terminal: the proxy fails with the typed
+       :class:`EngineDead` (no silent re-route — the decode engine owns
+       all live KV state, exactly the supervised-restart seam
+       ``resilience.PairSupervisor`` covers).
+     - **cancel/deadline** land on whichever engine currently owns the
+       request; the proxy mirrors the upstream finish reason.
+    """
+
+    def __init__(self, prefills, decode: Optional[ServingEngine] = None,
+                 decode_addr: Optional[Tuple[str, int]] = None,
+                 poll_s: float = 0.02):
+        if isinstance(prefills, ServingEngine):
+            prefills = [prefills]
+        if not prefills:
+            raise ValueError("DisaggPair needs at least one prefill engine")
+        for e in prefills:
+            if e.role != "prefill":
+                raise ValueError(f"prefill engines must be role='prefill', "
+                                 f"got role={e.role!r}")
+        if (decode is None) == (decode_addr is None):
+            raise ValueError("pass exactly one of decode= (in-process "
+                             "engine) or decode_addr= (remote server)")
+        if decode is not None and decode.role != "decode":
+            raise ValueError(f"decode engine must be role='decode', got "
+                             f"role={decode.role!r}")
+        self._prefills: List[ServingEngine] = list(prefills)
+        self._decode = decode
+        self._decode_addr = decode_addr
+        self.poll_s = float(poll_s)
+        self._lock = threading.Lock()
+        self._live: Dict[int, _DisaggRequest] = {}
+        self._next_id = 0
+        self._rr = 0  # round-robin cursor over prefill engines
+        # the pair's OWN terminal accounting: engine counters double-count
+        # a re-routed request (every attempt is a submission somewhere), so
+        # client-facing totals live here
+        self.counters: Dict[str, int] = {
+            "requests_submitted": 0, "requests_completed": 0,
+            "requests_failed": 0, "requests_rejected": 0,
+            "requests_cancelled": 0, "requests_expired": 0,
+            "prefill_reroutes": 0,
+        }
+
+    # ------------------------------------------------------------ lifecycle
+    def warmup(self) -> "DisaggPair":
+        """Compile every engine's role-specific programs (prefill buckets
+        + gather on the prefill side, decode step + ingest on the decode
+        side) before traffic — the pair-level twin of
+        ``ServingEngine.warmup``."""
+        for e in self.engines:
+            e.warmup()
+        return self
+
+    def start(self) -> "DisaggPair":
+        for e in self.engines:  # prefill engines first, then decode
+            e.start()
+        return self
+
+    def stop(self, join_timeout: float = 10.0) -> None:
+        for e in self.engines:
+            e.stop(join_timeout=join_timeout)
+        with self._lock:
+            threads = [r.thread for r in self._live.values()]
+        for t in threads:
+            if t is not None:
+                t.join(timeout=join_timeout)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain, prefill side first (no new hand-offs) then the
+        decode engine; router threads are joined last so every proxy
+        reaches a terminal state."""
+        with self._lock:
+            pres, dec = list(self._prefills), self._decode
+        clean = all([e.drain(timeout=timeout) for e in pres])
+        if dec is not None:
+            clean = dec.drain(timeout=timeout) and clean
+        with self._lock:
+            threads = [r.thread for r in self._live.values()]
+        for t in threads:
+            if t is not None:
+                t.join(timeout=5.0)
+        return clean
+
+    def __enter__(self) -> "DisaggPair":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------ admission
+    def submit(self, prompt, num_steps: int, **kw) -> RequestHandle:
+        """Unified-engine ``submit`` surface.  Returns a proxy handle whose
+        stream spans both halves: TTFT is the prefill engine's first
+        token, every later token is the decode engine's."""
+        prompt = np.asarray(prompt, np.int32)
+        ph, eng = self._submit_prefill(prompt, num_steps, kw, first=True)
+        if num_steps == 0:
+            # the prefill engine completed it in place ("empty"): nothing
+            # to hand off, and the engine's own counters saw it — mirror
+            # into the pair's
+            with self._lock:
+                self.counters["requests_submitted"] += 1
+                self.counters["requests_completed"] += 1
+            return ph
+        with self._lock:
+            self._next_id += 1
+            proxy = RequestHandle(
+                self._next_id, prompt, num_steps,
+                float(kw.get("temperature", 0.0)), kw.get("top_k"),
+                kw.get("top_p"), kw.get("eos_id"), kw.get("pad_id"),
+                ph.key, deadline_s=kw.get("deadline_s"))
+            rec = _DisaggRequest(proxy)
+            rec.upstream = ph
+            rec.cancel_fn = (lambda e=eng, h=ph: e.cancel(h))
+            self._live[proxy.id] = rec
+            self.counters["requests_submitted"] += 1
+            rec.thread = threading.Thread(
+                target=self._route, args=(rec, dict(kw)), daemon=True,
+                name=f"dkt-disagg-route-{proxy.id}")
+            rec.thread.start()
+        return proxy
+
+    def _submit_prefill(self, prompt, num_steps, kw, first: bool,
+                        rng=None):
+        """Round-robin submit over LIVE prefill engines; on the first
+        admission typed backpressure propagates to the caller after every
+        engine refused, on a re-route the caller handles it."""
+        last: Optional[BaseException] = None
+        with self._lock:
+            attempts_budget = len(self._prefills)
+        for _ in range(attempts_budget):
+            with self._lock:
+                eng = self._prefills[self._rr % len(self._prefills)]
+                self._rr += 1
+            try:
+                sub = dict(kw)
+                # pair admission is non-blocking by construction: a full
+                # prefill queue tries the next engine instead of parking
+                sub.pop("block", None)
+                sub.pop("timeout", None)
+                if rng is not None:
+                    sub.pop("seed", None)
+                    sub["rng"] = rng
+                return eng.submit(prompt, num_steps, block=False,
+                                  **sub), eng
+            except (EngineDead, QueueFull, Draining) as e:
+                last = e
+        if first:
+            with self._lock:
+                self.counters["requests_rejected"] += 1
+        raise last if last is not None else EngineDead(
+            "no live prefill engine")
+
+    # -------------------------------------------------------------- routing
+    def _route(self, rec: _DisaggRequest, kw: Dict[str, Any]) -> None:
+        """Per-request router thread: wait out the prefill half (re-routing
+        across prefill deaths), ship the block set, then relay the decode
+        engine's tokens into the proxy."""
+        proxy = rec.proxy
+        attempts = 1
+        while True:
+            ph = rec.upstream
+            ph.wait()
+            if ph.finish == "prefilled":
+                break
+            if ph.error is not None:
+                # prefill engine died with the request in flight: re-route
+                # with the ORIGINAL key so the retry is bit-identical
+                with self._lock:
+                    budget = len(self._prefills) + 1
+                if attempts >= budget:
+                    self._retire(rec, error=EngineDead(
+                        f"request {proxy.id}: every prefill re-route "
+                        f"failed ({ph.error})"))
+                    return
+                with self._lock:
+                    self.counters["prefill_reroutes"] += 1
+                    cancelled = rec.cancelled
+                if cancelled:
+                    self._retire(rec, finish="cancel")
+                    return
+                try:
+                    ph, eng = self._submit_prefill(
+                        proxy.prompt, proxy.num_steps, kw, first=False,
+                        rng=proxy.key)
+                except (EngineDead, QueueFull, Draining) as e:
+                    self._retire(rec, error=e)
+                    return
+                with self._lock:
+                    rec.upstream = ph
+                    rec.cancel_fn = (lambda e=eng, h=ph: e.cancel(h))
+                    if rec.cancelled:
+                        rec.cancel_fn()
+                attempts += 1
+                continue
+            # cancel / deadline / drain on the prefill half: mirror it
+            self._retire(rec, finish=ph.finish)
+            return
+        kvb = ph.kvblocks
+        first_token = int(ph.tokens[0])
+        with self._lock:
+            dec = self._decode  # in-flight relays keep their decode engine
+        try:
+            if dec is not None:
+                self._relay_local(rec, kvb, first_token, kw, dec)
+            else:
+                self._relay_wire(rec, kvb, first_token, kw)
+        except (EngineDead, ConnectionError, OSError) as e:
+            # decode death is terminal (typed), never silently re-routed:
+            # the decode engine owns all live KV state
+            self._retire(rec, error=e if isinstance(e, EngineDead)
+                         else EngineDead(f"decode engine unreachable: "
+                                         f"{e!r}"))
+        except ValueError as e:
+            self._retire(rec, error=e)
+
+    def _relay_local(self, rec: _DisaggRequest, kvb, first_token: int,
+                     kw: Dict[str, Any], dec: ServingEngine) -> None:
+        proxy = rec.proxy
+        dh = dec.submit_prefilled(
+            kvb, proxy.prompt, first_token, proxy.num_steps,
+            temperature=proxy.temperature, top_k=proxy.top_k,
+            top_p=proxy.top_p, eos_id=proxy.eos_id, pad_id=proxy.pad_id,
+            deadline_s=kw.get("deadline_s"), block=True)
+        with self._lock:
+            rec.upstream = dh
+            rec.cancel_fn = (lambda e=dec, h=dh: e.cancel(h))
+            if rec.cancelled:
+                rec.cancel_fn()
+        while True:
+            chunk, done = dh.next_chunk(timeout=self.poll_s)
+            for t in chunk:
+                proxy._push(int(t))
+            if done:
+                if dh.error is not None:
+                    self._retire(rec, error=dh.error)
+                else:
+                    self._retire(rec, finish=dh.finish)
+                return
+
+    def _relay_wire(self, rec: _DisaggRequest, kvb, first_token: int,
+                    kw: Dict[str, Any]) -> None:
+        proxy = rec.proxy
+        client = ServingClient(*self._decode_addr)
+        try:
+            rid = client.submit_prefilled(
+                kvb, proxy.prompt, first_token, proxy.num_steps,
+                temperature=proxy.temperature, top_k=proxy.top_k,
+                top_p=proxy.top_p, eos_id=proxy.eos_id,
+                pad_id=proxy.pad_id, deadline_s=kw.get("deadline_s"))
+            with self._lock:
+                rec.upstream = None
+                rec.cancel_fn = (lambda c=client, r=rid:
+                                 c.cancel(r, await_ack=False))
+                if rec.cancelled:
+                    rec.cancel_fn()
+            for tokens, done in client.stream(rid):
+                for t in tokens:
+                    proxy._push(int(t))
+                if done is not None:
+                    self._retire(rec, finish=done["finish"])
+                    return
+            raise ConnectionError("stream ended without a done frame")
+        finally:
+            client.close()
+
+    def _retire(self, rec: _DisaggRequest, finish: Optional[str] = None,
+                error: Optional[BaseException] = None) -> None:
+        """Make the proxy terminal exactly once and book the pair-level
+        counter for its reason."""
+        proxy = rec.proxy
+        if error is not None:
+            exc = (error if isinstance(error, EngineDead)
+                   else EngineDead(str(error)))
+            counted = proxy._fail(exc)
+            key = "requests_failed"
+        else:
+            counted = proxy._finish(finish)
+            key = {"cancel": "requests_cancelled",
+                   "deadline": "requests_expired"}.get(
+                       finish, "requests_completed")
+        with self._lock:
+            if counted:
+                self.counters[key] += 1
+            self._live.pop(proxy.id, None)
+
+    # ------------------------------------------------------------- controls
+    def cancel(self, handle: RequestHandle) -> bool:
+        """Cancel a proxy handle wherever its request currently lives
+        (queued/prefilling, mid-transfer, or decoding).  Returns False if
+        it already finished."""
+        with handle._cond:
+            if handle.finish is not None:
+                return False
+        with self._lock:
+            rec = self._live.get(handle.id)
+            if rec is None or rec.proxy is not handle:
+                return False
+            rec.cancelled = True
+            fn = rec.cancel_fn
+        if fn is not None:
+            try:
+                fn()
+            except (ConnectionError, OSError):
+                pass  # upstream gone: its death path retires the proxy
+        return True
+
+    def replace_engine(self, old: ServingEngine,
+                       new: ServingEngine) -> None:
+        """Swap a respawned engine into the pair (the
+        ``resilience.PairSupervisor`` restart seam).  In-flight requests
+        on the old engine fail through its death path and re-route."""
+        with self._lock:
+            for i, e in enumerate(self._prefills):
+                if e is old:
+                    self._prefills[i] = new
+                    return
+            if self._decode is old:
+                self._decode = new
+                return
+        raise ValueError("engine to replace is not part of this pair")
+
+    # ------------------------------------------------------------ telemetry
+    @property
+    def engines(self) -> List[ServingEngine]:
+        with self._lock:
+            return self._prefills + ([self._decode]
+                                     if self._decode is not None else [])
+
+    @property
+    def stats(self) -> Dict[str, Any]:
+        """Merged engine stats (numeric counters summed, sample lists
+        concatenated) with the request-level terminal counters OVERRIDDEN
+        by the pair's own: a re-routed request is one client request, not
+        one per attempt."""
+        merged: Dict[str, Any] = {}
+        for e in self.engines:
+            for k, v in e.stats.items():
+                if isinstance(v, bool) or not isinstance(
+                        v, (int, float, list)):
+                    merged.setdefault(k, v)
+                elif isinstance(v, list):
+                    merged.setdefault(k, [])
+                    merged[k] = merged[k] + list(v)
+                else:
+                    merged[k] = merged.get(k, 0) + v
+        with self._lock:
+            merged.update(self.counters)
+        return merged
+
+    @property
+    def kv_blocks_in_use(self) -> Optional[int]:
+        """Sum across BOTH sides — the zero-leak assertion surface."""
+        vals = [e.kv_blocks_in_use for e in self.engines]
+        vals = [v for v in vals if v is not None]
+        return sum(vals) if vals else None
+
+    @property
+    def slot_occupancy(self) -> Optional[float]:
+        """The DECODE engine's occupancy (None for wire-mode pairs): the
+        continuous-batching health metric disaggregation exists to
+        protect."""
+        with self._lock:
+            dec = self._decode
+        return dec.slot_occupancy if dec is not None else None
+
+    @property
+    def max_len(self) -> int:
+        return min(e.max_len for e in self.engines)
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(e.queue_depth for e in self.engines)
+
+    @property
+    def dead(self) -> Optional[BaseException]:
+        """The first dead engine's error, or None while every engine in
+        the pair is live."""
+        for e in self.engines:
+            if e.dead is not None:
+                return e.dead
+        return None

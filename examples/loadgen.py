@@ -51,7 +51,8 @@ def make_trace(num_requests: int, seed: int = 0, vocab: int = 16,
                prompt_lengths: Sequence[int] = PROMPT_LENGTHS,
                pattern: str = "random",
                prefix_groups: Optional[int] = None,
-               prefix_len: int = 0) -> List[Dict[str, Any]]:
+               prefix_len: int = 0,
+               long_fraction: float = 0.25) -> List[Dict[str, Any]]:
     """A deterministic request trace: seeded prompt contents + lengths, a
     ``sampled_fraction`` of requests sampling at ``temperature`` (per-
     request seeds), the rest greedy — so the slot batch always mixes
@@ -64,6 +65,14 @@ def make_trace(num_requests: int, seed: int = 0, vocab: int = 16,
     ``build_spec_engine`` trained pair, the way real serving prompts are
     in-distribution for a production draft (speculation's accept rate,
     and therefore its win, is a property of the traffic).
+
+    ``pattern="bimodal"`` is the disaggregation interference trace
+    (DistServe/Splitwise): a ``long_fraction`` of requests are
+    prefill-heavy (the LONGEST length in ``prompt_lengths``, few decode
+    steps) and the rest decode-heavy (the shortest length, the full
+    ``num_steps``) — on a unified engine the long-prompt bursts inflate
+    decode-token latency; a ``DisaggPair`` isolates them.  Only the two
+    extreme lengths are drawn, so the compile-bounded shape budget holds.
 
     ``prefix_groups``/``prefix_len``: the SHARED-PREFIX trace the paged
     engine's radix index exists for — requests split round-robin across
@@ -84,7 +93,15 @@ def make_trace(num_requests: int, seed: int = 0, vocab: int = 16,
                     for _ in range(int(prefix_groups))]
     trace = []
     for i in range(int(num_requests)):
-        p_len = int(prompt_lengths[rng.integers(0, len(prompt_lengths))])
+        steps = int(num_steps)
+        if pattern == "bimodal":
+            if rng.random() < float(long_fraction):
+                p_len = int(max(prompt_lengths))   # prefill-heavy
+                steps = max(1, int(num_steps) // 4)
+            else:
+                p_len = int(min(prompt_lengths))   # decode-heavy
+        else:
+            p_len = int(prompt_lengths[rng.integers(0, len(prompt_lengths))])
         if pattern == "arith":
             start = int(rng.integers(0, vocab))
             prompt = ((start + np.arange(p_len)) % vocab).astype(np.int32)
@@ -95,7 +112,7 @@ def make_trace(num_requests: int, seed: int = 0, vocab: int = 16,
                 [prefixes[i % len(prefixes)], prompt]).astype(np.int32)
         req: Dict[str, Any] = {
             "prompt": prompt,
-            "num_steps": int(num_steps),
+            "num_steps": steps,
             "seed": int(seed * 10_000 + i),
         }
         if temperature > 0.0 and rng.random() < sampled_fraction:
@@ -338,7 +355,9 @@ def build_engine(num_slots: int = 4, max_len: int = 32, vocab: int = 16,
                  kv_dtype: Optional[str] = None,
                  paged: bool = False,
                  block_size: Optional[int] = None,
-                 kv_blocks: Optional[int] = None):
+                 kv_blocks: Optional[int] = None,
+                 disaggregate: bool = False,
+                 prefill_engines: int = 1):
     """A small random-weight LM + engine (throughput benches measure
     scheduling and batching, not model quality) — one place so bench,
     tests, and the CLI agree on the workload shape.  ``prefill_mode``/
@@ -350,12 +369,18 @@ def build_engine(num_slots: int = 4, max_len: int = 32, vocab: int = 16,
     accept rate — the round-collapsing win is real because the whole
     draft+verify round is ONE dispatch), or an int layer count for a
     separate random-weight draft (near-floor accept rate — the worst
-    case).  ``spec_len``/``quantize``/``kv_dtype`` pass through."""
+    case).  ``spec_len``/``quantize``/``kv_dtype`` pass through.
+
+    ``disaggregate=True`` returns a ``DisaggPair`` instead of one
+    engine: ``prefill_engines`` role="prefill" engines feeding one
+    role="decode" engine over the in-process hand-off (paged is forced —
+    KV-block transfer is a paged-arena operation; ``spec_draft`` is
+    incompatible with role engines and rejected by the constructor)."""
     import jax
 
     from distkeras_tpu.core.model import FittedModel
     from distkeras_tpu.models import transformer_lm
-    from distkeras_tpu.serving import ServingEngine
+    from distkeras_tpu.serving import DisaggPair, ServingEngine
 
     model = transformer_lm(vocab_size=vocab, seq_len=max_len, d_model=32,
                            num_heads=4, num_layers=2, mlp_dim=64,
@@ -383,12 +408,20 @@ def build_engine(num_slots: int = 4, max_len: int = 32, vocab: int = 16,
         kw["quantize"] = quantize
     if kv_dtype is not None:
         kw["kv_dtype"] = kv_dtype
-    if paged:
+    if paged or disaggregate:
         kw["paged"] = True
         if block_size is not None:
             kw["block_size"] = int(block_size)
         if kv_blocks is not None:
             kw["kv_blocks"] = int(kv_blocks)
+    if disaggregate:
+        mk = lambda role: ServingEngine(  # noqa: E731
+            fitted, num_slots=num_slots, max_len=max_len,
+            queue_capacity=queue_capacity, role=role, **kw)
+        engine = DisaggPair([mk("prefill")
+                             for _ in range(int(prefill_engines))],
+                            decode=mk("decode"))
+        return fitted, engine
     engine = ServingEngine(fitted, num_slots=num_slots, max_len=max_len,
                            queue_capacity=queue_capacity, **kw)
     return fitted, engine
@@ -498,6 +531,19 @@ def main():
                          "(with --prefix-groups)")
     ap.add_argument("--max-len", type=int, default=32,
                     help="engine max_len (raise for long shared prefixes)")
+    ap.add_argument("--pattern", choices=("random", "arith", "bimodal"),
+                    default="random",
+                    help="trace shape: iid prompts, x+1 runs, or the "
+                         "bimodal long-prompt + decode-heavy interference "
+                         "mix (the disaggregation scenario)")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="serve through a DisaggPair: role='prefill' "
+                         "engines fill KV blocks and ship them to one "
+                         "role='decode' engine owning the token loop "
+                         "(implies --paged)")
+    ap.add_argument("--prefill-engines", type=int, default=1,
+                    help="prefill engines feeding the decode engine "
+                         "(with --disaggregate)")
     args = ap.parse_args()
 
     fitted, engine = build_engine(num_slots=args.slots,
@@ -510,9 +556,12 @@ def main():
                                   kv_dtype=args.kv_dtype,
                                   paged=args.paged,
                                   block_size=args.block_size,
-                                  kv_blocks=args.kv_blocks)
+                                  kv_blocks=args.kv_blocks,
+                                  disaggregate=args.disaggregate,
+                                  prefill_engines=args.prefill_engines)
     trace = make_trace(args.requests, num_steps=args.steps,
                        temperature=args.temperature,
+                       pattern=args.pattern,
                        prefix_groups=args.prefix_groups,
                        prefix_len=args.prefix_len)
     try:
@@ -530,11 +579,23 @@ def main():
                 "drafted": engine.stats["drafted"],
                 "accepted": engine.stats["accepted"],
                 "verify_calls": engine.stats["verify_calls"]}))
+        if args.disaggregate:
+            s = engine.stats
+            print(json.dumps({
+                "mode": "disagg",
+                "prefill_engines": args.prefill_engines,
+                "kv_blocks_shipped": s["kv_blocks_shipped"],
+                "kv_block_bytes_shipped": s["kv_block_bytes_shipped"],
+                "transfer_ms_mean": (round(float(np.mean(
+                    s["transfer_ms"])), 3) if s["transfer_ms"] else None),
+                "prefill_reroutes": s["prefill_reroutes"]}))
         if args.paged:
+            paged_eng = (engine.engines[0] if args.disaggregate
+                         else engine)
             print(json.dumps({
                 "mode": "paged",
-                "block_size": engine.block_size,
-                "kv_blocks": engine.kv_blocks,
+                "block_size": paged_eng.block_size,
+                "kv_blocks": paged_eng.kv_blocks,
                 "prefix_hits": closed["prefix_hits"],
                 "prefix_hit_tokens": closed["prefix_hit_tokens"],
                 "prefix_hit_rate": closed["prefix_hit_rate"],
@@ -569,7 +630,9 @@ def main():
                                      kv_dtype=args.kv_dtype,
                                      paged=args.paged,
                                      block_size=args.block_size,
-                                     kv_blocks=args.kv_blocks)
+                                     kv_blocks=args.kv_blocks,
+                                     disaggregate=args.disaggregate,
+                                     prefill_engines=args.prefill_engines)
             point = run_open_loop(engine, trace, qps=float(qps))
             engine.stop()
             print(json.dumps({"mode": "open_loop", **point}))

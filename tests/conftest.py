@@ -70,6 +70,12 @@ def pytest_configure(config):
         "generator-backed and seeded with inline-pumped engines — no "
         "sleeps on the fast path; the chaos soak with live engine kills "
         "and supervised restarts is additionally marked slow)")
+    config.addinivalue_line(
+        "markers",
+        "disagg: disaggregated prefill/decode serving tests (tier-1 legs "
+        "are in-process or socketpair/loopback-only, seeded, and "
+        "sleep-free; unified-vs-disagg timing comparisons are "
+        "additionally marked slow)")
 
 
 @pytest.fixture()

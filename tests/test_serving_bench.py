@@ -108,7 +108,10 @@ def test_bench_serving_fields_shape():
                         "serving_prefix_hit_rate",
                         "serving_prefix_prefill_tokens_per_sec",
                         "serving_prefix_prefill_dense_tokens_per_sec",
-                        "serving_paged_capacity_slots"}
+                        "serving_paged_capacity_slots",
+                        "serving_unified_decode_p99_ms",
+                        "serving_disagg_decode_p99_ms",
+                        "serving_kv_transfer_bytes"}
 
 
 def test_closed_loop_chaos_kill_schedule_no_leaks():

@@ -1,0 +1,425 @@
+"""Disaggregated prefill/decode serving (PR 16, ``serving.DisaggPair``).
+
+The contract pinned here, mirroring docs/serving.md's failure matrix:
+
+ - a prefill→decode pair emits tokens BIT-IDENTICAL to a unified paged
+   engine, greedy AND sampled, float32 AND int8 KV (the shipped block
+   set plus RNG key reconstruct the exact device state the unified
+   token loop would have had);
+ - zero block leak on BOTH engines across completion, cancel, and
+   kill/mid-transfer interleavings (``kv_blocks_in_use == 0`` after the
+   traffic drains — the pool refcount contract extended over the wire);
+ - a prefill engine killed with requests in flight re-routes them to
+   the next live prefill engine with the ORIGINAL rng key (idempotent
+   retry, one client-visible request, ``prefill_reroutes`` booked);
+ - a dead decode engine is TERMINAL (typed ``EngineDead``, no silent
+   re-route — it owned all live KV state), the seam
+   ``resilience.PairSupervisor`` restarts through ``replace_engine``;
+ - the wire path (``SERVING_OP_KVBLOCKS`` through ``ServingServer``)
+   behaves identically, and hostile/torn 'k' frames shed with the
+   decode pool untouched.
+
+Tier-1 legs are in-process or loopback-only, seeded, and sleep-free.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from distkeras_tpu import networking
+from distkeras_tpu.core.model import FittedModel
+from distkeras_tpu.models import transformer_lm
+from distkeras_tpu.networking import ChaosFault, ChaosProxy
+from distkeras_tpu.resilience import PairSupervisor
+from distkeras_tpu.serving import (DisaggPair, EngineDead, ServingClient,
+                                   ServingEngine, ServingServer)
+
+pytestmark = pytest.mark.disagg
+
+VOCAB = 17
+PROMPT = np.array([3, 4, 5, 6], np.int32)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    model = transformer_lm(vocab_size=VOCAB, seq_len=32, d_model=16,
+                           num_heads=2, num_layers=2, mlp_dim=32,
+                           compute_dtype="float32")
+    params = model.init(jax.random.PRNGKey(0), (32,))
+    return FittedModel(model, params)
+
+
+def _mk(fitted, role, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 24)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("kv_blocks", 30)
+    return ServingEngine(fitted, paged=True, role=role, **kw)
+
+
+def _unified_rows(fitted, reqs, **ekw):
+    """Reference rows from a unified paged engine (inline scheduler)."""
+    eng = _mk(fitted, "unified", **ekw)
+    hs = [eng.submit(**r) for r in reqs]
+    eng.run_until_idle()
+    assert eng.kv_blocks_in_use == 0
+    return [h.result() for h in hs]
+
+
+def _assert_zero_leak(pair):
+    assert pair.kv_blocks_in_use == 0
+    for e in pair.engines:
+        assert e.kv_blocks_in_use == 0, f"leak on role={e.role} engine"
+
+
+# ---------------------------------------------------------------------------
+# token identity: pair vs unified, greedy + sampled × float32 + int8 KV
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"],
+                         ids=["kv-f32", "kv-int8"])
+def test_pair_token_identical_to_unified(fitted, kv_dtype):
+    """The disaggregated hand-off is an execution strategy, never a
+    numerics change: greedy AND sampled streams match the unified engine
+    bit for bit (int8 KV ships quantized codes + per-block scales)."""
+    reqs = [
+        {"prompt": PROMPT, "num_steps": 8},                       # greedy
+        {"prompt": np.arange(1, 8, dtype=np.int32), "num_steps": 6,
+         "temperature": 0.7, "seed": 11},
+        {"prompt": np.array([2, 9], np.int32), "num_steps": 5,
+         "temperature": 0.7, "top_k": 5, "top_p": 0.9, "seed": 23},
+    ]
+    ekw = {} if kv_dtype is None else {"kv_dtype": kv_dtype}
+    want = _unified_rows(fitted, reqs, **ekw)
+    pair = DisaggPair([_mk(fitted, "prefill", **ekw)],
+                      decode=_mk(fitted, "decode", **ekw), poll_s=0.005)
+    with pair:
+        hs = [pair.submit(**r) for r in reqs]
+        rows = [h.result(timeout=60.0) for h in hs]
+    for got, ref in zip(rows, want):
+        np.testing.assert_array_equal(got, ref)
+    _assert_zero_leak(pair)
+    s = pair.stats
+    assert s["requests_completed"] == len(reqs)
+    assert s["kv_blocks_shipped"] > 0
+    assert s["kv_block_bytes_shipped"] > 0
+    # one ship-side sample (gather+host) + one ingest-side sample per req
+    assert len(s["transfer_ms"]) == 2 * len(reqs)
+
+
+def test_pair_zero_steps_completes_on_prefill_side(fitted):
+    """num_steps=0 never crosses the wire: the prefill engine completes
+    it in place and the pair books it without a router thread."""
+    pair = DisaggPair([_mk(fitted, "prefill")],
+                      decode=_mk(fitted, "decode"), poll_s=0.005)
+    with pair:
+        h = pair.submit(PROMPT, 0)
+        assert h.wait(timeout=30.0)
+    assert h.finish == "empty"
+    assert pair.counters["requests_completed"] == 1
+    _assert_zero_leak(pair)
+
+
+# ---------------------------------------------------------------------------
+# zero block leak across cancel interleavings
+# ---------------------------------------------------------------------------
+
+def test_cancel_mid_decode_reclaims_blocks_both_sides(fitted):
+    """Cancel lands on whichever engine owns the request; after the
+    traffic drains neither arena holds a block."""
+    pair = DisaggPair([_mk(fitted, "prefill")],
+                      decode=_mk(fitted, "decode"), poll_s=0.005)
+    with pair:
+        doomed = pair.submit(PROMPT, 18)
+        keeper = pair.submit(np.array([5, 6, 7], np.int32), 4)
+        # wait for the doomed stream to actually start decoding, then cancel
+        chunk, done = doomed.next_chunk(timeout=30.0)
+        assert chunk, "no first token within timeout"
+        assert pair.cancel(doomed) or doomed.done
+        assert doomed.wait(timeout=30.0)
+        assert keeper.wait(timeout=30.0)
+        assert pair.drain(timeout=30.0)
+    assert doomed.finish in ("cancel", "length", "eos")  # cancel can race
+    assert keeper.finish in ("length", "eos")
+    _assert_zero_leak(pair)
+    c = pair.counters
+    assert c["requests_submitted"] == 2
+    assert (c["requests_completed"] + c["requests_cancelled"]) == 2
+
+
+def test_cancel_queued_before_prefill(fitted):
+    """A cancel that lands while the request is still queued on the
+    prefill engine never touches the decode side."""
+    pre = _mk(fitted, "prefill")
+    dec = _mk(fitted, "decode")
+    pair = DisaggPair([pre], decode=dec, poll_s=0.005)
+    try:
+        # engines NOT started: the request parks in pre's queue, so the
+        # cancel deterministically lands before prefill; driving pre's
+        # scheduler inline sheds it without ever taking a KV slot
+        h = pair.submit(PROMPT, 8)
+        assert pair.cancel(h)
+        pre.run_until_idle()
+        assert h.wait(timeout=30.0)
+        assert h.finish == "cancel"
+    finally:
+        pair.stop()
+    _assert_zero_leak(pair)
+    assert dec.stats["requests_submitted"] == 0
+    assert pair.counters["requests_cancelled"] == 1
+
+
+# ---------------------------------------------------------------------------
+# prefill death: deterministic mid-flight re-route
+# ---------------------------------------------------------------------------
+
+def test_prefill_death_reroutes_with_original_key(fitted):
+    """pre1 is never started, so the request deterministically parks in
+    its queue; declaring it dead fails the upstream handle and the router
+    resubmits to pre2 with the ORIGINAL rng key — one client request,
+    token-identical to unified, zero leak on every engine."""
+    req = {"prompt": PROMPT, "num_steps": 6, "temperature": 0.6,
+           "seed": 7}
+    (want,) = _unified_rows(fitted, [req])
+    pre1 = _mk(fitted, "prefill")
+    pre2 = _mk(fitted, "prefill")
+    dec = _mk(fitted, "decode")
+    pair = DisaggPair([pre1, pre2], decode=dec, poll_s=0.005)
+    try:
+        pre2.start()
+        dec.start()
+        h = pair.submit(**req)  # round-robin lands on (unstarted) pre1
+        assert pre1.stats["requests_submitted"] == 1
+        pre1.declare_dead("chaos: prefill killed mid-flight")
+        row = h.result(timeout=60.0)
+    finally:
+        pair.stop()
+    np.testing.assert_array_equal(row, want)
+    assert pair.counters["prefill_reroutes"] == 1
+    assert pair.counters["requests_completed"] == 1
+    assert pair.counters["requests_failed"] == 0
+    assert pre2.stats["requests_submitted"] == 1
+    _assert_zero_leak(pair)
+
+
+def test_every_prefill_dead_fails_typed(fitted):
+    """When no live prefill engine remains, the re-route budget exhausts
+    and the proxy fails with the typed EngineDead."""
+    pre = _mk(fitted, "prefill")
+    dec = _mk(fitted, "decode")
+    pair = DisaggPair([pre], decode=dec, poll_s=0.005)
+    try:
+        dec.start()
+        h = pair.submit(PROMPT, 6)
+        pre.declare_dead("chaos: the only prefill engine died")
+        assert h.wait(timeout=30.0)
+    finally:
+        pair.stop()
+    assert isinstance(h.error, EngineDead)
+    with pytest.raises(EngineDead):
+        h.result()
+    assert pair.counters["requests_failed"] == 1
+    _assert_zero_leak(pair)
+
+
+# ---------------------------------------------------------------------------
+# decode death: terminal, typed, restartable through the supervisor seam
+# ---------------------------------------------------------------------------
+
+def test_decode_death_is_terminal_no_reroute(fitted):
+    """The decode engine owns all live KV state, so its death fails the
+    proxy with EngineDead instead of silently re-routing."""
+    pre = _mk(fitted, "prefill")
+    dec = _mk(fitted, "decode")
+    pair = DisaggPair([pre], decode=dec, poll_s=0.005)
+    try:
+        pre.start()  # decode NOT started: the hand-off parks in its queue
+        h = pair.submit(PROMPT, 8)
+        # wait for the prefill half + transfer to land on the decode queue
+        assert h.next_chunk(timeout=30.0)[0], "prefill token not relayed"
+        dec.declare_dead("chaos: decode engine killed")
+        assert h.wait(timeout=30.0)
+    finally:
+        pair.stop()
+    assert isinstance(h.error, EngineDead)
+    assert pair.counters["prefill_reroutes"] == 0
+    assert pair.counters["requests_failed"] == 1
+    assert pair.dead is not None
+    _assert_zero_leak(pair)
+
+
+def test_pair_supervisor_restart_seam(fitted):
+    """resilience.PairSupervisor: a dead engine is respawned through
+    respawn_clone and swapped into the pair via replace_engine; traffic
+    after recovery completes token-identically."""
+    req = {"prompt": PROMPT, "num_steps": 6}
+    (want,) = _unified_rows(fitted, [req])
+    pre = _mk(fitted, "prefill")
+    dec = _mk(fitted, "decode")
+    pair = DisaggPair([pre], decode=dec, poll_s=0.005)
+    with pair:
+        assert pair.submit(**req).wait(timeout=60.0)
+        sup = PairSupervisor(pair, liveness_deadline=30.0)
+        assert sup.check_all() == [None, None]
+        pre.declare_dead("chaos: kill the prefill half")
+        recs = sup.recover_all()
+        assert len(recs) == 1 and recs[0]["restarted"]
+        assert sup.restarts == 1
+        new_pre = pair.engines[0]
+        assert new_pre is not pre and new_pre.role == "prefill"
+        row = pair.submit(**req).result(timeout=60.0)
+    np.testing.assert_array_equal(row, want)
+    assert pair.counters["requests_completed"] == 2
+    _assert_zero_leak(pair)
+
+
+# ---------------------------------------------------------------------------
+# the wire path: SERVING_OP_KVBLOCKS through ServingServer
+# ---------------------------------------------------------------------------
+
+def test_pair_over_wire_token_identical(fitted):
+    """decode_addr mode: blocks ship over loopback through the serving
+    protocol's 'k' opcode; the client-visible stream is unchanged."""
+    reqs = [
+        {"prompt": PROMPT, "num_steps": 6},
+        {"prompt": np.array([2, 9, 4], np.int32), "num_steps": 5,
+         "temperature": 0.7, "seed": 5},
+    ]
+    want = _unified_rows(fitted, reqs)
+    with ServingServer(_mk(fitted, "decode"), poll_s=0.005) as srv:
+        pair = DisaggPair([_mk(fitted, "prefill")], decode_addr=srv.addr,
+                          poll_s=0.005)
+        with pair:
+            rows = [pair.submit(**r).result(timeout=60.0) for r in reqs]
+        for got, ref in zip(rows, want):
+            np.testing.assert_array_equal(got, ref)
+        assert srv.engine.kv_blocks_in_use == 0
+        assert srv.engine.stats["kv_blocks_ingested"] > 0
+    _assert_zero_leak(pair)
+    assert pair.counters["requests_completed"] == len(reqs)
+
+
+def _prefilled(fitted, num_steps=6):
+    """Run a real prefill half inline and return its shipped artifacts."""
+    pre = _mk(fitted, "prefill")
+    h = pre.submit(PROMPT, num_steps)
+    pre.run_until_idle()
+    assert h.finish == "prefilled"
+    assert pre.kv_blocks_in_use == 0
+    return h.kvblocks, int(h.tokens[0])
+
+
+def test_hostile_kvblocks_frame_sheds_pool_untouched(fitted):
+    """A 'k' frame whose payload lies about its own geometry dies in
+    validate() (typed ProtocolError → the server's shed path) BEFORE any
+    engine call: protocol_errors increments, the decode pool never
+    allocates, and the server keeps serving."""
+    kvb, first = _prefilled(fitted)
+    # self-inconsistent: row counts no longer match num_blocks*block_size
+    torn = kvb.decoded()
+    for c in torn.layers:
+        if c is not None:
+            for k in list(c):
+                c[k] = c[k][:-1]
+    with ServingServer(_mk(fitted, "decode"), poll_s=0.005) as srv:
+        with ServingClient(*srv.addr) as c:
+            with pytest.raises((ConnectionError, OSError)):
+                c.submit_prefilled(torn, PROMPT, first, 6)
+                c.sock.recv(1)  # the shed path drops the connection
+        assert srv.protocol_errors == 1
+        assert srv.engine.kv_blocks_in_use == 0
+        assert srv.engine.stats["kv_blocks_ingested"] == 0
+        # the server survived: the intact block set decodes fine
+        with ServingClient(*srv.addr) as c:
+            rid = c.submit_prefilled(kvb, PROMPT, first, 6)
+            toks = []  # the stream starts at the prefill token
+            for chunk, done in c.stream(rid):
+                toks.extend(int(t) for t in chunk)
+                if done is not None:
+                    assert done["finish"] in ("length", "eos")
+                    break
+        (want,) = _unified_rows(fitted, [{"prompt": PROMPT,
+                                          "num_steps": 6}])
+        np.testing.assert_array_equal(np.asarray(toks, np.int32),
+                                      want[len(PROMPT):])
+        assert srv.engine.kv_blocks_in_use == 0
+
+
+def test_geometry_mismatch_rejected_typed(fitted):
+    """A self-consistent block set that doesn't match the DECODE engine's
+    arena geometry is a typed bad_request (engine-level ValueError), not
+    a dropped connection."""
+    kvb, first = _prefilled(fitted)
+    with ServingServer(_mk(fitted, "decode", block_size=8, kv_blocks=16),
+                       poll_s=0.005) as srv:
+        with ServingClient(*srv.addr) as c:
+            with pytest.raises(ValueError):
+                c.submit_prefilled(kvb, PROMPT, first, 6)
+        assert srv.protocol_errors == 0
+        assert srv.engine.kv_blocks_in_use == 0
+
+
+def test_torn_kvblocks_transfer_decode_pool_untouched(fitted):
+    """ChaosProxy tears the 'k' frame mid-transfer (half the payload,
+    then RST): the decode server sheds the torn frame with its pool
+    untouched and keeps serving the next, intact transfer."""
+    kvb, first = _prefilled(fitted)
+    with ServingServer(_mk(fitted, "decode"), poll_s=0.005) as srv:
+        with ChaosProxy(*srv.addr, protocol="serving",
+                        faults=[ChaosFault(0, 0, "tear")]) as px:
+            with ServingClient(*px.addr) as c:
+                with pytest.raises((ConnectionError, OSError)):
+                    c.submit_prefilled(kvb, PROMPT, first, 6)
+                    c.sock.recv(1)
+            # the proxy RSTs the client before the server's handler has
+            # necessarily observed the tear — wait for its accounting
+            deadline = time.monotonic() + 10.0
+            while (srv.protocol_errors + srv.disconnects == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert srv.protocol_errors + srv.disconnects >= 1
+            assert srv.engine.kv_blocks_in_use == 0
+            assert srv.engine.stats["kv_blocks_ingested"] == 0
+        # intact retry straight at the server completes
+        with ServingClient(*srv.addr) as c:
+            rid = c.submit_prefilled(kvb, PROMPT, first, 6)
+            for chunk, done in c.stream(rid):
+                if done is not None:
+                    assert done["finish"] in ("length", "eos")
+                    break
+        assert srv.engine.kv_blocks_in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# role-mode admission contracts + loadgen surface
+# ---------------------------------------------------------------------------
+
+def test_role_admission_contracts(fitted):
+    with pytest.raises(ValueError):
+        _mk(fitted, "decode").submit(PROMPT, 4)  # decode rejects submit
+    with pytest.raises(ValueError):
+        ServingEngine(fitted, num_slots=2, max_len=24, role="prefill")
+    with pytest.raises(ValueError):
+        DisaggPair([_mk(fitted, "unified")], decode=_mk(fitted, "decode"))
+    with pytest.raises(ValueError):
+        DisaggPair([_mk(fitted, "prefill")])  # neither decode nor addr
+
+
+def test_loadgen_bimodal_trace_and_disagg_builder():
+    from examples import loadgen
+    trace = loadgen.make_trace(40, num_steps=12, seed=0,
+                               prompt_lengths=(4, 24), pattern="bimodal",
+                               long_fraction=0.4)
+    lens = {len(r["prompt"]) for r in trace}
+    assert lens == {4, 24}
+    for r in trace:
+        assert r["num_steps"] == (3 if len(r["prompt"]) == 24 else 12)
+    _, pair = loadgen.build_engine(num_slots=2, max_len=32,
+                                   disaggregate=True, prefill_engines=2)
+    assert isinstance(pair, DisaggPair)
+    roles = [e.role for e in pair.engines]
+    assert roles == ["prefill", "prefill", "decode"]
+    assert networking.SERVING_OP_KVBLOCKS == b"k"

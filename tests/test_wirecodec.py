@@ -521,3 +521,152 @@ def test_native_rejects_u64_overflow_lengths(native):
         tampered[off:off + 8] = evil.to_bytes(8, "little")
         with pytest.raises(ValueError, match="Truncated"):
             native.decode_frames(bytes(tampered))
+
+
+# KV-block transfer node ('k' SERVING_OP_KVBLOCKS / __kvb__ — PR 16):
+# a prefill engine ships a request's filled paged-KV blocks (plus int8
+# scales, positions, RNG key) to a decode engine.  Like the sparse nodes,
+# the codecs frame the buffers and validate() is the transport-boundary
+# guard: hostile geometry must raise the typed ProtocolError before the
+# receiving pool allocates anything.
+
+def _kvb(int8=False, bs=4, nb=2, hkv=2, dh=3, seed=0):
+    """A 3-layer KVBlocks (layer 0 cache-less, like an embedding layer)."""
+    rng = np.random.default_rng(seed)
+    rows = nb * bs
+    layers = [None]
+    for _ in range(2):
+        if int8:
+            c = {"k": rng.integers(-127, 128, (rows, hkv, dh)).astype(
+                     np.int8),
+                 "v": rng.integers(-127, 128, (rows, hkv, dh)).astype(
+                     np.int8),
+                 "ks": rng.random((rows, hkv)).astype(np.float32),
+                 "vs": rng.random((rows, hkv)).astype(np.float32)}
+        else:
+            c = {"k": rng.standard_normal((rows, hkv, dh)).astype(
+                     np.float32),
+                 "v": rng.standard_normal((rows, hkv, dh)).astype(
+                     np.float32)}
+        layers.append(c)
+    return networking.KVBlocks(layers, bs, nb, positions=rows - 1,
+                               key=np.array([0, 11], np.uint32))
+
+
+def test_kvblocks_opcode_distinct():
+    ops = (networking.SERVING_OP_ENQUEUE, networking.SERVING_OP_STREAM,
+           networking.SERVING_OP_CANCEL, networking.SERVING_OP_KVBLOCKS)
+    assert len(networking.SERVING_OP_KVBLOCKS) == 1
+    assert len(set(ops)) == len(ops)
+
+
+@pytest.mark.parametrize("int8", [False, True],
+                         ids=["dense", "int8-scales"])
+def test_kvblocks_roundtrip_either_codec(codec, int8):
+    """__kvb__ survives both codecs bit for bit: block geometry, positions,
+    RNG key, per-layer k/v payloads (and int8 codes + per-entry scales),
+    None layers preserved positionally."""
+    kvb = _kvb(int8=int8)
+    frame = {"blocks": kvb, "prompt": np.array([1, 2, 3], np.int32),
+             "first_token": 9, "num_steps": 4}
+    out = networking.decode_message(networking.encode_message(frame))
+    got = out["blocks"]
+    assert isinstance(got, networking.KVBlocks)
+    assert got.block_size == kvb.block_size
+    assert got.num_blocks == kvb.num_blocks
+    assert got.positions == kvb.positions
+    np.testing.assert_array_equal(got.key, kvb.key)
+    assert got.key.dtype == np.uint32
+    assert len(got.layers) == len(kvb.layers)
+    assert got.layers[0] is None
+    for mine, want in zip(got.layers[1:], kvb.layers[1:]):
+        assert sorted(mine) == sorted(want)
+        for k in want:
+            np.testing.assert_array_equal(mine[k], want[k])
+            assert mine[k].dtype == want[k].dtype
+    assert got.nbytes == kvb.nbytes
+    got.validate()  # a clean round trip must stay admissible
+
+
+def test_kvblocks_pooled_recv_decoded_either_codec(codec):
+    """Through the zero-copy pooled path the payloads are views into the
+    reusable recv buffer; decoded() detaches them (what ServingServer
+    must do before queueing past the next recv)."""
+    pool = networking.BufferPool()
+    kvb = _kvb(int8=True)
+    a, b = socket.socketpair()
+    try:
+        for _ in range(2):
+            t = threading.Thread(target=networking.send_data,
+                                 args=(a, {"blocks": kvb}))
+            t.start()
+            out = networking.recv_data(b, pool=pool)
+            t.join()
+            got = out["blocks"]
+            assert not got.layers[1]["k"].flags["OWNDATA"]
+            det = got.validate().decoded()
+            assert det.layers[1]["k"].flags["OWNDATA"]
+            np.testing.assert_array_equal(det.layers[1]["k"],
+                                          kvb.layers[1]["k"])
+            np.testing.assert_array_equal(det.layers[2]["vs"],
+                                          kvb.layers[2]["vs"])
+        assert pool.misses == 1 and pool.hits == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def _corrupt(kvb, how):
+    if how == "zero-blocks":
+        kvb.num_blocks = 0
+    elif how == "positions-zero":
+        kvb.positions = 0
+    elif how == "positions-overflow":
+        kvb.positions = kvb.num_blocks * kvb.block_size + 1
+    elif how == "missing-v":
+        del kvb.layers[1]["v"]
+    elif how == "unknown-payload":
+        kvb.layers[1]["evil"] = kvb.layers[1]["k"]
+    elif how == "row-count-lie":
+        kvb.layers[1]["k"] = kvb.layers[1]["k"][:-1]
+        kvb.layers[1]["v"] = kvb.layers[1]["v"][:-1]
+    elif how == "kv-dtype-split":
+        kvb.layers[1]["v"] = kvb.layers[1]["v"].astype(np.float64)
+    elif how == "half-scales":
+        del kvb.layers[1]["vs"]
+    elif how == "scales-on-dense":
+        kvb.layers[1]["ks"] = np.ones(kvb.layers[1]["k"].shape[:2],
+                                      np.float32)
+        kvb.layers[1]["vs"] = kvb.layers[1]["ks"]
+    elif how == "scale-shape-lie":
+        kvb.layers[1]["ks"] = kvb.layers[1]["ks"][:, :1]
+    elif how == "no-layers":
+        kvb.layers = [None, None, None]
+    elif how == "signed-key":
+        kvb.key = np.array([-1, 2], np.int64)
+    return kvb
+
+
+@pytest.mark.parametrize("how", [
+    "zero-blocks", "positions-zero", "positions-overflow", "missing-v",
+    "unknown-payload", "row-count-lie", "kv-dtype-split", "no-layers",
+    "signed-key"])
+def test_kvblocks_hostile_rejects_either_codec(codec, how):
+    """Hostile/torn block frames survive the codec (it frames buffers,
+    it doesn't interpret them) but validate() rejects with the typed
+    ProtocolError — the serving server's ValueError shed path."""
+    kvb = _corrupt(_kvb(), how)
+    out = networking.decode_message(
+        networking.encode_message({"blocks": kvb}))["blocks"]
+    with pytest.raises(networking.ProtocolError):
+        out.validate()
+
+
+@pytest.mark.parametrize("how", ["half-scales", "scales-on-dense",
+                                 "scale-shape-lie"])
+def test_kvblocks_hostile_scale_rejects_either_codec(codec, how):
+    kvb = _corrupt(_kvb(int8=(how != "scales-on-dense")), how)
+    out = networking.decode_message(
+        networking.encode_message({"blocks": kvb}))["blocks"]
+    with pytest.raises(networking.ProtocolError):
+        out.validate()
