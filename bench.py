@@ -885,6 +885,92 @@ def serving_bench(budget_s: float = 90.0):
     return out
 
 
+def serving_fleet_bench(budget_s: float = 90.0):
+    """Replicated-fleet routing observables (distkeras_tpu/router.py):
+
+     - ``serving_fleet_tokens_per_sec`` — the SAME closed-loop trace
+       through a ``ServingRouter`` at N ∈ {1, 2, 4} in-process replicas
+       (concurrency scaled with N so offered load tracks capacity): the
+       fleet-scaling curve, keyed by replica count.
+     - ``serving_fleet_prefix_hit_rate`` — a multi-tenant shared-prefix
+       trace through a 2-replica PAGED fleet under ``affinity="prefix"``
+       vs the seeded ``"random"`` control arm: cache-aware routing holds
+       the fleet-wide radix hit rate where random scatters tenants
+       across cold tries.
+     - ``serving_fleet_failover_lost_requests`` — accepted requests that
+       failed to complete after one of two replicas is killed under
+       load.  MUST be 0: typed ``EngineDead`` + seeded resubmission is
+       the zero-loss contract tests/test_router.py pins bit-exactly.
+
+    Returns Nones on overrun/failure — never fatal to the artifact.
+    """
+    sys.path.insert(0, os.path.join(_REPO, "examples"))
+    import loadgen
+
+    none = {"serving_fleet_tokens_per_sec": None,
+            "serving_fleet_prefix_hit_rate": None,
+            "serving_fleet_failover_lost_requests": None}
+    if budget_s < 10.0:
+        return none
+    t0 = time.perf_counter()
+    out = dict(none)
+    # fleet scaling: identical trace + per-replica knobs, N in {1, 2, 4}
+    scaling = {}
+    trace = loadgen.make_trace(24, num_steps=8, temperature=0.7)
+    for n in (1, 2, 4):
+        _, router = loadgen.build_fleet(replicas=n,
+                                        affinity="least-loaded",
+                                        num_slots=2)
+        try:
+            closed = loadgen.run_closed_loop(router, trace,
+                                             concurrency=4 * n,
+                                             timeout_s=budget_s)
+        finally:
+            router.stop()
+        scaling[str(n)] = closed["tokens_per_sec"]
+        if time.perf_counter() - t0 > budget_s * 0.5:
+            break
+    out["serving_fleet_tokens_per_sec"] = scaling
+    if time.perf_counter() - t0 > budget_s * 0.6:
+        return out
+    # cache-aware routing vs the control arm: same tenanted trace, same
+    # paged fleet, only the dispatch policy differs
+    hit = {}
+    ptrace = loadgen.make_trace(24, num_steps=4, prefix_groups=4,
+                                prefix_len=12)
+    for policy in ("prefix", "random"):
+        _, router = loadgen.build_fleet(replicas=2, affinity=policy,
+                                        paged=True, block_size=4)
+        try:
+            closed = loadgen.run_closed_loop(router, ptrace,
+                                             concurrency=4,
+                                             timeout_s=budget_s)
+        finally:
+            router.stop()
+        hit[policy] = closed["prefix_hit_rate"]
+    out["serving_fleet_prefix_hit_rate"] = hit
+    if time.perf_counter() - t0 > budget_s * 0.85:
+        return out
+    # zero-loss failover: one of two replicas dies with requests queued
+    # and mid-stream; seeded resubmission must complete every one
+    _, router = loadgen.build_fleet(replicas=2, affinity="least-loaded",
+                                    num_slots=2)
+    ftrace = loadgen.make_trace(12, num_steps=8, seed=5, temperature=0.7)
+    router.start()
+    try:
+        handles = [router.submit(block=True, timeout=budget_s, **req)
+                   for req in ftrace]
+        router.engines[0].declare_dead("bench: fleet failover leg")
+        lost = 0
+        for h in handles:
+            if not h.wait(timeout=budget_s) or h.error is not None:
+                lost += 1
+        out["serving_fleet_failover_lost_requests"] = lost
+    finally:
+        router.stop()
+    return out
+
+
 def main():
     t_start = time.perf_counter()
     debug = os.environ.get("DISTKERAS_BENCH_DEBUG", "") == "1"
@@ -1191,6 +1277,20 @@ def main():
         except Exception as e:
             print(f"[bench] serving bench failed: {e}", file=sys.stderr)
     result.update(serving_fields)
+    # replicated-fleet routing (router.py): scaling curve, cache-aware
+    # routing vs the random control arm, and the zero-loss failover count
+    stage("serving fleet routing")
+    fleet_fields = {"serving_fleet_tokens_per_sec": None,
+                    "serving_fleet_prefix_hit_rate": None,
+                    "serving_fleet_failover_lost_requests": None}
+    fleet_remaining = budget - (time.perf_counter() - t_start)
+    if fleet_remaining > 45:
+        try:
+            fleet_fields = serving_fleet_bench(budget_s=fleet_remaining)
+        except Exception as e:
+            print(f"[bench] serving fleet bench failed: {e}",
+                  file=sys.stderr)
+    result.update(fleet_fields)
     # the train-while-serve loop (deployment_online.py): freshness
     # percentiles + served accuracy under drift on the live deployment
     stage("online deployment")

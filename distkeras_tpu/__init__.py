@@ -24,6 +24,8 @@ from .predictors import Predictor, ModelPredictor
 from . import serving
 from .serving import (Draining, EngineDead, QueueFull, RequestHandle,
                       ServingClient, ServingEngine, ServingServer)
+from . import router
+from .router import ServingRouter
 from .evaluators import (Evaluator, AccuracyEvaluator, AUCEvaluator,
                          F1Evaluator, LossEvaluator, TopKAccuracyEvaluator)
 from . import utils
@@ -37,8 +39,8 @@ from . import ps_sharding
 from . import parameter_servers
 from . import resilience
 from .ps_sharding import PSShardDown
-from .resilience import (EngineSupervisor, LeaseLedger, RetryPolicy,
-                         ShardSupervisor, WorkerSupervisor)
+from .resilience import (EngineSupervisor, FleetSupervisor, LeaseLedger,
+                         RetryPolicy, ShardSupervisor, WorkerSupervisor)
 from .networking import ChaosFault, ChaosProxy
 from . import job_deployment
 from . import checkpoint

@@ -685,6 +685,73 @@ def connect(host: str, port: int, disable_nagle: bool = True,
     return sock
 
 
+class ClientPool:
+    """Router-side connection pooling: a bounded per-address free list of
+    reusable client objects (anything with a ``close()``), so a
+    :class:`serving.ServingRouter` streaming thousands of requests to a
+    handful of replica addresses re-dials only on growth or after a
+    transport fault instead of once per request.
+
+    ``factory(addr)`` builds a fresh client for an address (the router
+    passes ``lambda a: ServingClient(*a)``).  ``acquire`` pops an idle
+    client for the address or dials a new one; ``release`` returns it to
+    the free list (closed instead once ``max_idle_per_addr`` are already
+    parked — the pool bounds idle sockets, not concurrency); ``discard``
+    closes a client whose connection is suspect (any transport fault —
+    a pooled client is only reusable while its request/reply stream is
+    in a clean between-frames state).  ``close`` empties every free list.
+
+    The free lists are lock-protected; the clients themselves are NOT
+    made thread-safe by pooling — one acquirer uses one client at a time,
+    which is exactly the borrow/return discipline the pool enforces.
+    """
+
+    def __init__(self, factory, max_idle_per_addr: int = 4):
+        self._factory = factory
+        self._idle: Dict[Any, List[Any]] = {}
+        self._lock = threading.Lock()
+        self.max_idle_per_addr = int(max_idle_per_addr)
+        self.dials = 0     # fresh clients built
+        self.reuses = 0    # acquisitions served from the free list
+        self.discards = 0  # clients dropped on suspicion
+
+    def acquire(self, addr):
+        with self._lock:
+            free = self._idle.get(addr)
+            if free:
+                self.reuses += 1
+                return free.pop()
+            self.dials += 1
+        return self._factory(addr)
+
+    def release(self, addr, client) -> None:
+        with self._lock:
+            free = self._idle.setdefault(addr, [])
+            if len(free) < self.max_idle_per_addr:
+                free.append(client)
+                return
+        self._close_one(client)
+
+    def discard(self, client) -> None:
+        with self._lock:
+            self.discards += 1
+        self._close_one(client)
+
+    def close(self) -> None:
+        with self._lock:
+            clients = [c for free in self._idle.values() for c in free]
+            self._idle.clear()
+        for c in clients:
+            self._close_one(c)
+
+    @staticmethod
+    def _close_one(client) -> None:
+        try:
+            client.close()
+        except OSError:
+            pass
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     chunks = []
     remaining = n
@@ -1052,6 +1119,13 @@ SERVING_OP_CANCEL = b"x"
 #: server acks ``{"ok", "id"}`` exactly like an enqueue and the reply
 #: stream rides the ordinary ``'r'`` opcode.
 SERVING_OP_KVBLOCKS = b"k"
+#: ``'s'`` load/stats probe (fleet routing): the server replies with the
+#: engine's lock-free :meth:`serving.ServingEngine.load` snapshot (queue
+#: depth, free slots, trie-cached block count, draining/dead flags) — the
+#: signal a :class:`serving.ServingRouter` dispatches on.  Read-only, no
+#: request body; deliberately NOT ``'h'`` (the PS heartbeat byte) so the
+#: two protocols' namespaces stay collision-free where possible.
+SERVING_OP_STATS = b"s"
 
 #: PS-protocol opcodes (``parameter_servers.*SocketParameterServer`` —
 #: reference protocol ``'p'`` pull / ``'c'`` commit, plus ``'u'`` update
@@ -1264,7 +1338,7 @@ class ChaosProxy:
                       SERVING_OP_CANCEL, SERVING_OP_KVBLOCKS) if serving
                      else (PS_OP_COMMIT, PS_OP_UPDATE))
         reply_ops = ((SERVING_OP_ENQUEUE, SERVING_OP_CANCEL,
-                      SERVING_OP_KVBLOCKS) if serving
+                      SERVING_OP_KVBLOCKS, SERVING_OP_STATS) if serving
                      else (PS_OP_PULL, PS_OP_UPDATE, PS_OP_HEARTBEAT))
         op_index = 0
         try:

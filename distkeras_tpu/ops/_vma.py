@@ -21,9 +21,16 @@ import jax
 
 _EMPTY = frozenset()
 
+# jax < 0.6 has neither ``jax.typeof`` nor vma tracking in shard_map
+# (check_vma arrived with the vma-typed shard_map) — there is nothing to
+# plumb, so every value reads as unvarying and both helpers no-op.
+_typeof = getattr(jax, "typeof", None)
+
 
 def _vma_of(x):
-    return getattr(jax.typeof(x), "vma", None) or _EMPTY
+    if _typeof is None:
+        return _EMPTY
+    return getattr(_typeof(x), "vma", None) or _EMPTY
 
 
 def out_struct(shape, dtype, like):

@@ -21,6 +21,8 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from . import _compat
+
 tmap = jax.tree_util.tree_map
 
 STAGE_AXIS = "stage"
@@ -48,7 +50,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
     # 'data' when the pipeline composes with data parallelism inside one
     # shard_map), plus the stage axis the ring introduces.  stage_fn must
     # not make its output vary over further mesh axes beyond these.
-    varying = lambda a: jax.lax.pcast(a, axis_name, to="varying")
+    varying = lambda a: _compat.pcast(a, axis_name, to="varying")
     buf0 = varying(jnp.zeros_like(x_micro[0]))
     out0 = varying(jnp.zeros_like(x_micro, jnp.float32))
 
@@ -134,16 +136,15 @@ def pipeline_1f1b(stage_fn, stage_params, x_micro, labels_micro,
     def _cast_varying(a, axes):
         # idempotent pcast: add only the axes the value doesn't carry yet
         missing = tuple(ax for ax in axes
-                        if ax not in getattr(jax.typeof(a), "vma", ()))
-        return jax.lax.pcast(a, missing, to="varying") if missing else a
+                        if ax not in _compat.vma_of(a))
+        return _compat.pcast(a, missing, to="varying") if missing else a
 
     # activation-shaped carries follow the data: varying over the ring
     # axis AND whatever outer axes the microbatches vary over (e.g. 'data'
     # when composed with data parallelism).  Gradient accumulators are
     # ring-varying only — the vjp's replication transpose data-psums the
     # param cotangents before they reach the accumulator.
-    batch_axes = tuple(getattr(jax.typeof(x_micro), "vma", ())) \
-        + (axis_name,)
+    batch_axes = tuple(_compat.vma_of(x_micro)) + (axis_name,)
     varying = lambda a: _cast_varying(a, batch_axes)
     varying_ring = lambda a: _cast_varying(a, (axis_name,))
     zeros_like_v = lambda t: tmap(

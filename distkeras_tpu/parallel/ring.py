@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import _compat
+
 SEQ_AXIS = "seq"
 
 
@@ -135,7 +137,7 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
     # over the ring axis so the scan carry types stay fixed once the online
     # update makes them data-dependent (attending the own block below also
     # picks up whatever outer shard_map axes q/k/v vary over)
-    varying = lambda a: jax.lax.pcast(a, axis_name, to="varying")
+    varying = lambda a: _compat.pcast(a, axis_name, to="varying")
     acc0 = (varying(jnp.zeros((b, hkv, g, s_loc, d), jnp.float32)),
             varying(jnp.zeros((b, hkv, g, s_loc), jnp.float32)),
             varying(jnp.full((b, hkv, g, s_loc), -jnp.inf, jnp.float32)))
@@ -166,7 +168,7 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
     over ``mesh[axis_name]``, ring attention, global array out.  For models
     already running under shard_map, call ``ring_attention`` directly."""
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = _compat.shard_map(
         lambda a, b_, c: ring_attention(a, b_, c, axis_name, causal, scale,
                                         block_k, window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)

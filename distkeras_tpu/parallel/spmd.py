@@ -38,6 +38,8 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import _compat
+
 from ..core.model import Sequential
 from ..core.losses import get_loss
 from ..core import optimizers as opt_lib
@@ -172,7 +174,7 @@ class SPMDEngine:
                 # worker-side copy must stay device-varying for the
                 # P(WORKER_AXIS) out_spec; the center copy stays unvarying
                 p = {**p, "stats": tmap(
-                    lambda v: jax.lax.pcast(v, WORKER_AXIS, to="varying"),
+                    lambda v: _compat.pcast(v, WORKER_AXIS, to="varying"),
                     mean)}
                 c = {**c, "stats": mean}
             out_p.append(p)
@@ -206,7 +208,7 @@ class SPMDEngine:
                 # "pull": start from the replicated center; mark it
                 # device-varying so the per-worker scan carry typechecks.
                 start = tmap(
-                    lambda v: jax.lax.pcast(v, WORKER_AXIS, to="varying"),
+                    lambda v: _compat.pcast(v, WORKER_AXIS, to="varying"),
                     center)
             else:  # EASGD family + 'local' keep persistent local params
                 start = local_p
@@ -261,7 +263,7 @@ class SPMDEngine:
         """The single shard_map'd round program — the one contract both the
         scanned epoch and the streaming path execute."""
         data_spec = (P(None, WORKER_AXIS),) * (4 if self.packed else 3)
-        return jax.shard_map(
+        return _compat.shard_map(
             self._make_round_fn(),
             mesh=self.mesh,
             in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P())

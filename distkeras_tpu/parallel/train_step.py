@@ -23,6 +23,8 @@ import jax
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import _compat
+
 tmap = jax.tree_util.tree_map
 
 
@@ -152,7 +154,7 @@ def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
                                is_leaf=lambda x: isinstance(x, P))
         opt_state = jax.jit(optimizer.init, out_shardings=ns(opt_sp))(params)
 
-        grads_fn = jax.shard_map(
+        grads_fn = _compat.shard_map(
             loss_and_grads, mesh=mesh,
             in_specs=(param_specs, batch_spec, batch_spec),
             out_specs=(P(), param_specs))
@@ -188,7 +190,7 @@ def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
                            is_leaf=lambda x: isinstance(x, P)))(params)
 
     if zero_axis is not None:
-        grads_fn = jax.shard_map(
+        grads_fn = _compat.shard_map(
             loss_and_grads, mesh=mesh,
             in_specs=(param_specs, batch_spec, batch_spec),
             out_specs=(P(), param_specs))
@@ -212,7 +214,7 @@ def build_train_step(mesh: Mesh, local_loss, param_specs, batch_spec,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(_compat.shard_map(
         local_step, mesh=mesh,
         in_specs=(param_specs, opt_sp, batch_spec, batch_spec),
         out_specs=(param_specs, opt_sp, P())),

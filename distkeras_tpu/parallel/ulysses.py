@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import _compat
+
 from .ring import SEQ_AXIS
 
 
@@ -68,7 +70,7 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
     """
     from ..ops.attention import attention
 
-    sp = jax.lax.axis_size(axis_name)
+    sp = _compat.axis_size(axis_name)
     b, s_loc, h, dh = q.shape
     hkv = k.shape[2]
     if h % sp:
@@ -104,7 +106,7 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
     models already running under shard_map, call ``ulysses_attention``
     directly (same shape as ``ring.ring_self_attention``)."""
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = _compat.shard_map(
         lambda a, b_, c: ulysses_attention(a, b_, c, axis_name, causal,
                                            scale, window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)

@@ -150,6 +150,31 @@ class RetryPolicy:
                 time.sleep(d)
         raise last  # type: ignore[misc]
 
+    def call_reconnecting(self, fn: Callable[[], Any],
+                          reconnect: Callable[[], None],
+                          retry_on: tuple,
+                          reconnect_on: tuple = (ConnectionError,
+                                                 OSError)) -> Any:
+        """:meth:`call`, with a transport-repair step between attempts:
+        when ``fn`` raises one of ``reconnect_on``, ``reconnect()`` runs
+        best-effort (its own ``OSError`` is swallowed — the endpoint may
+        still be down, and the policy's backoff covers the wait) before
+        the failure re-enters the retry loop.  This is the ONE
+        re-dial-and-resubmit shape shared by ``ServingClient.generate``
+        and a ``ServingRouter``'s replica resubmission — idempotent only
+        because requests are deterministic in their seed (the PR 8
+        contract), so callers must not use it for non-seeded effects."""
+        def attempt() -> Any:
+            try:
+                return fn()
+            except reconnect_on:
+                try:
+                    reconnect()
+                except OSError:
+                    pass  # endpoint still down: keep backing off
+                raise
+        return self.call(attempt, retry_on=retry_on)
+
     def describe(self) -> str:
         if self.attempts is not None:
             return str(int(self.attempts))
@@ -673,6 +698,97 @@ class PairSupervisor:
             s.stop()
 
     def __enter__(self) -> "PairSupervisor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class FleetSupervisor:
+    """Supervise every in-process replica of a ``router.ServingRouter`` —
+    one :class:`EngineSupervisor` per replica engine, each restarting
+    through ``respawn_clone`` and swapping the replacement into the fleet
+    via the router's ``replace_engine`` (the same ``_PairSlot`` seam the
+    disaggregated pair uses: the router rebinds the replica and bumps its
+    generation atomically under its own lock).
+
+    The failure story is the router's: while a replica is down its
+    in-flight requests are already being resubmitted to surviving
+    replicas (typed ``EngineDead`` + seeded resubmission — zero accepted
+    requests lost), so this supervisor restores CAPACITY, not
+    correctness.  Wire replicas (remote addresses) are not supervised
+    here — their engines live in another process behind their own
+    supervisor.
+
+    Elastic fleets change membership; call :meth:`refresh` after
+    ``scale_up``/``scale_down`` so supervision tracks the current
+    replica set."""
+
+    def __init__(self, router, **supervisor_kw):
+        self.router = router
+        self._kw = supervisor_kw
+        self._running = False
+        self.supervisors: List[EngineSupervisor] = []
+        self.refresh()
+
+    def refresh(self) -> "FleetSupervisor":
+        """Re-sync supervision with the router's CURRENT in-process
+        replica set: new replicas gain a supervisor (started if the
+        fleet supervisor is running), removed replicas' supervisors are
+        stopped and dropped.  Identity is the engine object — a swapped
+        replacement is already tracked via its slot's setter."""
+        current = {id(s.target.engine): s for s in self.supervisors}
+        keep: List[EngineSupervisor] = []
+        live_ids = set()
+        for eng in self.router.engines:
+            live_ids.add(id(eng))
+            sup = current.get(id(eng))
+            if sup is None:
+                sup = EngineSupervisor(_PairSlot(self.router, eng),
+                                       **self._kw)
+                if self._running:
+                    sup.start()
+            keep.append(sup)
+        for sup in self.supervisors:
+            if id(sup.target.engine) not in live_ids and sup not in keep:
+                sup.stop()
+        self.supervisors = keep
+        return self
+
+    @property
+    def restarts(self) -> int:
+        return sum(s.restarts for s in self.supervisors)
+
+    @property
+    def recoveries(self) -> List[Dict[str, Any]]:
+        return [r for s in self.supervisors for r in s.recoveries]
+
+    def check_all(self) -> List[Optional[str]]:
+        """One synchronous liveness probe per supervised replica (the
+        loop-free form tier-1 tests drive)."""
+        return [s.check() for s in self.supervisors]
+
+    def recover_all(self) -> List[Dict[str, Any]]:
+        """Probe + recover every unhealthy replica once, synchronously."""
+        out = []
+        for s in self.supervisors:
+            reason = s.check()
+            if reason is not None:
+                out.append(s._recover(reason))
+        return out
+
+    def start(self) -> "FleetSupervisor":
+        self._running = True
+        for s in self.supervisors:
+            s.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        for s in self.supervisors:
+            s.stop()
+
+    def __enter__(self) -> "FleetSupervisor":
         return self.start()
 
     def __exit__(self, *exc) -> None:

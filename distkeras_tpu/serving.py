@@ -498,6 +498,10 @@ class _PagedKVPool:
         self.private_out = 0
         self._clock = 0
         self.epoch = 0
+        #: incremental mirror of ``cached_blocks()`` — kept so the
+        #: engine's lock-free load snapshot reads an int instead of
+        #: walking the trie (O(nodes)) on every publish
+        self.trie_nodes = 0
 
     # -- clocks ------------------------------------------------------------
     def _tick(self) -> int:
@@ -585,6 +589,7 @@ class _PagedKVPool:
             victim = min(cands, key=lambda n: n.last_used)
             del victim.parent.children[victim.key]
             self.free.append(victim.block)
+            self.trie_nodes -= 1
             self.stats["blocks_evicted"] += 1
         return True
 
@@ -654,6 +659,7 @@ class _PagedKVPool:
             plan.nodes.append(node)
             plan.private.remove(plan.blocks[i])
             self.private_out -= 1
+            self.trie_nodes += 1
             parent = node
             i += 1
 
@@ -1094,6 +1100,30 @@ class ServingEngine:
             self._pool = _PagedKVPool(self.kv_blocks, self.block_size,
                                       share=not self.rolling,
                                       stats=self.stats)
+
+        # -- lock-free load snapshot (the routing surface).  A plain dict
+        #    republished by REFERENCE assignment from submit/step/drain/
+        #    death sites — always OUTSIDE the admission-lock blocks, so
+        #    readers (`load()`, a ServingRouter's dispatch loop, the wire
+        #    's' probe) never touch `_qlock` or the scheduler's hot path.
+        #    Values may lag one scheduler iteration; routing only needs a
+        #    recent signal, not a linearizable one.
+        self._load_snapshot: Dict[str, Any] = {
+            "queue_depth": 0,
+            "slots_free": self.num_slots,
+            "slots_total": self.num_slots,
+            "active": 0,
+            "trie_blocks": 0,
+            "queue_capacity": self.queue_capacity,
+            "max_len": self.max_len,
+            "draining": False,
+            "dead": False,
+            "prefix_hit_tokens": 0,
+            "prefill_tokens": 0,
+            "tokens_generated": 0,
+            "requests_completed": 0,
+            "requests_failed": 0,
+        }
 
     # ------------------------------------------------------------------ jit
     def _build_step_fn(self):
@@ -1945,6 +1975,8 @@ class ServingEngine:
             self.stats["queue_peak"] = max(self.stats["queue_peak"],
                                            len(self._queue))
             self._have_work.notify()
+            qd = len(self._queue)
+        self._publish_load(qd=qd)
         return handle
 
     def submit_prefilled(self, blocks, prompt, first_token: int,
@@ -2064,6 +2096,8 @@ class ServingEngine:
             self.stats["queue_peak"] = max(self.stats["queue_peak"],
                                            len(self._queue))
             self._have_work.notify()
+            qd = len(self._queue)
+        self._publish_load(qd=qd)
         return handle
 
     @property
@@ -2075,13 +2109,57 @@ class ServingEngine:
     def active_requests(self) -> int:
         return int(self._active.sum())
 
+    # --------------------------------------------------- load snapshot
+    def _publish_load(self, qd: Optional[int] = None,
+                      draining: Optional[bool] = None,
+                      dead: Optional[bool] = None) -> None:
+        """Republish the lock-free load snapshot (see ``__init__``).
+
+        Must be called OUTSIDE any ``_qlock`` block: callers that need an
+        exact queue depth capture it under the lock and pass it in; a
+        ``None`` field carries the previous snapshot's value forward.
+        Everything else read here is scheduler-confined (``_free``,
+        ``_active``, the trie counter) or an already-synchronised stats
+        counter — stale-by-one is fine for routing."""
+        prev = self._load_snapshot
+        stats = self.stats
+        self._load_snapshot = {
+            "queue_depth": prev["queue_depth"] if qd is None else int(qd),
+            "slots_free": len(self._free),
+            "slots_total": self.num_slots,
+            "active": int(self._active.sum()),
+            "trie_blocks": (self._pool.trie_nodes if self.paged else 0),
+            "queue_capacity": self.queue_capacity,
+            "max_len": self.max_len,
+            "draining": (prev["draining"] if draining is None
+                         else bool(draining)),
+            "dead": prev["dead"] if dead is None else bool(dead),
+            "prefix_hit_tokens": stats["prefix_hit_tokens"],
+            "prefill_tokens": stats["prefill_tokens"],
+            "tokens_generated": stats["tokens_generated"],
+            "requests_completed": stats["requests_completed"],
+            "requests_failed": stats["requests_failed"],
+        }
+
+    def load(self) -> Dict[str, Any]:
+        """Cheap read-only load snapshot for routing decisions: queue
+        depth, free/total slots, active requests, prefix-trie cached block
+        count, draining/dead flags, and a few throughput counters.  Takes
+        NO locks (the snapshot dict is republished by reference from the
+        scheduler/submit paths), so a router may poll it at any rate
+        without perturbing the hot path.  Values may trail the engine by
+        one scheduler iteration."""
+        return dict(self._load_snapshot)
+
     def _pop_queued(self) -> Optional[RequestHandle]:
         with self._qlock:
             if not self._queue:
                 return None
             h = self._queue.popleft()
             self._not_full.notify()
-            return h
+            qd = len(self._queue)
+        self._publish_load(qd=qd)
+        return h
 
     # ------------------------------------------------- cancel + deadlines
     def cancel(self, handle: RequestHandle) -> bool:
@@ -2736,6 +2814,7 @@ class ServingEngine:
             # lookahead entry out of the pipeline)
             if self._pending:
                 did = self._drain_pending(flush=True) or did
+            self._publish_load()
             return did
         if self._active.any():
             self._decode_once()
@@ -2746,6 +2825,7 @@ class ServingEngine:
                 and self.stats["decode_steps"] > steps_before
                 and self.stats["decode_steps"] % self._reload_every == 0):
             self._pull_weights()
+        self._publish_load()
         return did
 
     def _decode_once(self) -> None:
@@ -2920,6 +3000,7 @@ class ServingEngine:
         with self._qlock:
             self._draining = True
             self._not_full.notify_all()  # blocked submitters raise Draining
+        self._publish_load(draining=True)
         t0 = time.monotonic()
 
         def busy() -> bool:
@@ -2997,6 +3078,7 @@ class ServingEngine:
             if h._fail(EngineDead(str(exc)), reason=reason):
                 with self._qlock:  # drain()'s busy() sums this cross-thread
                     self.stats["requests_failed"] += 1
+        self._publish_load(qd=0, dead=True)
 
     @property
     def dead(self) -> Optional[BaseException]:
@@ -3352,6 +3434,7 @@ OP_ENQUEUE = networking.SERVING_OP_ENQUEUE
 OP_STREAM = networking.SERVING_OP_STREAM
 OP_CANCEL = networking.SERVING_OP_CANCEL
 OP_KVBLOCKS = networking.SERVING_OP_KVBLOCKS
+OP_STATS = networking.SERVING_OP_STATS
 
 
 class ServingServer:
@@ -3630,6 +3713,12 @@ class ServingServer:
                     networking.send_data(
                         conn, {"ok": True, "cancelled": bool(ok)},
                         pool=send_pool)
+                elif op == OP_STATS:
+                    # load probe (no request body): the engine's lock-free
+                    # snapshot, the signal a ServingRouter dispatches on
+                    networking.send_data(
+                        conn, {"ok": True, "load": self.engine.load()},
+                        pool=send_pool)
                 else:
                     return  # protocol violation: drop the connection
         except ValueError:
@@ -3870,6 +3959,18 @@ class ServingClient:
         ack = networking.recv_data(self.sock, pool=self._pool)
         return bool(ack.get("cancelled"))
 
+    def load(self) -> Dict[str, Any]:
+        """Probe the server's engine load (``SERVING_OP_STATS``): the
+        lock-free :meth:`ServingEngine.load` snapshot — queue depth, free
+        slots, trie-cached block count, draining/dead flags.  Cheap enough
+        for a router to poll per dispatch."""
+        networking.send_opcode(self.sock, OP_STATS)
+        reply = networking.recv_data(self.sock, pool=self._pool)
+        if not reply.get("ok"):
+            _raise_typed(reply.get("kind"),
+                         str(reply.get("error", "stats probe rejected")))
+        return dict(reply["load"])
+
     def stream(self, rid: int):
         """Yield ``(tokens, done_reply)`` chunk by chunk; ``done_reply`` is
         None until the final frame (which carries ``finish`` —
@@ -3907,19 +4008,8 @@ class ServingClient:
 
         if retry_policy is None:
             return attempt()
-
-        def redialing_attempt() -> np.ndarray:
-            try:
-                return attempt()
-            except (ConnectionError, OSError):
-                try:
-                    self._redial()
-                except OSError:
-                    pass  # server still down: the policy keeps backing off
-                raise
-
-        return retry_policy.call(
-            redialing_attempt,
+        return retry_policy.call_reconnecting(
+            attempt, self._redial,
             retry_on=(EngineDead, ConnectionError, OSError))
 
 

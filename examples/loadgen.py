@@ -257,12 +257,25 @@ def run_closed_loop(engine, trace: Sequence[Dict[str, Any]],
     # killed users cancel and move on without waiting for the retirement,
     # so the scheduler may be one iteration away from reaping the last
     # cancel — let terminal accounting settle (bounded) before reading it
+    # routers/pairs book cancels and expiries in their own terminal
+    # counters, NOT in requests_completed (a bare engine books them in
+    # both — adding them there would double-count half-reaped kills)
+    own_counters = hasattr(engine, "counters")
+
+    def _terminal(s):
+        t = (s["requests_completed"] + s["requests_failed"]
+             + s["requests_rejected"])
+        if own_counters:
+            t += s.get("requests_cancelled", 0) + s.get(
+                "requests_expired", 0)
+        return t
+
     s = engine.stats
     settle_deadline = time.perf_counter() + 10.0
-    while (s["requests_submitted"] > s["requests_completed"]
-           + s["requests_failed"] + s["requests_rejected"]
+    while (s["requests_submitted"] > _terminal(s)
            and time.perf_counter() < settle_deadline):
         time.sleep(0.005)
+        s = engine.stats
     return _metrics(engine, latencies, wall,
                     engine.stats["tokens_generated"] - tokens0,
                     engine.stats["requests_completed"] - completed0,
@@ -427,6 +440,82 @@ def build_engine(num_slots: int = 4, max_len: int = 32, vocab: int = 16,
     return fitted, engine
 
 
+def build_fleet(replicas: int = 2, affinity: str = "prefix",
+                num_slots: int = 4, max_len: int = 32, vocab: int = 16,
+                queue_capacity: int = 64, seed: int = 0,
+                prefill_mode: str = "bucketed",
+                prefill_chunk: Optional[int] = None,
+                paged: bool = False,
+                block_size: Optional[int] = None,
+                kv_blocks: Optional[int] = None,
+                router_seed: int = 0):
+    """``replicas`` identical engines serving the SAME weights behind a
+    :class:`distkeras_tpu.router.ServingRouter` — the fleet analog of
+    ``build_engine`` (one model build, N engines, so what the bench
+    measures is routing + replication, not N different models).  The
+    router gets an ``engine_factory`` too, so ``autoscale_tick`` /
+    ``scale_up`` work out of the box on the returned fleet."""
+    import jax
+
+    from distkeras_tpu.core.model import FittedModel
+    from distkeras_tpu.models import transformer_lm
+    from distkeras_tpu.router import ServingRouter
+    from distkeras_tpu.serving import ServingEngine
+
+    model = transformer_lm(vocab_size=vocab, seq_len=max_len, d_model=32,
+                           num_heads=4, num_layers=2, mlp_dim=64,
+                           compute_dtype="float32")
+    params = model.init(jax.random.PRNGKey(seed), (max_len,))
+    fitted = FittedModel(model, params)
+    kw: Dict[str, Any] = {"prefill_mode": prefill_mode}
+    if prefill_chunk is not None:
+        kw["prefill_chunk"] = int(prefill_chunk)
+    if paged:
+        kw["paged"] = True
+        if block_size is not None:
+            kw["block_size"] = int(block_size)
+        if kv_blocks is not None:
+            kw["kv_blocks"] = int(kv_blocks)
+    mk = lambda: ServingEngine(  # noqa: E731
+        fitted, num_slots=num_slots, max_len=max_len,
+        queue_capacity=queue_capacity, **kw)
+    router = ServingRouter([mk() for _ in range(int(replicas))],
+                           affinity=affinity, seed=router_seed,
+                           engine_factory=mk,
+                           max_replicas=max(int(replicas) * 2, 2))
+    return fitted, router
+
+
+def fleet_report(router, closed: Dict[str, Any]) -> Dict[str, Any]:
+    """The per-replica occupancy-skew report: how evenly (or, under
+    prefix affinity, how DELIBERATELY unevenly) the trace landed across
+    the fleet.  ``routed_skew`` is max/mean routed requests per live
+    replica — 1.0 is a perfectly balanced fleet; prefix affinity trades
+    some skew for the warm-trie ``prefix_hit_rate``."""
+    snap = router.fleet_snapshot()
+    per_replica = [{
+        "uid": rep["uid"], "kind": rep["kind"],
+        "generation": rep["generation"], "routed": rep["routed"],
+        "tokens_generated": rep["load"].get("tokens_generated", 0),
+        "queue_depth": rep["load"].get("queue_depth", 0),
+        "trie_blocks": rep["load"].get("trie_blocks", 0),
+    } for rep in snap]
+    routed = [p["routed"] for p in per_replica]
+    mean = sum(routed) / max(len(routed), 1)
+    return {
+        "mode": "fleet",
+        "replicas": len(per_replica),
+        "affinity": router.affinity,
+        "per_replica": per_replica,
+        "routed_skew": round(max(routed) / mean, 3) if mean else None,
+        "prefix_hit_rate": closed.get("prefix_hit_rate"),
+        "affinity_routed": router.counters["affinity_routed"],
+        "affinity_spills": router.counters["affinity_spills"],
+        "resubmissions": router.counters["resubmissions"],
+        "requests_failed": router.counters["requests_failed"],
+    }
+
+
 def build_spec_engine(num_slots: int = 4, max_len: int = 32,
                       vocab: int = 16, queue_capacity: int = 64,
                       spec_len: int = 4, num_epoch: int = 25,
@@ -544,21 +633,50 @@ def main():
     ap.add_argument("--prefill-engines", type=int, default=1,
                     help="prefill engines feeding the decode engine "
                          "(with --disaggregate)")
+    ap.add_argument("--router", action="store_true",
+                    help="serve through a ServingRouter fronting "
+                         "--replicas identical engines (same weights); "
+                         "prints a per-replica occupancy-skew report — "
+                         "the multi-tenant fleet trace is --router "
+                         "--paged --affinity prefix --prefix-groups G")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="fleet size behind --router")
+    ap.add_argument("--affinity", choices=("prefix", "least-loaded",
+                                           "random"), default="prefix",
+                    help="router dispatch policy: prefix-affinity "
+                         "(cache-aware, the default), pure least-loaded, "
+                         "or seeded random (the control arm)")
     args = ap.parse_args()
 
-    fitted, engine = build_engine(num_slots=args.slots,
-                                  max_len=args.max_len,
-                                  prefill_mode=args.prefill_mode,
-                                  prefill_chunk=args.prefill_chunk,
-                                  spec_draft=args.spec_draft,
-                                  spec_len=args.spec_len,
-                                  quantize=args.quantize,
-                                  kv_dtype=args.kv_dtype,
-                                  paged=args.paged,
-                                  block_size=args.block_size,
-                                  kv_blocks=args.kv_blocks,
-                                  disaggregate=args.disaggregate,
-                                  prefill_engines=args.prefill_engines)
+    if args.router and (args.disaggregate or args.spec_draft is not None):
+        ap.error("--router replicates unified engines; it composes with "
+                 "--disaggregate or --spec-draft only behind a "
+                 "ServingServer address, not in-process")
+
+    if args.router:
+        fitted, engine = build_fleet(replicas=args.replicas,
+                                     affinity=args.affinity,
+                                     num_slots=args.slots,
+                                     max_len=args.max_len,
+                                     prefill_mode=args.prefill_mode,
+                                     prefill_chunk=args.prefill_chunk,
+                                     paged=args.paged,
+                                     block_size=args.block_size,
+                                     kv_blocks=args.kv_blocks)
+    else:
+        fitted, engine = build_engine(num_slots=args.slots,
+                                      max_len=args.max_len,
+                                      prefill_mode=args.prefill_mode,
+                                      prefill_chunk=args.prefill_chunk,
+                                      spec_draft=args.spec_draft,
+                                      spec_len=args.spec_len,
+                                      quantize=args.quantize,
+                                      kv_dtype=args.kv_dtype,
+                                      paged=args.paged,
+                                      block_size=args.block_size,
+                                      kv_blocks=args.kv_blocks,
+                                      disaggregate=args.disaggregate,
+                                      prefill_engines=args.prefill_engines)
     trace = make_trace(args.requests, num_steps=args.steps,
                        temperature=args.temperature,
                        pattern=args.pattern,
@@ -589,8 +707,11 @@ def main():
                 "transfer_ms_mean": (round(float(np.mean(
                     s["transfer_ms"])), 3) if s["transfer_ms"] else None),
                 "prefill_reroutes": s["prefill_reroutes"]}))
+        if args.router:
+            print(json.dumps(fleet_report(engine, closed)))
         if args.paged:
-            paged_eng = (engine.engines[0] if args.disaggregate
+            paged_eng = (engine.engines[0]
+                         if (args.disaggregate or args.router)
                          else engine)
             print(json.dumps({
                 "mode": "paged",
@@ -620,6 +741,20 @@ def main():
                               round(closed["tokens_per_sec"]
                                     / seq["tokens_per_sec"], 2)}))
         for qps in filter(None, args.qps_sweep.split(",")):
+            if args.router:
+                _, engine = build_fleet(replicas=args.replicas,
+                                        affinity=args.affinity,
+                                        num_slots=args.slots,
+                                        max_len=args.max_len,
+                                        prefill_mode=args.prefill_mode,
+                                        prefill_chunk=args.prefill_chunk,
+                                        paged=args.paged,
+                                        block_size=args.block_size,
+                                        kv_blocks=args.kv_blocks)
+                point = run_open_loop(engine, trace, qps=float(qps))
+                engine.stop()
+                print(json.dumps({"mode": "open_loop", **point}))
+                continue
             _, engine = build_engine(num_slots=args.slots,
                                      max_len=args.max_len,
                                      prefill_mode=args.prefill_mode,

@@ -11,6 +11,7 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.parallel import get_mesh
+from distkeras_tpu.parallel import _compat
 from distkeras_tpu.parallel.tp import (column_parallel_dense,
                                        row_parallel_dense, tp_mlp,
                                        tp_self_attention)
@@ -35,7 +36,7 @@ def test_tp_mlp_matches_dense(eight_devices):
 
     want = jax.nn.gelu(x @ w1 + b1) @ w2 + b2
 
-    fn = jax.shard_map(
+    fn = _compat.shard_map(
         lambda x_, w1_, b1_, w2_, b2_: tp_mlp(
             x_, w1_, b1_, w2_, b2_, axis_name="model",
             compute_dtype=jnp.float32),
@@ -62,7 +63,7 @@ def test_tp_attention_matches_full(eight_devices):
         out = dot_product_attention(q, k, v, causal=True)
         return out.reshape(b, s, d) @ wo
 
-    fn = jax.shard_map(
+    fn = _compat.shard_map(
         lambda x_, q_, k_, v_, o_: tp_self_attention(
             x_, q_, k_, v_, o_, num_local_heads=1, head_dim=dh,
             axis_name="model", causal=True, compute_dtype=jnp.float32),
@@ -128,7 +129,7 @@ def test_moe_matches_reference(eight_devices):
     # each of the 8 shards routes 16/8 = 2 tokens;
     # capacity = ceil(2.0 * 2 / 8) = 1
     capacity = 1
-    fn = jax.shard_map(
+    fn = _compat.shard_map(
         # the MoE output is identical on every device but shard_map
         # cannot infer that statically; psum/n makes replication provable
         lambda x_, r_, w1_, b1_, w2_, b2_: jax.lax.psum(moe_mlp(
@@ -155,7 +156,7 @@ def test_moe_gradients_flow(eight_devices):
     b2 = jnp.zeros((8, 8))
 
     def loss(w1_):
-        fn = jax.shard_map(
+        fn = _compat.shard_map(
             lambda x_, r_, a, b_, c, d_: jax.lax.psum(moe_mlp(
                 x_, r_, a, b_, c, d_, axis_name="model",
                 capacity_factor=2.0, compute_dtype=jnp.float32)[0],
@@ -253,7 +254,7 @@ def test_moe_aux_loss_prevents_expert_starvation(eight_devices):
                 lambda v: jax.lax.pmean(v, "model"), stats)
             return (jax.lax.pmean(mse, "model")
                     + aux_weight * load_balance_loss(stats))
-        fn = jax.shard_map(
+        fn = _compat.shard_map(
             local, mesh=mesh,
             in_specs=(P(), P(), P(), P("model"), P("model"), P("model"),
                       P("model")),
@@ -321,7 +322,7 @@ def test_pipeline_matches_sequential(eight_devices):
             h = stage_fn(ws[i], h)
         return h
 
-    fn = jax.shard_map(
+    fn = _compat.shard_map(
         # outputs are zeros on all but the last stage, so a psum over the
         # stage axis replicates the result for out_specs=P()
         lambda w, xm: jax.lax.psum(
@@ -343,7 +344,7 @@ def test_pipeline_gradients(eight_devices):
         return jnp.tanh(h @ w)
 
     def loss_pipe(ws_):
-        fn = jax.shard_map(
+        fn = _compat.shard_map(
             lambda w, xm: jax.lax.psum(
                 pipeline_apply(stage_fn, w[0], xm, axis_name="stage"),
                 "stage"),
@@ -381,7 +382,7 @@ def test_pipeline_transformer_matches_sequential(eight_devices):
     labels = (tokens + 1) % 32
 
     # pipelined loss+grads via shard_map
-    pipelined = jax.jit(jax.shard_map(
+    pipelined = jax.jit(_compat.shard_map(
         jax.value_and_grad(lm._local_loss), mesh=mesh,
         in_specs=(lm.param_specs(), P("data"), P("data")),
         out_specs=(P(), lm.param_specs())))
@@ -404,7 +405,7 @@ def test_pipeline_transformer_matches_sequential(eight_devices):
         vocab_size=32, seq_len=16, d_model=16, num_heads=2, num_layers=4,
         mlp_dim=32, mesh=mesh, num_microbatches=2,
         compute_dtype=jnp.float32, remat=True)
-    loss_m, grads_m = jax.jit(jax.shard_map(
+    loss_m, grads_m = jax.jit(_compat.shard_map(
         jax.value_and_grad(lm_r._local_loss), mesh=mesh,
         in_specs=(lm_r.param_specs(), P("data"), P("data")),
         out_specs=(P(), lm_r.param_specs())))(params, tokens, labels)
@@ -456,7 +457,7 @@ def test_pipeline_1f1b_toy_grads_match_autodiff(eight_devices):
         lead = lambda t: jax.tree_util.tree_map(lambda v: v[None], t)
         return loss[None], lead(dstage), lead(dhead), lead(dx)
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(_compat.shard_map(
         local, mesh=mesh, in_specs=(P("stage"), P(), P(), P()),
         out_specs=(P("stage"),) * 4))
     loss, dstage, dhead, dx = fn(ws, head, x, labels)
@@ -491,7 +492,7 @@ def test_pipeline_1f1b_toy_grads_match_autodiff(eight_devices):
             lead = lambda t: jax.tree_util.tree_map(lambda v: v[None], t)
             return loss[None], lead(dstage), lead(dhead), lead(dx)
 
-        fn_e = jax.jit(jax.shard_map(
+        fn_e = jax.jit(_compat.shard_map(
             local_e, mesh=mesh_e, in_specs=(P("stage"), P(), P(), P()),
             out_specs=(P("stage"),) * 4))
         loss_e, dstage_e, _, dx_e = fn_e(ws_e, head, x_e, l_e)
@@ -533,11 +534,11 @@ def test_pipeline_1f1b_lm_matches_gpipe(eight_devices):
     tokens = jnp.asarray(rng.integers(0, 32, (16, 16)), jnp.int32)
     labels = (tokens + 1) % 32
 
-    loss_g, grads_g = jax.jit(jax.shard_map(
+    loss_g, grads_g = jax.jit(_compat.shard_map(
         jax.value_and_grad(lm_g._local_loss), mesh=mesh,
         in_specs=(lm_g.param_specs(), P("data"), P("data")),
         out_specs=(P(), lm_g.param_specs())))(params, tokens, labels)
-    loss_1, grads_1 = jax.jit(jax.shard_map(
+    loss_1, grads_1 = jax.jit(_compat.shard_map(
         lm_1._local_loss_and_grads_1f1b, mesh=mesh,
         in_specs=(lm_1.param_specs(), P("data"), P("data")),
         out_specs=(P(), lm_1.param_specs())))(params, tokens, labels)
@@ -552,7 +553,7 @@ def test_pipeline_1f1b_lm_matches_gpipe(eight_devices):
 
     # remat composes (same grads, tick inputs re-linearized)
     lm_r = PipelineTransformerLM(**kw, schedule="1f1b", remat=True)
-    _, grads_r = jax.jit(jax.shard_map(
+    _, grads_r = jax.jit(_compat.shard_map(
         lm_r._local_loss_and_grads_1f1b, mesh=mesh,
         in_specs=(lm_r.param_specs(), P("data"), P("data")),
         out_specs=(P(), lm_r.param_specs())))(params, tokens, labels)
@@ -602,7 +603,7 @@ def test_pipeline_3d_dp_pp_tp(eight_devices):
     tokens = jnp.asarray(rng.integers(0, 32, (8, 16)), jnp.int32)
     labels = (tokens + 1) % 32
 
-    loss_g, grads_g = jax.jit(jax.shard_map(
+    loss_g, grads_g = jax.jit(_compat.shard_map(
         jax.value_and_grad(lm._local_loss), mesh=mesh,
         in_specs=(lm.param_specs(), P("data"), P("data")),
         out_specs=(P(), lm.param_specs())))(params, tokens, labels)
@@ -619,7 +620,7 @@ def test_pipeline_3d_dp_pp_tp(eight_devices):
 
     # 1F1B under tp: same loss/grads as the GPipe autodiff path
     lm1 = PipelineTransformerLM(**kw, schedule="1f1b")
-    loss_1, grads_1 = jax.jit(jax.shard_map(
+    loss_1, grads_1 = jax.jit(_compat.shard_map(
         lm1._local_loss_and_grads_1f1b, mesh=mesh,
         in_specs=(lm1.param_specs(), P("data"), P("data")),
         out_specs=(P(), lm1.param_specs())))(params, tokens, labels)
@@ -630,7 +631,7 @@ def test_pipeline_3d_dp_pp_tp(eight_devices):
 
     # remat composes with the tp stage under the manual 1F1B backward
     lm_r = PipelineTransformerLM(**kw, schedule="1f1b", remat=True)
-    loss_m, grads_m = jax.jit(jax.shard_map(
+    loss_m, grads_m = jax.jit(_compat.shard_map(
         lm_r._local_loss_and_grads_1f1b, mesh=mesh,
         in_specs=(lm_r.param_specs(), P("data"), P("data")),
         out_specs=(P(), lm_r.param_specs())))(params, tokens, labels)

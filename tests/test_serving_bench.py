@@ -231,6 +231,67 @@ def test_paged_loadgen_shared_prefix_fast_leg():
     assert engine.kv_blocks_in_use == 0
 
 
+# ---------------------------------------------------------------------------
+# fleet routing (PR 17): the fast legs are tier-1 (seeded trace, bounded
+# waits); the scaling timing comparison is slow
+# ---------------------------------------------------------------------------
+
+@pytest.mark.router
+def test_fleet_bench_fields_shape():
+    """bench.serving_fleet_bench returns exactly the serving_fleet_*
+    field set (None allowed — the artifact contract)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    out = bench.serving_fleet_bench(budget_s=0.0)  # force the overrun path
+    assert set(out) == {"serving_fleet_tokens_per_sec",
+                        "serving_fleet_prefix_hit_rate",
+                        "serving_fleet_failover_lost_requests"}
+    assert all(v is None for v in out.values())
+
+
+@pytest.mark.router
+def test_closed_loop_router_fleet_lossless():
+    """Tier-1 deterministic fleet leg: the closed loop drives a 2-replica
+    router exactly like a bare engine (duck-typed submit/cancel/stats),
+    every request completes, and the per-replica skew report accounts
+    for the whole trace."""
+    _, router = loadgen.build_fleet(replicas=2, affinity="least-loaded",
+                                    num_slots=2)
+    trace = loadgen.make_trace(6, num_steps=6, temperature=0.5)
+    try:
+        m = loadgen.run_closed_loop(router, trace, concurrency=4,
+                                    timeout_s=120.0)
+        report = loadgen.fleet_report(router, m)
+    finally:
+        router.stop()
+    assert m["completed"] == 6 and m["shed"] == 0
+    assert m["tokens"] == 6 * 6
+    assert m["tokens_per_sec"] > 0
+    assert report["replicas"] == 2
+    assert sum(p["routed"] for p in report["per_replica"]) == 6
+    assert report["requests_failed"] == 0
+    assert report["routed_skew"] is not None and report["routed_skew"] >= 1
+
+
+@pytest.mark.router
+@pytest.mark.slow
+def test_fleet_bench_scaling_and_failover():
+    """The full bench leg: the scaling curve records every fleet size,
+    affinity routing beats the random control arm on the tenanted trace,
+    and the failover count is ZERO — the acceptance bar."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    out = bench.serving_fleet_bench(budget_s=300.0)
+    scaling = out["serving_fleet_tokens_per_sec"]
+    assert scaling and scaling["1"] > 0
+    hit = out["serving_fleet_prefix_hit_rate"]
+    assert hit["prefix"] is not None and hit["random"] is not None
+    assert hit["prefix"] > hit["random"], hit
+    assert out["serving_fleet_failover_lost_requests"] == 0
+
+
 @pytest.mark.paged
 @pytest.mark.slow
 def test_paged_shared_prefix_ttft_beats_dense_5x():
