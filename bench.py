@@ -655,6 +655,14 @@ def serving_bench(budget_s: float = 90.0):
     interference disaggregation eliminates) and
     ``serving_kv_transfer_bytes`` (byte-accounted shipped blocks).
 
+    Multi-tenant QoS observables (PR 18): an open-loop overload burst
+    over a mixed-tenant trace —
+    ``serving_interactive_p99_ms_under_overload`` (the interactive
+    tier's latency while weighted-fair admission + batch preemption
+    shield it), ``serving_batch_completion_rate`` (the tier absorbing
+    the queueing), and ``serving_preempt_resume_ms`` (mean swap-in
+    cost — the TUNING.md swap-vs-recompute crossover input).
+
     Paged KV + prefix sharing observables (PR 12): one shared-prefix
     trace (8 users over a single 128-token prefix, steady state — the
     prefix is warmed once first) through the paged pool AND the PR 9
@@ -692,7 +700,10 @@ def serving_bench(budget_s: float = 90.0):
             "serving_paged_capacity_slots": None,
             "serving_unified_decode_p99_ms": None,
             "serving_disagg_decode_p99_ms": None,
-            "serving_kv_transfer_bytes": None}
+            "serving_kv_transfer_bytes": None,
+            "serving_interactive_p99_ms_under_overload": None,
+            "serving_batch_completion_rate": None,
+            "serving_preempt_resume_ms": None}
     if budget_s < 5.0:  # not enough budget to even warm the engine up
         return none
     t0 = time.perf_counter()
@@ -882,6 +893,29 @@ def serving_bench(budget_s: float = 90.0):
     out["serving_disagg_decode_p99_ms"] = _decode_p99(pair)
     out["serving_kv_transfer_bytes"] = int(
         pair.stats["kv_block_bytes_shipped"])
+    if time.perf_counter() - t0 > budget_s * 0.95:
+        return out
+    # multi-tenant QoS leg (PR 18): an open-loop overload burst over a
+    # mixed-tenant trace on a small paged engine — weighted-fair
+    # admission + batch-slot preemption must hold the interactive tier's
+    # p99 while the batch tier absorbs the queueing; preempt_resume_ms
+    # prices the swap-out/swap-in round-trip the TUNING.md crossover
+    # guidance is about
+    _, qos_eng = loadgen.build_engine(num_slots=2, max_len=32, paged=True,
+                                      block_size=8, queue_capacity=32)
+    for p in loadgen.qos_policies(3):
+        qos_eng.register_tenant(p)
+    qos_trace = loadgen.make_trace(20, num_steps=16, seed=5,
+                                   tenants=3, tier_mix=0.3)
+    try:
+        qos = loadgen.run_overload(qos_eng, qos_trace, qps=200.0,
+                                   timeout_s=budget_s)
+        out["serving_interactive_p99_ms_under_overload"] = \
+            qos["interactive_p99_ms"]
+        out["serving_batch_completion_rate"] = qos["batch_completion_rate"]
+        out["serving_preempt_resume_ms"] = qos["preempt_resume_ms"]
+    finally:
+        qos_eng.stop()
     return out
 
 
@@ -1269,7 +1303,10 @@ def main():
                       "serving_paged_capacity_slots": None,
                       "serving_unified_decode_p99_ms": None,
                       "serving_disagg_decode_p99_ms": None,
-                      "serving_kv_transfer_bytes": None}
+                      "serving_kv_transfer_bytes": None,
+                      "serving_interactive_p99_ms_under_overload": None,
+                      "serving_batch_completion_rate": None,
+                      "serving_preempt_resume_ms": None}
     serving_remaining = budget - (time.perf_counter() - t_start)
     if serving_remaining > 45:
         try:

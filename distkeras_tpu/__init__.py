@@ -22,8 +22,9 @@ from .trainers import (Trainer, SingleTrainer, AveragingTrainer,
                        ADAG, DOWNPOUR, AEASGD, EAMSGD, DynSGD)
 from .predictors import Predictor, ModelPredictor
 from . import serving
-from .serving import (Draining, EngineDead, QueueFull, RequestHandle,
-                      ServingClient, ServingEngine, ServingServer)
+from .serving import (Draining, EngineDead, QueueFull, QuotaExceeded,
+                      RequestHandle, ServingClient, ServingEngine,
+                      ServingServer, TenantPolicy)
 from . import router
 from .router import ServingRouter
 from .evaluators import (Evaluator, AccuracyEvaluator, AUCEvaluator,

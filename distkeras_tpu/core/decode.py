@@ -271,6 +271,20 @@ def scatter_blocks(caches, rows, payload):
             for i, c in enumerate(caches)]
 
 
+def gather_slot_state(caches, rows, tok, pos, keys, slot):
+    """The suspend half of a QoS preemption swap-out: one jitted dispatch
+    returning the arena slots named by ``rows`` (``gather_blocks``
+    layout) TOGETHER with the preempted slot's device-resident decode
+    frontier — its current un-written token (``tok[slot]``), position
+    (``pos[slot]``, entries written so far), and RNG key row.  The
+    frontier must come off the device in the same dispatch as the blocks:
+    the pair (KV prefix, frontier) is what makes a later re-install
+    bit-identical, and reading the device copy (not a host mirror) makes
+    the snapshot authoritative by construction."""
+    payload = gather_blocks(caches, rows)
+    return payload, tok[slot], pos[slot], keys[slot]
+
+
 def _per_row(pos) -> bool:
     """True when ``pos`` is a (B,) per-row position vector (the serving
     engine's slot pool) rather than the scalar all-rows-share-one-position

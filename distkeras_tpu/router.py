@@ -55,7 +55,7 @@ import numpy as np
 from . import networking
 from . import resilience
 from .serving import (Draining, EngineDead, QueueFull, RequestHandle,
-                      ServingClient, ServingEngine)
+                      ServingClient, ServingEngine, TenantPolicy)
 
 __all__ = ["ServingRouter", "DEFAULT_RESUBMIT_POLICY"]
 
@@ -211,7 +211,8 @@ class ServingRouter:
                  = None,
                  min_replicas: int = 1, max_replicas: int = 8,
                  scale_up_queue: int = 4,
-                 max_idle_clients: int = 4):
+                 max_idle_clients: int = 4,
+                 tenants: Optional[Sequence[TenantPolicy]] = None):
         replicas = list(replicas or [])
         addrs = list(addrs or [])
         if not replicas and not addrs:
@@ -253,6 +254,23 @@ class ServingRouter:
             self._replicas.append(
                 _WireReplica(self._next_uid, a, load_ttl=load_ttl))
             self._next_uid += 1
+        #: fleet-level QoS (PR 18): the policies are the dispatch's tier
+        #: map AND are cloned onto every in-process replica (fresh
+        #: per-replica token buckets — a quota ``rate`` is therefore
+        #: per-replica, so the fleet-wide rate scales with live replicas;
+        #: see docs/serving.md).  Tenant-aware dispatch composes with
+        #: prefix affinity: a batch-tier submission spills off an affine
+        #: replica whose snapshot shows interactive requests waiting.
+        self._tenants: Dict[str, TenantPolicy] = {}
+        for p in (tenants or []):
+            if not isinstance(p, TenantPolicy):
+                raise ValueError(f"tenants= entries must be TenantPolicy, "
+                                 f"got {type(p).__name__}")
+            self._tenants[p.name] = p
+        for rep in self._replicas:
+            if rep.kind == "engine":
+                for p in self._tenants.values():
+                    rep.engine.register_tenant(p.clone())
         self._rng = np.random.default_rng(self.seed)  # "random" policy
         self._live: Dict[int, _RouterRequest] = {}
         self._attributions: Dict[int, Tuple[int, int]] = {}
@@ -268,7 +286,8 @@ class ServingRouter:
             "requests_failed": 0, "requests_rejected": 0,
             "requests_cancelled": 0, "requests_expired": 0,
             "resubmissions": 0, "affinity_routed": 0,
-            "affinity_spills": 0, "generation_swaps": 0,
+            "affinity_spills": 0, "tenant_spills": 0,
+            "generation_swaps": 0,
             "scale_ups": 0, "scale_downs": 0,
         }
 
@@ -372,11 +391,23 @@ class ServingRouter:
             out.append((rep, load))
         return out
 
-    def _dispatch_order(self, prompt: np.ndarray
+    def _tier_of(self, tenant: Optional[str]) -> str:
+        pol = self._tenants.get("default" if tenant is None
+                                else str(tenant))
+        return "batch" if pol is None else pol.tier
+
+    def _dispatch_order(self, prompt: np.ndarray,
+                        tenant: Optional[str] = None
                         ) -> List[Tuple[Any, Dict[str, Any]]]:
         """Replicas in preference order for one admission attempt: the
         policy's pick first, the rest by ascending load (the fallback
-        chain a full/refusing replica hands over to)."""
+        chain a full/refusing replica hands over to).  Tenant-aware QoS
+        rides on top of prefix affinity: a BATCH-tier submission spills
+        off an affine replica whose snapshot shows interactive requests
+        queued — warm-trie reuse is not worth feeding the replica more
+        preemption victims while its interactive tier is backlogged
+        (interactive submissions keep their affinity; they are what the
+        backlog drains into)."""
         cands = self._candidates()
         if not cands:
             raise EngineDead("no live serving replica in the fleet")
@@ -395,10 +426,17 @@ class ServingRouter:
                 pick = max(cands, key=lambda rl: zlib.crc32(
                     key + rl[0].uid.to_bytes(4, "little")))
                 least = by_load[0]
+                spill = None
                 if (pick[0] is not least[0]
                         and self._should_spill(pick[1], least[1])):
+                    spill = "affinity_spills"
+                elif (pick[0] is not least[0]
+                      and self._tier_of(tenant) == "batch"
+                      and int(pick[1].get("queued_interactive", 0)) > 0):
+                    spill = "tenant_spills"
+                if spill is not None:
                     with self._lock:
-                        self.counters["affinity_spills"] += 1
+                        self.counters[spill] += 1
                 else:
                     with self._lock:
                         self.counters["affinity_routed"] += 1
@@ -466,7 +504,8 @@ class ServingRouter:
         :class:`QueueFull`, a fleet-wide drain as :class:`Draining`)."""
         proxy = rec.proxy
         last: Optional[BaseException] = None
-        for rep, _load in self._dispatch_order(proxy.prompt):
+        for rep, _load in self._dispatch_order(proxy.prompt,
+                                               rec.kw.get("tenant")):
             try:
                 self._attach(rec, rep)
                 return
@@ -695,6 +734,8 @@ class ServingRouter:
         if self.engine_factory is None:
             raise ValueError("scale_up needs engine_factory=")
         eng = self.engine_factory()
+        for p in self._tenants.values():  # fleet QoS reaches new capacity
+            eng.register_tenant(p.clone())
         eng.warmup()
         with self._lock:
             started = self._started

@@ -153,6 +153,85 @@ class EngineDead(RuntimeError):
     their seed, so a resubmit is idempotent)."""
 
 
+class QuotaExceeded(QueueFull):
+    """Admission refused by the submitting tenant's token-bucket quota
+    (``TenantPolicy.rate``).  Subclasses :class:`QueueFull` so every
+    existing shed path (router spill, open-loop load shedding, wire
+    backpressure) treats a quota refusal as sheddable — but the wire
+    server replies with its own ``{"kind": "quota"}`` so clients can
+    distinguish policy refusal from transient queue pressure.  Raised
+    immediately even from a blocking ``submit``: waiting out a refill
+    inside the engine would hold admission slots hostage to one tenant's
+    burst."""
+
+
+class TenantPolicy:
+    """One tenant's QoS contract: ``weight`` is its weighted-fair share
+    of admissions, ``rate``/``burst`` a token-bucket quota in requests/s
+    (``rate=None`` = unlimited), ``tier`` the SLO band (``"interactive"``
+    tenants are admitted ahead of ``"batch"`` tenants and may preempt
+    them; ``"batch"`` tenants are preemptible), and ``deadline_s`` an
+    optional tier-default per-request deadline applied when ``submit``
+    passes none (explicit ``deadline_s`` still wins).  Bucket state is
+    mutated under the engine's queue lock — one policy object belongs to
+    one engine (``clone()`` for a fresh-bucket copy)."""
+
+    __slots__ = ("name", "weight", "rate", "burst", "tier", "deadline_s",
+                 "_tokens", "_stamp")
+
+    def __init__(self, name: str, weight: float = 1.0,
+                 rate: Optional[float] = None,
+                 burst: Optional[float] = None, tier: str = "batch",
+                 deadline_s: Optional[float] = None):
+        if not name:
+            raise ValueError("tenant name must be non-empty")
+        if not (weight > 0):
+            raise ValueError(f"weight must be > 0, got {weight}")
+        if rate is not None and not (rate > 0):
+            raise ValueError(f"rate must be None or > 0, got {rate}")
+        if tier not in ("interactive", "batch"):
+            raise ValueError(f"tier must be 'interactive' or 'batch', "
+                             f"got {tier!r}")
+        if deadline_s is not None and not (deadline_s > 0):
+            raise ValueError(f"deadline_s must be None or > 0, "
+                             f"got {deadline_s}")
+        self.name = str(name)
+        self.weight = float(weight)
+        self.rate = None if rate is None else float(rate)
+        if burst is None:
+            burst = None if rate is None else max(1.0, float(rate))
+        elif not (burst >= 1.0):
+            raise ValueError(f"burst must be >= 1, got {burst}")
+        self.burst = None if burst is None else float(burst)
+        self.tier = tier
+        self.deadline_s = deadline_s
+        self._tokens = 0.0 if self.burst is None else self.burst
+        self._stamp: Optional[float] = None
+
+    def _take(self, now: float) -> bool:
+        """Spend one bucket token (refilling first); False = over quota.
+        Caller holds the engine's queue lock."""
+        if self.rate is None:
+            return True
+        if self._stamp is not None:
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._stamp)
+                               * self.rate)
+        self._stamp = now
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+    def clone(self) -> "TenantPolicy":
+        """A copy with a full, unshared token bucket (the
+        ``respawn_clone`` seam — the replacement engine must not inherit
+        the dead engine's bucket debt)."""
+        return TenantPolicy(self.name, weight=self.weight, rate=self.rate,
+                            burst=self.burst, tier=self.tier,
+                            deadline_s=self.deadline_s)
+
+
 class RequestHandle:
     """One submitted request's lifecycle + streaming surface.
 
@@ -177,13 +256,14 @@ class RequestHandle:
                  "top_p", "eos_id", "pad_id", "key", "tokens", "finish",
                  "slot", "submitted_at", "started_at", "first_token_at",
                  "finished_at", "deadline", "error", "cancelled_at",
-                 "kvblocks", "_cond", "_chunk_read")
+                 "kvblocks", "tenant", "priority", "_cond", "_chunk_read")
 
     def __init__(self, rid: int, prompt: np.ndarray, num_steps: int,
                  temperature: float, top_k: Optional[int],
                  top_p: Optional[float], eos_id: Optional[int],
                  pad_id: Optional[int], key,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 tenant: str = "default", priority: int = 0):
         self.id = rid
         self.prompt = prompt
         self.num_steps = int(num_steps)
@@ -207,6 +287,8 @@ class RequestHandle:
         #: networking.KVBlocks on a "prefilled" handle (prefill role's
         #: extraction output) or on a decode-role ingest before admission
         self.kvblocks = None
+        self.tenant = str(tenant)
+        self.priority = int(priority)
         self._cond = threading.Condition()
         self._chunk_read = 0            # tokens already handed out as chunks
 
@@ -414,6 +496,30 @@ class _PrefillJob:
         self.bt = bt                # paged: (1, T) device block-table row
         self.dbt = dbt
         self.written = 0
+
+
+class _SuspendedReq:
+    """One preempted request swapped out to host memory: the live KV
+    blocks (``layers`` — per-layer dicts of host arrays, ``n_blocks`` ×
+    ``block_size`` rows each, the same layout ``networking.KVBlocks``
+    ships) plus the decode frontier (``pos`` device positions written,
+    ``tok`` the current un-written token).  The handle itself stays
+    non-terminal — tokens already emitted remain on it, and the RNG key
+    (``handle.key``) folds per absolute position, so re-installing
+    (tok, pos, key) over the restored blocks resumes a bit-identical
+    stream.  Holds NO slot and NO arena blocks."""
+
+    __slots__ = ("handle", "layers", "n_blocks", "pos", "tok",
+                 "suspended_at")
+
+    def __init__(self, handle: RequestHandle, layers, n_blocks: int,
+                 pos: int, tok: int):
+        self.handle = handle
+        self.layers = layers
+        self.n_blocks = int(n_blocks)
+        self.pos = int(pos)
+        self.tok = int(tok)
+        self.suspended_at = time.perf_counter()
 
 
 # ---------------------------------------------------------------------------
@@ -754,7 +860,8 @@ class ServingEngine:
                  kv_dtype: Optional[str] = None,
                  paged: bool = False, block_size: int = 16,
                  kv_blocks: Optional[int] = None,
-                 role: str = "unified"):
+                 role: str = "unified",
+                 tenants: Optional[List[TenantPolicy]] = None):
         if isinstance(model, FittedModel):
             self.model, self.params = model.model, model.params
         else:
@@ -944,12 +1051,47 @@ class ServingEngine:
         self._topp = np.zeros((self.num_slots,), np.float32)  # 0 = off
         self._keys = np.zeros((self.num_slots, 2), np.uint32)
 
-        # -- admission queue (the ONLY cross-thread state besides handles)
-        self._queue: "collections.deque[RequestHandle]" = collections.deque()
+        # -- admission queues (the ONLY cross-thread state besides
+        #    handles): one FIFO list per tenant, picked by stride-based
+        #    weighted-fair scheduling (interactive-tier tenants first).
+        #    With no policies registered everything lands in the single
+        #    "default" queue and every pick is plain FIFO — bit-identical
+        #    to the pre-QoS deque.  _qdepth is the global depth (the
+        #    backpressure bound stays engine-wide); _q_int counts queued
+        #    interactive-tier requests (the preemption-pressure signal).
+        self._queues: Dict[str, List[RequestHandle]] = {}
+        self._qdepth = 0
+        self._q_int = 0
+        self._wf_pass: Dict[str, float] = {}
+        self._tenants: Dict[str, TenantPolicy] = {}
+        for pol in (tenants or []):
+            if not isinstance(pol, TenantPolicy):
+                raise ValueError(f"tenants must be TenantPolicy instances, "
+                                 f"got {type(pol).__name__}")
+            self._tenants[pol.name] = pol
         self._qlock = threading.Lock()
         self._not_full = threading.Condition(self._qlock)
         self._have_work = threading.Condition(self._qlock)
         self._next_id = 0
+
+        # -- preemption state (QoS swap-out): suspended requests live here
+        #    holding NO slot and NO arena blocks — just a host-memory copy
+        #    of their live KV blocks + decode frontier.  Scheduler-thread
+        #    confined except for the read in _declare_dead (same snapshot
+        #    discipline as _handles there).  _preempt_ids carries explicit
+        #    preempt() marks to the scheduler; _int_blocked is set when an
+        #    interactive admission failed on BLOCK exhaustion (free slot,
+        #    empty arena) so starvation-triggered preemption also fires on
+        #    pool pressure, not just slot pressure.
+        self._suspended: Dict[int, _SuspendedReq] = collections.OrderedDict()
+        self._preempt_ids: set = set()
+        self._int_blocked = False
+        self._can_preempt = (self.paged and not self.rolling
+                             and self._draft_model is None
+                             and self.role == "unified"
+                             and self.prefill_mode == "bucketed")
+        self._swap_gather_fn = None
+        self._swap_ingest_fn = None
 
         # -- jitted programs (compiled once per engine: shapes are fixed)
         self._step_fn = self._build_step_fn()
@@ -1095,6 +1237,21 @@ class ServingEngine:
             "kv_blocks_shipped": 0, "kv_block_bytes_shipped": 0,
             "kv_blocks_ingested": 0, "kv_block_bytes_ingested": 0,
             "transfer_ms": [],
+            # multi-tenant QoS observables: preemptions/resumes count
+            # swap-out/swap-in events; the block/byte counters account the
+            # swapped KV payloads (their d2h/h2d dispatches also land in
+            # the PR 9 transfer-discipline counters); preempt_swap_ms /
+            # preempt_resume_ms are one sample per suspend / resume
+            # (device gather/ingest + host copy); quota_refused counts
+            # token-bucket admission refusals (NOT requests_rejected —
+            # a policy refusal must not dilute shed_rate); "tenants" maps
+            # tenant name -> its own submitted/completed/shed/
+            # quota_refused/preemptions/resumes counters
+            "preemptions": 0, "resumes": 0,
+            "kv_blocks_swapped_out": 0, "kv_block_bytes_swapped_out": 0,
+            "kv_blocks_resumed": 0, "kv_block_bytes_resumed": 0,
+            "preempt_swap_ms": [], "preempt_resume_ms": [],
+            "quota_refused": 0, "tenants": {},
         }
         if self.paged:
             self._pool = _PagedKVPool(self.kv_blocks, self.block_size,
@@ -1123,6 +1280,7 @@ class ServingEngine:
             "tokens_generated": 0,
             "requests_completed": 0,
             "requests_failed": 0,
+            "queued_interactive": 0,
         }
 
     # ------------------------------------------------------------------ jit
@@ -1891,13 +2049,105 @@ class ServingEngine:
                     np.float32)),
                 self._put(np.asarray(h.key, np.uint32)[None]))
 
+    # ------------------------------------------------- tenant QoS plumbing
+    def register_tenant(self, policy: TenantPolicy) -> None:
+        """Install (or replace) one tenant's :class:`TenantPolicy`.
+        Thread-safe; takes effect for the next admission.  Requests naming
+        no tenant (or an unregistered one) get batch-tier, weight-1,
+        unlimited-quota treatment."""
+        if not isinstance(policy, TenantPolicy):
+            raise ValueError(f"expected a TenantPolicy, got "
+                             f"{type(policy).__name__}")
+        with self._qlock:
+            self._tenants[policy.name] = policy
+
+    def _tenant_stats(self, tenant: str) -> Dict[str, int]:
+        """The per-tenant counter dict, created lazily.  Caller holds
+        ``_qlock`` (the counters are summed cross-thread by drain/stats
+        consumers under the same lock discipline as the globals)."""
+        ts = self.stats["tenants"].get(tenant)
+        if ts is None:
+            ts = {"submitted": 0, "completed": 0, "shed": 0,
+                  "quota_refused": 0, "preemptions": 0, "resumes": 0}
+            self.stats["tenants"][tenant] = ts
+        return ts
+
+    def _tier_of(self, tenant: str) -> str:  # dklint: holds _qlock
+        pol = self._tenants.get(tenant)
+        return "batch" if pol is None else pol.tier
+
+    def _q_push(self, h: RequestHandle, front: bool = False) -> None:  # dklint: holds _qlock
+        """Enqueue under ``_qlock``.  A tenant's first-ever push seeds its
+        stride pass at the current minimum among backlogged tenants, so a
+        newcomer (or a long-idle returner) can't bank idle time and then
+        monopolize admissions."""
+        q = self._queues.get(h.tenant)
+        if q is None:
+            q = self._queues[h.tenant] = []
+        if not q:  # (re)joining the backlog: no banked credit
+            floor = min((self._wf_pass.get(n, 0.0)
+                         for n, qq in self._queues.items() if qq),
+                        default=0.0)
+            self._wf_pass[h.tenant] = max(
+                self._wf_pass.get(h.tenant, 0.0), floor)
+        if front:
+            q.insert(0, h)
+        else:
+            q.append(h)
+        self._qdepth += 1
+        if self._tier_of(h.tenant) == "interactive":
+            self._q_int += 1
+
+    def _q_pop_locked(self) -> Optional[RequestHandle]:  # dklint: holds _qlock
+        """Weighted-fair pick under ``_qlock``: interactive-tier tenants
+        strictly before batch-tier; within a tier, the backlogged tenant
+        with the smallest stride pass (pass += 1/weight per pick); within
+        a tenant, highest ``priority`` first, FIFO among equals.  With a
+        single tenant of uniform priority this degenerates to the plain
+        FIFO the pre-QoS engine ran."""
+        best_name, best_key = None, None
+        for name, q in self._queues.items():
+            if not q:
+                continue
+            lvl = 0 if self._tier_of(name) == "interactive" else 1
+            key = (lvl, self._wf_pass.get(name, 0.0), name)
+            if best_key is None or key < best_key:
+                best_name, best_key = name, key
+        if best_name is None:
+            return None
+        q = self._queues[best_name]
+        idx = max(range(len(q)), key=lambda i: (q[i].priority, -i))
+        h = q.pop(idx)
+        self._qdepth -= 1
+        if self._tier_of(best_name) == "interactive":
+            self._q_int -= 1
+        pol = self._tenants.get(best_name)
+        weight = 1.0 if pol is None else pol.weight
+        self._wf_pass[best_name] = (self._wf_pass.get(best_name, 0.0)
+                                    + 1.0 / weight)
+        return h
+
+    def _q_snapshot_locked(self) -> List[RequestHandle]:  # dklint: holds _qlock
+        """Every queued handle (all tenants, queue order) under
+        ``_qlock``."""
+        return [h for q in self._queues.values() for h in q]
+
+    def _q_clear_locked(self) -> List[RequestHandle]:  # dklint: holds _qlock
+        out = self._q_snapshot_locked()
+        self._queues.clear()
+        self._qdepth = 0
+        self._q_int = 0
+        return out
+
     # ------------------------------------------------------------ admission
     def submit(self, prompt, num_steps: int, temperature: float = 0.0,
                top_k: Optional[int] = None, top_p: Optional[float] = None,
                eos_id: Optional[int] = None, pad_id: Optional[int] = None,
                seed: int = 0, rng: Optional[jax.Array] = None,
                block: bool = True, timeout: Optional[float] = None,
-               deadline_s: Optional[float] = None) -> RequestHandle:
+               deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None,
+               priority: int = 0) -> RequestHandle:
         """Enqueue one request; returns its :class:`RequestHandle`.
 
         ``prompt``: (P,) int tokens.  Sampling/stopping knobs mirror
@@ -1905,13 +2155,24 @@ class ServingEngine:
         request's rng is ``rng`` if given, else ``PRNGKey(seed)``.
         Backpressure: with the queue at ``queue_capacity``, ``block=True``
         waits (up to ``timeout``), ``block=False`` raises :class:`QueueFull`
-        immediately.  ``deadline_s`` (default: the engine's
+        immediately.  ``deadline_s`` (default: the submitting tenant's
+        ``TenantPolicy.deadline_s``, else the engine's
         ``default_deadline_s``) bounds the request's whole lifetime,
         queueing included: an expired request is retired with reason
         ``"deadline"`` — shed before prefill if still queued, mid-run with
         its slot freed immediately if decoding.  Raises :class:`Draining`
         while ``drain`` is in progress and :class:`EngineDead` on a dead
         engine.
+
+        QoS: ``tenant`` names the submitting tenant (default
+        ``"default"``) — admission is weighted-fair across backlogged
+        tenants per their registered :class:`TenantPolicy`; a tenant over
+        its token-bucket quota raises :class:`QuotaExceeded` immediately
+        (even with ``block=True`` — quota is policy, not backpressure).
+        ``priority`` orders requests WITHIN a tenant's queue (higher
+        first); batch-tier running requests may additionally be preempted
+        (swapped out, later resumed bit-identically) when the interactive
+        tier is starved.
         """
         if self.role == "decode":
             raise ValueError(
@@ -1924,8 +2185,15 @@ class ServingEngine:
                              f"{prompt.shape} — submit one request per row")
         if num_steps < 0:
             raise ValueError(f"num_steps must be >= 0, got {num_steps}")
+        tenant = "default" if tenant is None else str(tenant)
+        priority = int(priority)
         if deadline_s is None:
-            deadline_s = self.default_deadline_s
+            with self._qlock:  # register_tenant may race admission
+                pol = self._tenants.get(tenant)
+            if pol is not None and pol.deadline_s is not None:
+                deadline_s = pol.deadline_s
+            else:
+                deadline_s = self.default_deadline_s
         elif deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         key = rng if rng is not None else jax.random.PRNGKey(int(seed))
@@ -1944,18 +2212,34 @@ class ServingEngine:
             if self._draining:
                 raise Draining("serving engine is draining; admission "
                                "stopped")
+            pol = self._tenants.get(tenant)
+            if pol is not None and not pol._take(time.monotonic()):
+                # policy refusal BEFORE requests_submitted so drain()'s
+                # terminal accounting never waits on a refused request;
+                # per-tenant so one tenant's refusals don't dilute the
+                # global shed_rate (requests_rejected untouched)
+                self._tenant_stats(tenant)["quota_refused"] += 1
+                self.stats["quota_refused"] += 1
+                raise QuotaExceeded(
+                    f"tenant {tenant!r} over its token-bucket quota "
+                    f"({pol.rate}/s, burst {pol.burst})")
             self._next_id += 1
             handle = RequestHandle(self._next_id, prompt, num_steps,
                                    temperature, top_k, top_p, eos_id,
-                                   pad_id, key, deadline_s=deadline_s)
+                                   pad_id, key, deadline_s=deadline_s,
+                                   tenant=tenant, priority=priority)
             self.stats["requests_submitted"] += 1
+            tstats = self._tenant_stats(tenant)
+            tstats["submitted"] += 1
             if num_steps == 0:  # nothing to generate: complete in place
                 handle._finish("empty")
                 self.stats["requests_completed"] += 1
+                tstats["completed"] += 1
                 return handle
-            while len(self._queue) >= self.queue_capacity:
+            while self._qdepth >= self.queue_capacity:
                 if not block or not self._not_full.wait(timeout=timeout):
                     self.stats["requests_rejected"] += 1
+                    tstats["shed"] += 1
                     raise QueueFull(
                         f"admission queue at capacity "
                         f"({self.queue_capacity}); request {handle.id} shed")
@@ -1971,11 +2255,11 @@ class ServingEngine:
                     self.stats["requests_rejected"] += 1
                     raise Draining("serving engine is draining; admission "
                                    "stopped")
-            self._queue.append(handle)
+            self._q_push(handle)
             self.stats["queue_peak"] = max(self.stats["queue_peak"],
-                                           len(self._queue))
+                                           self._qdepth)
             self._have_work.notify()
-            qd = len(self._queue)
+            qd = self._qdepth
         self._publish_load(qd=qd)
         return handle
 
@@ -1986,8 +2270,9 @@ class ServingEngine:
                          eos_id: Optional[int] = None,
                          pad_id: Optional[int] = None,
                          block: bool = True, timeout: Optional[float] = None,
-                         deadline_s: Optional[float] = None
-                         ) -> RequestHandle:
+                         deadline_s: Optional[float] = None,
+                         tenant: Optional[str] = None,
+                         priority: int = 0) -> RequestHandle:
         """Decode-role admission: enqueue a request whose prefill already
         ran elsewhere.  ``blocks`` is the shipped
         :class:`networking.KVBlocks` (prompt KV in logical block order +
@@ -2013,8 +2298,15 @@ class ServingEngine:
         if num_steps < 1:
             raise ValueError(f"num_steps must be >= 1 (it counts the "
                              f"shipped first token), got {num_steps}")
+        tenant = "default" if tenant is None else str(tenant)
+        priority = int(priority)
         if deadline_s is None:
-            deadline_s = self.default_deadline_s
+            with self._qlock:  # register_tenant may race admission
+                pol = self._tenants.get(tenant)
+            if pol is not None and pol.deadline_s is not None:
+                deadline_s = pol.deadline_s
+            else:
+                deadline_s = self.default_deadline_s
         elif deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         kvb = blocks
@@ -2060,12 +2352,22 @@ class ServingEngine:
             if self._draining:
                 raise Draining("serving engine is draining; admission "
                                "stopped")
+            pol = self._tenants.get(tenant)
+            if pol is not None and not pol._take(time.monotonic()):
+                self._tenant_stats(tenant)["quota_refused"] += 1
+                self.stats["quota_refused"] += 1
+                raise QuotaExceeded(
+                    f"tenant {tenant!r} over its token-bucket quota "
+                    f"({pol.rate}/s, burst {pol.burst})")
             self._next_id += 1
             handle = RequestHandle(self._next_id, prompt, num_steps,
                                    temperature, top_k, top_p, eos_id,
-                                   pad_id, key, deadline_s=deadline_s)
+                                   pad_id, key, deadline_s=deadline_s,
+                                   tenant=tenant, priority=priority)
             handle.kvblocks = kvb
             self.stats["requests_submitted"] += 1
+            tstats = self._tenant_stats(tenant)
+            tstats["submitted"] += 1
             # the shipped first token IS this request's first generated
             # token: push it now (TTFT on this engine is the hand-off
             # instant) and complete in place when it already terminates
@@ -2076,11 +2378,13 @@ class ServingEngine:
                           and int(first_token) == int(eos_id) else "length")
                 handle._finish(reason)
                 self.stats["requests_completed"] += 1
+                tstats["completed"] += 1
                 self.stats["tokens_generated"] += 1
                 return handle
-            while len(self._queue) >= self.queue_capacity:
+            while self._qdepth >= self.queue_capacity:
                 if not block or not self._not_full.wait(timeout=timeout):
                     self.stats["requests_rejected"] += 1
+                    tstats["shed"] += 1
                     raise QueueFull(
                         f"admission queue at capacity "
                         f"({self.queue_capacity}); request {handle.id} shed")
@@ -2092,18 +2396,18 @@ class ServingEngine:
                     raise Draining("serving engine is draining; admission "
                                    "stopped")
             self.stats["tokens_generated"] += 1
-            self._queue.append(handle)
+            self._q_push(handle)
             self.stats["queue_peak"] = max(self.stats["queue_peak"],
-                                           len(self._queue))
+                                           self._qdepth)
             self._have_work.notify()
-            qd = len(self._queue)
+            qd = self._qdepth
         self._publish_load(qd=qd)
         return handle
 
     @property
     def queue_depth(self) -> int:
         with self._qlock:
-            return len(self._queue)
+            return self._qdepth
 
     @property
     def active_requests(self) -> int:
@@ -2123,6 +2427,8 @@ class ServingEngine:
         counter — stale-by-one is fine for routing."""
         prev = self._load_snapshot
         stats = self.stats
+        with self._qlock:
+            qi = self._q_int
         self._load_snapshot = {
             "queue_depth": prev["queue_depth"] if qd is None else int(qd),
             "slots_free": len(self._free),
@@ -2139,6 +2445,7 @@ class ServingEngine:
             "tokens_generated": stats["tokens_generated"],
             "requests_completed": stats["requests_completed"],
             "requests_failed": stats["requests_failed"],
+            "queued_interactive": qi,
         }
 
     def load(self) -> Dict[str, Any]:
@@ -2153,11 +2460,11 @@ class ServingEngine:
 
     def _pop_queued(self) -> Optional[RequestHandle]:
         with self._qlock:
-            if not self._queue:
+            h = self._q_pop_locked()
+            if h is None:
                 return None
-            h = self._queue.popleft()
             self._not_full.notify()
-            qd = len(self._queue)
+            qd = self._qdepth
         self._publish_load(qd=qd)
         return h
 
@@ -2185,16 +2492,21 @@ class ServingEngine:
         now = time.perf_counter()
         shed: List[RequestHandle] = []
         with self._qlock:
-            if self._queue and any(h.cancelled_at is not None
-                                   or h._expired(now)
-                                   for h in self._queue):
-                keep: "collections.deque[RequestHandle]" = collections.deque()
-                for h in self._queue:
+            for name, q in self._queues.items():
+                if not any(h.cancelled_at is not None or h._expired(now)
+                           for h in q):
+                    continue
+                keep: List[RequestHandle] = []
+                for h in q:
                     if h.cancelled_at is not None or h._expired(now):
                         shed.append(h)
+                        self._qdepth -= 1
+                        if self._tier_of(name) == "interactive":
+                            self._q_int -= 1
                     else:
                         keep.append(h)
-                self._queue = keep
+                self._queues[name] = keep
+            if shed:
                 self._not_full.notify_all()
         for h in shed:
             reason = "cancel" if h.cancelled_at is not None else "deadline"
@@ -2205,6 +2517,7 @@ class ServingEngine:
                 self._account_terminal(h, reason, now, held_slot=False)
                 with self._qlock:  # drain()'s busy() sums this cross-thread
                     self.stats["requests_completed"] += 1
+                    self._tenant_stats(h.tenant)["completed"] += 1
         did = bool(shed)
         for slot in np.flatnonzero(self._active):
             h = self._handles[slot]
@@ -2222,6 +2535,28 @@ class ServingEngine:
             elif h._expired(now):
                 self._abort_prefill(slot, "deadline")
                 did = True
+        # suspended (swapped-out) requests hold no slot or blocks — their
+        # cancel/deadline path is pure bookkeeping: drop the host-side
+        # swap record and retire the handle (held_slot=False: nothing to
+        # reclaim, so no slot_reclaim_ms sample)
+        with self._qlock:  # _declare_dead clears _suspended cross-thread
+            susp = list(self._suspended.items())
+        for rid, rec in susp:
+            h = rec.handle
+            if h.cancelled_at is not None:
+                reason = "cancel"
+            elif h._expired(now):
+                reason = "deadline"
+            else:
+                continue
+            with self._qlock:
+                self._suspended.pop(rid, None)
+            if h._finish(reason):
+                self._account_terminal(h, reason, now, held_slot=False)
+                with self._qlock:
+                    self.stats["requests_completed"] += 1
+                    self._tenant_stats(h.tenant)["completed"] += 1
+            did = True
         return did
 
     def _abort_prefill(self, slot: int, reason: str) -> None:
@@ -2240,6 +2575,7 @@ class ServingEngine:
         if h._finish(reason):
             with self._qlock:  # drain()'s busy() sums this cross-thread
                 self.stats["requests_completed"] += 1
+                self._tenant_stats(h.tenant)["completed"] += 1
             self._account_terminal(h, reason, time.perf_counter())
 
     def _release_blocks(self, slot: int) -> None:
@@ -2348,7 +2684,7 @@ class ServingEngine:
                     break
                 if not self._ingest(h):
                     with self._qlock:
-                        self._queue.appendleft(h)
+                        self._q_push(h, front=True)
                     break
                 budget -= 1
                 did = True
@@ -2370,9 +2706,16 @@ class ServingEngine:
                 plan = self._admit_blocks(h)
                 if plan is None:
                     # no blocks even after eviction: requeue at the FRONT
-                    # and stop admitting — retirements will free blocks
+                    # and stop admitting — retirements will free blocks.
+                    # An interactive-tier request starving on BLOCKS (not
+                    # slots) flags the preemption pass: next iteration a
+                    # batch-tier victim is swapped out to free its chain
                     with self._qlock:
-                        self._queue.appendleft(h)
+                        interactive = (self._tier_of(h.tenant)
+                                       == "interactive")
+                        self._q_push(h, front=True)
+                    if interactive:
+                        self._int_blocked = True
                     break
             budget -= 1
             did = True
@@ -2786,7 +3129,242 @@ class ServingEngine:
         if h._finish(reason):  # no-op when _declare_dead already failed it
             with self._qlock:  # drain()'s busy() sums this cross-thread
                 self.stats["requests_completed"] += 1
+                self._tenant_stats(h.tenant)["completed"] += 1
             self._account_terminal(h, reason, time.perf_counter())
+
+    # ----------------------------------------------- preemption (QoS swap)
+    def preempt(self, handle: RequestHandle) -> bool:
+        """Mark a RUNNING request for preemption (thread-safe): within one
+        scheduler iteration its live KV blocks are gathered to host
+        memory, its slot and blocks are freed, and it waits in the
+        suspended set until capacity is free again — then resumes through
+        the jitted ingest program with a bit-identical token stream.
+        The deterministic-control surface tests and operators use; the
+        scheduler fires the same path itself when the interactive tier is
+        starved.  Returns False when the request already finished or this
+        engine cannot preempt (needs ``paged=True``, bucketed prefill,
+        ``role="unified"``, no rolling window, no speculation)."""
+        if not self._can_preempt:
+            return False
+        with handle._cond:
+            if handle.finish is not None:
+                return False
+        with self._qlock:
+            self._preempt_ids.add(handle.id)
+            self._have_work.notify_all()
+        return True
+
+    def _ensure_swap_fns(self) -> None:
+        """Build (lazily) the swap-out gather and swap-in ingest programs.
+        Both reuse the disaggregation machinery — ``gather_slot_state``
+        wraps the prefill role's block gather and ``_build_ingest_fn`` is
+        exactly the decode role's install program — so a preemption
+        round-trips bytes through the very path PR 16 ships them over the
+        wire with."""
+        if self._swap_gather_fn is None:
+            self._swap_gather_fn = jax.jit(_dec.gather_slot_state)
+        if self._swap_ingest_fn is None:
+            self._swap_ingest_fn = self._build_ingest_fn()
+
+    def _suspend_slot(self, slot: int) -> bool:
+        """Swap one running request out: flush the decode lookahead (so
+        the handle's emitted tokens reach the true frontier), gather its
+        live KV blocks + device frontier in one jitted dispatch, copy
+        them to host memory, then free the slot and blocks through the
+        standard deactivation path — WITHOUT making the handle terminal.
+        The d2h fetches land in the PR 9 transfer counters like any
+        extraction.  Returns False when the request retired during the
+        flush (nothing left to suspend)."""
+        h = self._handles[slot]
+        if h is None:
+            return False
+        t0 = time.perf_counter()
+        if self._pending:
+            self._drain_pending(flush=True)
+        if self._handles[slot] is not h or h.finish is not None:
+            return False  # eos/length/cancel landed in the flush
+        self._ensure_swap_fns()
+        plan = self._plans[slot]
+        bs = self.block_size
+        n_src = max(-(-int(self._positions[slot]) // bs), 1)
+        rows = np.full((self._blocks_per_slot,), self.kv_blocks, np.int32)
+        rows[:n_src] = plan.blocks[:n_src]
+        phys = (rows[:, None] * bs
+                + np.arange(bs, dtype=np.int32)[None, :]).reshape(-1)
+        dev, d_tok, d_pos, _ = self._swap_gather_fn(
+            self.caches, self._put(phys), self._dev_tok, self._dev_pos,
+            self._dev_keys, self._put(np.int32(slot)))
+        keep = n_src * bs
+        layers = [None if c is None else
+                  {k: np.ascontiguousarray(self._fetch(v)[:keep])
+                   for k, v in c.items()}
+                  for c in dev]
+        pos, tok = int(self._fetch(d_pos)), int(self._fetch(d_tok))
+        rec = _SuspendedReq(h, layers, n_src, pos, tok)
+        # free the slot + blocks exactly like _retire, minus the terminal
+        # transition: the handle stays live, parked in _suspended
+        self._handles[slot] = None
+        self._active[slot] = False
+        self._temp[slot] = 0.0
+        self._topk[slot] = 0
+        self._topp[slot] = 0.0
+        self._positions[slot] = 0
+        self._cur_tok[slot] = 0
+        self._free.append(slot)
+        if self._draft_model is None:
+            self._dev_act, self._dev_bt = self._deact_fn(
+                self._dev_act, self._dev_bt, slot)
+        else:
+            (self._dev_act, self._dev_bt, self._dev_dbt) = self._deact_fn(
+                self._dev_act, self._dev_bt, self._dev_dbt, slot)
+        self._release_blocks(slot)
+        h.slot = None
+        nbytes = sum(a.nbytes for c in layers if c is not None
+                     for a in c.values())
+        with self._qlock:  # _declare_dead drains _suspended cross-thread
+            self._suspended[h.id] = rec
+            self.stats["preemptions"] += 1
+            self._tenant_stats(h.tenant)["preemptions"] += 1
+        self.stats["kv_blocks_swapped_out"] += n_src
+        self.stats["kv_block_bytes_swapped_out"] += nbytes
+        self.stats["preempt_swap_ms"].append(
+            (time.perf_counter() - t0) * 1000.0)
+        return True
+
+    def _resume_suspended(self, rec: _SuspendedReq) -> bool:
+        """Swap one suspended request back in: allocate a fresh private
+        chain, scatter the host payload into it, and re-install the
+        slot's device row at the SUSPENDED frontier — original RNG key,
+        current token, position — through the jitted ingest program.
+        Sampling keys fold per (key, absolute position), so the resumed
+        stream is bit-identical to the run that was never preempted.
+        Returns False when blocks or slots are unavailable (the caller
+        retries next iteration)."""
+        h = rec.handle
+        if not self._free:
+            return False
+        bs = self.block_size
+        total = len(h.prompt) + h.num_steps
+        plan = self._pool.admit(None, -(-total // bs))
+        if plan is None:
+            return False
+        t0 = time.perf_counter()
+        self._ensure_swap_fns()
+        slot = self._free.pop()
+        h.slot = slot
+        self._handles[slot] = h
+        self._plans[slot] = plan
+        self.stats["slot_requests"][slot] += 1
+        n_src = rec.n_blocks
+        rows = np.full((self._blocks_per_slot,), self.kv_blocks, np.int32)
+        rows[:n_src] = plan.blocks[:n_src]
+        phys = (rows[:, None] * bs
+                + np.arange(bs, dtype=np.int32)[None, :]).reshape(-1)
+        pad = (self._blocks_per_slot - n_src) * bs
+        payload = []
+        nbytes = 0
+        for c in rec.layers:
+            if c is None:
+                payload.append(None)
+                continue
+            nbytes += sum(a.nbytes for a in c.values())
+            payload.append({
+                k: self._put(np.concatenate(
+                    [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+                    if pad else v)
+                for k, v in c.items()})
+        bt, _ = self._row_tables(plan)
+        (self.caches, self._dev_bt, self._dev_tok, self._dev_pos,
+         self._dev_act, self._dev_temp, self._dev_topk, self._dev_topp,
+         self._dev_keys) = self._swap_ingest_fn(
+            self.caches, self._dev_bt, self._dev_tok, self._dev_pos,
+            self._dev_act, self._dev_temp, self._dev_topk,
+            self._dev_topp, self._dev_keys,
+            self._put(phys), payload, self._put(np.int32(slot)),
+            self._put(bt), self._put(np.int32(rec.tok)),
+            self._put(np.int32(rec.pos)),
+            self._put(np.float32(h.temperature)),
+            self._put(np.int32(0 if h.top_k is None else h.top_k)),
+            self._put(np.float32(0.0 if h.top_p is None else h.top_p)),
+            self._put(np.asarray(h.key, np.uint32)))
+        self._mirror_admit(slot, h)
+        self._positions[slot] = rec.pos   # the suspended frontier, not
+        self._cur_tok[slot] = rec.tok     # the prompt boundary
+        with self._qlock:
+            self.stats["resumes"] += 1
+            self._tenant_stats(h.tenant)["resumes"] += 1
+        self.stats["kv_blocks_resumed"] += n_src
+        self.stats["kv_block_bytes_resumed"] += nbytes
+        self.stats["preempt_resume_ms"].append(
+            (time.perf_counter() - t0) * 1000.0)
+        return True
+
+    def _balance_qos(self) -> bool:
+        """The preemption scheduler pass (between ``_reap`` and
+        ``_schedule_prefills``): suspend explicitly-marked requests and —
+        when the interactive tier is starved of slots or blocks — the
+        lowest-priority, youngest batch-tier running request (one victim
+        per iteration: preemption is expensive; starvation that persists
+        keeps firing it); then resume suspended requests oldest-first
+        whenever capacity is free and no interactive request is waiting
+        (suspended requests outrank the queue — they hold paid-for
+        progress)."""
+        did = False
+        suspended_now = set()
+        with self._qlock:
+            explicit = set(self._preempt_ids)
+            self._preempt_ids.clear()
+            starved = self._q_int > 0
+        blocked = self._int_blocked
+        self._int_blocked = False
+        if explicit:
+            for slot in np.flatnonzero(self._active):
+                h = self._handles[int(slot)]
+                if h is not None and h.id in explicit:
+                    if self._suspend_slot(int(slot)):
+                        suspended_now.add(h.id)
+                        did = True
+        if starved and (not self._free or blocked):
+            victims = []
+            for slot in np.flatnonzero(self._active):
+                h = self._handles[int(slot)]
+                if h is None:
+                    continue
+                with self._qlock:
+                    tier = self._tier_of(h.tenant)
+                if tier == "interactive":
+                    continue
+                victims.append((h.priority, -(h.started_at or 0.0),
+                                int(slot)))
+            if victims:
+                victims.sort()
+                v = self._handles[victims[0][2]]
+                if self._suspend_slot(victims[0][2]):
+                    suspended_now.add(v.id)
+                    did = True
+        with self._qlock:  # _declare_dead drains _suspended cross-thread
+            waiting_int = self._q_int > 0
+            susp = list(self._suspended.items())
+        if susp and self._free and not waiting_int:
+            for rid, rec in susp:
+                if not self._free:
+                    break
+                if rid in suspended_now:
+                    # never round-trip a request suspended THIS pass:
+                    # the capacity it freed must first be offered to
+                    # whatever starved it (admitted one stage later,
+                    # in _schedule_prefills)
+                    continue
+                if rec.handle.finish is not None:
+                    with self._qlock:
+                        self._suspended.pop(rid, None)  # failed meanwhile
+                    continue
+                if not self._resume_suspended(rec):
+                    break  # block-starved: wait for retirements
+                with self._qlock:
+                    self._suspended.pop(rid, None)
+                did = True
+        return did
 
     # ------------------------------------------------------------ schedule
     def step(self) -> bool:
@@ -2806,6 +3384,12 @@ class ServingEngine:
         self.last_beat = time.monotonic()
         steps_before = self.stats["decode_steps"]
         did = self._reap()
+        if self._can_preempt:
+            with self._qlock:
+                qos_work = bool(self._preempt_ids or self._suspended
+                                or self._q_int)
+            if qos_work or self._int_blocked:
+                did = self._balance_qos() or did
         did = self._schedule_prefills() or did
         if self.role == "prefill":
             # no token loop at all: drain every dispatched prefill NOW
@@ -3039,7 +3623,34 @@ class ServingEngine:
                 EngineDead(f"drain timed out after {timeout}s with work "
                            f"in flight"), reason="drain")
         self.stop(join_timeout=10.0 if clean else 2.0)
+        if not clean:
+            self._fail_stragglers(reason="drain")
         return clean
+
+    def _fail_stragglers(self, reason: str) -> None:
+        """Post-join sweep for the declare→exit window: a request the
+        scheduler popped from the queue BEFORE ``_declare_dead`` swept it
+        can land in ``_handles`` (or ``_suspended``) during the loop's
+        final iteration, AFTER the sweep — invisible to both.  With the
+        loop joined, fail whatever it left live so no waiter hangs."""
+        exc = self._dead
+        if exc is None:
+            return
+        with self._qlock:
+            suspended = [rec.handle for rec in self._suspended.values()]
+            self._suspended.clear()
+        for h in suspended:
+            if h._fail(EngineDead(
+                    f"request was swapped out (preempted) and not resumed "
+                    f"before engine shutdown: {exc}"), reason=reason):
+                with self._qlock:
+                    self.stats["requests_failed"] += 1
+                    self._tenant_stats(h.tenant)["completed"] += 1
+        for h in list(self._handles):
+            if h is not None and h._fail(EngineDead(str(exc)),
+                                         reason=reason):
+                with self._qlock:
+                    self.stats["requests_failed"] += 1
 
     # -------------------------------------------------- failure semantics
     def declare_dead(self, reason: str) -> None:
@@ -3065,10 +3676,22 @@ class ServingEngine:
             if self._dead is not None:
                 return
             self._dead = exc
-            queued = list(self._queue)
-            self._queue.clear()
+            queued = self._q_clear_locked()
+            suspended = [rec.handle for rec in self._suspended.values()]
+            self._suspended.clear()
             self._not_full.notify_all()
             self._have_work.notify_all()
+        # suspended requests hold no slot and no blocks — they are invisible
+        # to _handles and to busy()'s terminal accounting until failed here;
+        # without this, drain()/scale_down() would hang on a swapped-out
+        # request forever (its waiter never reaches a terminal state)
+        for h in suspended:
+            if h._fail(EngineDead(
+                    f"request was swapped out (preempted) and not resumed "
+                    f"before engine shutdown: {exc}"), reason=reason):
+                with self._qlock:
+                    self.stats["requests_failed"] += 1
+                    self._tenant_stats(h.tenant)["completed"] += 1
         inflight = queued + [h for h in self._handles if h is not None]
         for h in inflight:
             # _handles is read without the scheduler's lock: a still-running
@@ -3089,6 +3712,10 @@ class ServingEngine:
         """A fresh engine over the same model/params and knobs — new KV
         slot pool, empty queue, fresh stats (the ``EngineSupervisor``
         restart path; mirrors ``SocketParameterServer.respawn_clone``)."""
+        with self._qlock:  # register_tenant may race a supervisor respawn
+            # QoS policy carries over with FRESH token buckets — banked
+            # quota credit belongs to the dead engine's admission history
+            tenant_pols = [p.clone() for p in self._tenants.values()]
         eng = ServingEngine(
             (self.model, self.params), num_slots=self.num_slots,
             max_len=self.max_len, queue_capacity=self.queue_capacity,
@@ -3104,7 +3731,8 @@ class ServingEngine:
             # FRESH trie + allocator — cached prefix chains belong to the
             # dead pool's arena contents, which the clone does not share
             paged=self.paged, block_size=self.block_size,
-            kv_blocks=self.kv_blocks, role=self.role)
+            kv_blocks=self.kv_blocks, role=self.role,
+            tenants=tenant_pols or None)
         # quantized clones re-quantize idempotently; the f32 skeleton the
         # hot-reload path maps pulled weights onto carries over as-is
         # (the clone's params are already quantized, so it could not
@@ -3318,6 +3946,37 @@ class ServingEngine:
                         self._apply_state(self._final_fn(width)(
                             *self._prog_args(), staging, toks,
                             self.num_slots, 0, 0, 1, *one))
+        # QoS engines also pre-pay the preemption swap programs: gather
+        # (all-null rows read the null block) and ingest (slot num_slots
+        # drops the install, the scatter lands in the null block) — a
+        # first preemption under live overload must not stall the decode
+        # loop a jit-compile long.
+        with self._qlock:
+            qos_on = bool(self._tenants)
+        if self._can_preempt and qos_on:
+            self._ensure_swap_fns()
+            n = self._blocks_per_slot * self.block_size
+            null_rows = jnp.full((n,), self.kv_blocks * self.block_size,
+                                 jnp.int32)
+            jax.block_until_ready(jax.tree_util.tree_leaves(
+                self._swap_gather_fn(self.caches, null_rows, self._dev_tok,
+                                     self._dev_pos, self._dev_keys,
+                                     jnp.int32(0))[0])[0])
+            payload = [None if c is None else
+                       {k: jnp.zeros((n,) + v.shape[1:], v.dtype)
+                        for k, v in c.items()}
+                       for c in self.caches]
+            (self.caches, self._dev_bt, self._dev_tok, self._dev_pos,
+             self._dev_act, self._dev_temp, self._dev_topk,
+             self._dev_topp, self._dev_keys) = self._swap_ingest_fn(
+                self.caches, self._dev_bt, self._dev_tok, self._dev_pos,
+                self._dev_act, self._dev_temp, self._dev_topk,
+                self._dev_topp, self._dev_keys, null_rows, payload,
+                jnp.int32(self.num_slots),
+                jnp.full((self._t_tbl,), self.kv_blocks, jnp.int32),
+                jnp.int32(0), jnp.int32(0), jnp.float32(0.0),
+                jnp.int32(0), jnp.float32(0.0),
+                jnp.zeros((2,), jnp.uint32))
         jax.block_until_ready(jax.tree_util.tree_leaves(self.caches)[0])
         return self
 
@@ -3327,7 +3986,8 @@ class ServingEngine:
                 if not self.step():
                     with self._qlock:
                         self._have_work.wait_for(
-                            lambda: bool(self._queue) or not self._running,
+                            lambda: self._qdepth > 0 or bool(self._preempt_ids)
+                            or not self._running,
                             timeout=0.05)
         except Exception as e:
             # a crashed decode loop fails loudly: every in-flight handle
@@ -3607,7 +4267,14 @@ class ServingServer:
                             pad_id=msg.get("pad_id"),
                             seed=int(msg.get("seed", 0)),
                             deadline_s=msg.get("deadline_s"),
+                            tenant=msg.get("tenant"),
+                            priority=int(msg.get("priority", 0)),
                             block=False)
+                    except QuotaExceeded as e:
+                        networking.send_data(
+                            conn, {"ok": False, "error": str(e),
+                                   "kind": "quota"}, pool=send_pool)
+                        continue
                     except QueueFull:
                         networking.send_data(
                             conn, {"ok": False, "error": "queue full",
@@ -3660,7 +4327,14 @@ class ServingServer:
                             eos_id=msg.get("eos_id"),
                             pad_id=msg.get("pad_id"),
                             deadline_s=msg.get("deadline_s"),
+                            tenant=msg.get("tenant"),
+                            priority=int(msg.get("priority", 0)),
                             block=False)
+                    except QuotaExceeded as e:
+                        networking.send_data(
+                            conn, {"ok": False, "error": str(e),
+                                   "kind": "quota"}, pool=send_pool)
+                        continue
                     except QueueFull:
                         networking.send_data(
                             conn, {"ok": False, "error": "queue full",
@@ -3874,6 +4548,8 @@ class ServingServer:
 
 def _raise_typed(kind: Optional[str], err: str):
     """Map a typed error reply back to the exception the engine raised."""
+    if kind == "quota":  # before backpressure: QuotaExceeded IS a QueueFull
+        raise QuotaExceeded(err)
     if kind == "backpressure" or "queue full" in err:
         raise QueueFull(err)
     if kind == "draining":

@@ -52,7 +52,9 @@ def make_trace(num_requests: int, seed: int = 0, vocab: int = 16,
                pattern: str = "random",
                prefix_groups: Optional[int] = None,
                prefix_len: int = 0,
-               long_fraction: float = 0.25) -> List[Dict[str, Any]]:
+               long_fraction: float = 0.25,
+               tenants: int = 0,
+               tier_mix: float = 0.25) -> List[Dict[str, Any]]:
     """A deterministic request trace: seeded prompt contents + lengths, a
     ``sampled_fraction`` of requests sampling at ``temperature`` (per-
     request seeds), the rest greedy — so the slot batch always mixes
@@ -82,7 +84,14 @@ def make_trace(num_requests: int, seed: int = 0, vocab: int = 16,
     engine every admission after a group's first is a prefix hit that
     prefills only the suffix; a dense engine prefills ``prefix_len +
     suffix`` every time — the TTFT comparison ``bench.py``'s
-    ``serving_prefix_ttft_p99_ms`` leg measures."""
+    ``serving_prefix_ttft_p99_ms`` leg measures.
+
+    ``tenants``/``tier_mix``: the MIXED-TENANT QoS trace (PR 18) — with
+    ``tenants >= 2``, a ``tier_mix`` fraction of requests carry
+    ``tenant="interactive"`` and the rest spread over ``tenants - 1``
+    batch tenants (``"batch0"``, ``"batch1"``, ...), matching the
+    policies :func:`qos_policies` builds.  The draw is seeded, so the
+    tier of request *i* is a pure function of ``(seed, i)``."""
     rng = np.random.default_rng(seed)
     prefixes = None
     if prefix_groups is not None:
@@ -117,8 +126,96 @@ def make_trace(num_requests: int, seed: int = 0, vocab: int = 16,
         }
         if temperature > 0.0 and rng.random() < sampled_fraction:
             req["temperature"] = float(temperature)
+        if int(tenants) >= 2:
+            if rng.random() < float(tier_mix):
+                req["tenant"] = "interactive"
+            else:
+                req["tenant"] = f"batch{int(rng.integers(tenants - 1))}"
         trace.append(req)
     return trace
+
+
+def qos_policies(tenants: int = 2, interactive_weight: float = 4.0,
+                 interactive_rate: Optional[float] = None,
+                 interactive_deadline_s: Optional[float] = None):
+    """The :class:`distkeras_tpu.serving.TenantPolicy` set matching
+    :func:`make_trace`'s tenant names: one ``"interactive"`` tenant
+    (interactive tier, ``interactive_weight``× admission weight, optional
+    token-bucket ``rate`` and tier deadline) plus ``tenants - 1``
+    weight-1 batch tenants."""
+    from distkeras_tpu.serving import TenantPolicy
+
+    pols = [TenantPolicy("interactive", tier="interactive",
+                         weight=interactive_weight,
+                         rate=interactive_rate,
+                         deadline_s=interactive_deadline_s)]
+    for i in range(max(int(tenants) - 1, 1)):
+        pols.append(TenantPolicy(f"batch{i}", tier="batch", weight=1.0))
+    return pols
+
+
+def run_overload(engine, trace: Sequence[Dict[str, Any]], qps: float,
+                 timeout_s: float = 300.0) -> Dict[str, Any]:
+    """The QoS overload leg: open-loop arrivals at an offered ``qps``
+    past capacity over a mixed-tenant trace.  The acceptance shape
+    (bench fields ``serving_interactive_p99_ms_under_overload`` /
+    ``serving_batch_completion_rate`` / ``serving_preempt_resume_ms``):
+    the interactive tier holds its latency band — weighted-fair
+    admission pops it first and starvation preempts batch-tier slots —
+    while the batch tier absorbs ALL the queueing, shedding, and
+    preemption."""
+    from distkeras_tpu.serving import QueueFull
+
+    engine.start()
+    handles = []
+    shed = {"interactive": 0, "batch": 0}
+    t0 = time.perf_counter()
+    for i, req in enumerate(trace):
+        due = t0 + i / float(qps)
+        delay = due - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        tier = ("interactive" if req.get("tenant") == "interactive"
+                else "batch")
+        try:
+            # QuotaExceeded IS a QueueFull: quota refusals count as sheds
+            handles.append((tier, engine.submit(block=False, **req)))
+        except QueueFull:
+            shed[tier] += 1
+    lat = {"interactive": [], "batch": []}
+    done = {"interactive": 0, "batch": 0}
+    total = dict(shed)
+    for tier, h in handles:
+        total[tier] += 1
+        h.wait(timeout=timeout_s)
+        if h.finish in ("eos", "length", "empty"):
+            done[tier] += 1
+            lat[tier].append(h.latency_s)
+    wall = time.perf_counter() - t0
+    s = engine.stats
+    return {
+        "offered_qps": float(qps),
+        "wall_s": round(wall, 3),
+        "interactive_p50_ms": _percentile_ms(lat["interactive"], 50),
+        "interactive_p99_ms": _percentile_ms(lat["interactive"], 99),
+        "batch_p99_ms": _percentile_ms(lat["batch"], 99),
+        "interactive_completion_rate": round(
+            done["interactive"] / max(total["interactive"], 1), 4),
+        "batch_completion_rate": round(
+            done["batch"] / max(total["batch"], 1), 4),
+        "shed_interactive": shed["interactive"],
+        "shed_batch": shed["batch"],
+        "preemptions": s["preemptions"],
+        "resumes": s["resumes"],
+        "preempt_swap_ms": (round(float(np.mean(s["preempt_swap_ms"])), 3)
+                            if s["preempt_swap_ms"] else None),
+        "preempt_resume_ms": (round(float(
+            np.mean(s["preempt_resume_ms"])), 3)
+            if s["preempt_resume_ms"] else None),
+        "kv_blocks_swapped_out": s["kv_blocks_swapped_out"],
+        "quota_refused": s["quota_refused"],
+        "tenants": {t: dict(v) for t, v in s["tenants"].items()},
+    }
 
 
 def _percentile_ms(latencies_s: Sequence[float], q: float) -> Optional[float]:
@@ -448,7 +545,8 @@ def build_fleet(replicas: int = 2, affinity: str = "prefix",
                 paged: bool = False,
                 block_size: Optional[int] = None,
                 kv_blocks: Optional[int] = None,
-                router_seed: int = 0):
+                router_seed: int = 0,
+                tenants=None):
     """``replicas`` identical engines serving the SAME weights behind a
     :class:`distkeras_tpu.router.ServingRouter` — the fleet analog of
     ``build_engine`` (one model build, N engines, so what the bench
@@ -482,7 +580,8 @@ def build_fleet(replicas: int = 2, affinity: str = "prefix",
     router = ServingRouter([mk() for _ in range(int(replicas))],
                            affinity=affinity, seed=router_seed,
                            engine_factory=mk,
-                           max_replicas=max(int(replicas) * 2, 2))
+                           max_replicas=max(int(replicas) * 2, 2),
+                           tenants=tenants)
     return fitted, router
 
 
@@ -646,12 +745,32 @@ def main():
                     help="router dispatch policy: prefix-affinity "
                          "(cache-aware, the default), pure least-loaded, "
                          "or seeded random (the control arm)")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="mixed-tenant QoS trace: one interactive tenant "
+                         "plus N-1 batch tenants, with matching "
+                         "TenantPolicy registrations on the engine/fleet "
+                         "(needs >= 2)")
+    ap.add_argument("--tier-mix", type=float, default=0.25,
+                    help="fraction of requests on the interactive tenant "
+                         "(with --tenants)")
+    ap.add_argument("--overload", type=float, default=None,
+                    help="run the QoS overload leg instead of the closed "
+                         "loop: open-loop arrivals at this offered QPS "
+                         "over the mixed-tenant trace, printing per-tier "
+                         "latency/completion + preemption counters")
     args = ap.parse_args()
 
     if args.router and (args.disaggregate or args.spec_draft is not None):
         ap.error("--router replicates unified engines; it composes with "
                  "--disaggregate or --spec-draft only behind a "
                  "ServingServer address, not in-process")
+    if args.overload is not None and args.tenants < 2:
+        ap.error("--overload is the mixed-tenant QoS leg; pass "
+                 "--tenants >= 2")
+    if args.tenants and args.disaggregate:
+        ap.error("--tenants registers policies on unified engines or a "
+                 "router fleet; DisaggPair does not take tenant policies")
+    policies = qos_policies(args.tenants) if args.tenants >= 2 else None
 
     if args.router:
         fitted, engine = build_fleet(replicas=args.replicas,
@@ -662,7 +781,8 @@ def main():
                                      prefill_chunk=args.prefill_chunk,
                                      paged=args.paged,
                                      block_size=args.block_size,
-                                     kv_blocks=args.kv_blocks)
+                                     kv_blocks=args.kv_blocks,
+                                     tenants=policies)
     else:
         fitted, engine = build_engine(num_slots=args.slots,
                                       max_len=args.max_len,
@@ -677,12 +797,22 @@ def main():
                                       kv_blocks=args.kv_blocks,
                                       disaggregate=args.disaggregate,
                                       prefill_engines=args.prefill_engines)
+    if policies is not None and not args.router:
+        for p in policies:
+            engine.register_tenant(p)
     trace = make_trace(args.requests, num_steps=args.steps,
                        temperature=args.temperature,
                        pattern=args.pattern,
                        prefix_groups=args.prefix_groups,
-                       prefix_len=args.prefix_len)
+                       prefix_len=args.prefix_len,
+                       tenants=args.tenants, tier_mix=args.tier_mix)
     try:
+        if args.overload is not None:
+            point = run_overload(engine, trace, qps=args.overload)
+            print(json.dumps({"mode": "qos_overload",
+                              "tenants": args.tenants,
+                              "tier_mix": args.tier_mix, **point}))
+            return
         closed = run_closed_loop(engine, trace,
                                  concurrency=args.concurrency,
                                  chaos_kill=args.chaos,

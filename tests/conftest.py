@@ -52,6 +52,7 @@ _FILE_COST = {  # mean s/test on the CPU gate machine; unlisted -> 3.0
     "test_serving.py": 2.51, "test_pipeline.py": 2.60,
     "test_decode.py": 2.76, "test_router.py": 3.55,
     "test_serving_disagg.py": 3.82, "test_serving_bench.py": 3.85,
+    "test_serving_qos.py": 4.0,
     "test_speculative.py": 4.44, "test_ulysses.py": 4.50,
     "test_parallelism.py": 4.69, "test_attention.py": 4.91,
     "test_packing.py": 5.10, "test_parallel_transformer.py": 5.47,
@@ -124,6 +125,13 @@ def pytest_configure(config):
         "condition-variable waits with deadlines, no fixed sleeps on "
         "the fast path; fleet-scaling timing comparisons are "
         "additionally marked slow)")
+    config.addinivalue_line(
+        "markers",
+        "qos: multi-tenant QoS tests — quotas, weighted-fair admission, "
+        "SLO tiers, and paged-KV preemption with bit-identical resume "
+        "(tier-1 legs run seeded traces on inline-stepped engines — no "
+        "sleeps on the fast path; the overload soak is additionally "
+        "marked slow)")
 
 
 @pytest.fixture()

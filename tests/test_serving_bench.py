@@ -111,7 +111,10 @@ def test_bench_serving_fields_shape():
                         "serving_paged_capacity_slots",
                         "serving_unified_decode_p99_ms",
                         "serving_disagg_decode_p99_ms",
-                        "serving_kv_transfer_bytes"}
+                        "serving_kv_transfer_bytes",
+                        "serving_interactive_p99_ms_under_overload",
+                        "serving_batch_completion_rate",
+                        "serving_preempt_resume_ms"}
 
 
 def test_closed_loop_chaos_kill_schedule_no_leaks():

@@ -1,0 +1,567 @@
+"""Multi-tenant QoS (PR 18): quotas, SLO tiers, paged-KV preemption.
+
+The contract pinned here, mirroring docs/serving.md's QoS section:
+
+ - admission is weighted-fair across backlogged tenants (stride
+   scheduling: pass += 1/weight per pick), interactive tier strictly
+   before batch, highest ``priority`` first within a tenant — and with
+   no tenants registered it degenerates to the exact FIFO the pre-QoS
+   engine ran (the defaults-unchanged contract);
+ - a tenant over its token-bucket quota is refused typed
+   (:class:`QuotaExceeded`, a :class:`QueueFull` subclass) BEFORE the
+   request counts as submitted, and per-tenant counters book every
+   shed/refusal (``stats()["tenants"][t]``);
+ - a preempted (swapped-out) request resumes BIT-IDENTICAL to an
+   unpreempted run — same tokens, same finish — with its KV blocks
+   round-tripped through host memory (d2h/h2d transfer counters move,
+   swap-out and resume byte counts match) and ``kv_blocks_in_use == 0``
+   while it sits suspended;
+ - zero block leak across EVERY preempt/resume/cancel/deadline/
+   disconnect interleaving, and ``drain()``/``declare_dead()`` fail a
+   still-suspended request with a typed reason (the message names the
+   swap-out) instead of hanging its waiter;
+ - the wire carries ``tenant``/``priority`` on ``'q'`` and maps quota
+   refusals to a distinct ``"quota"`` kind; the router spills batch-tier
+   submissions off affine replicas with interactive backlog and
+   ``scale_down`` composes with suspension for zero-loss failover.
+
+Tier-1 legs run seeded traces on inline-stepped engines — no sleeps on
+the fast path; the overload soak is additionally marked slow.
+"""
+
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+import jax
+
+from distkeras_tpu.core.model import FittedModel
+from distkeras_tpu.models import transformer_lm
+from distkeras_tpu.router import ServingRouter
+from distkeras_tpu.serving import (EngineDead, QueueFull, QuotaExceeded,
+                                   ServingClient, ServingEngine,
+                                   ServingServer, TenantPolicy)
+
+pytestmark = pytest.mark.qos
+
+VOCAB = 17
+P6 = np.arange(1, 7, dtype=np.int32)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    model = transformer_lm(vocab_size=VOCAB, seq_len=32, d_model=16,
+                           num_heads=2, num_layers=2, mlp_dim=32,
+                           compute_dtype="float32")
+    params = model.init(jax.random.PRNGKey(0), (32,))
+    return FittedModel(model, params)
+
+
+def _mk(fitted, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("kv_blocks", 30)
+    return ServingEngine(fitted, paged=True, **kw)
+
+
+def _bulk(**kw):
+    return TenantPolicy("bulk", tier="batch", **kw)
+
+
+def _live(**kw):
+    return TenantPolicy("live", tier="interactive", **kw)
+
+
+#: the request shapes the preemption legs replay — referenced by name so
+#: every test compares against the SAME unpreempted rows (one reference
+#: engine, one compile, module-wide)
+REQS = {
+    "bulk_sampled": dict(prompt=P6, num_steps=18, temperature=0.8, seed=7),
+    "bulk_lo": dict(prompt=P6, num_steps=14, temperature=0.7, seed=11),
+    "bulk_hi": dict(prompt=np.array([2, 9, 4, 1, 8, 5], np.int32),
+                    num_steps=14, temperature=0.7, seed=23),
+    "interactive": dict(prompt=np.array([1, 2, 3, 4, 5], np.int32),
+                        num_steps=8),
+    "wire_greedy": dict(prompt=np.array([3, 4, 5, 6], np.int32),
+                        num_steps=8),
+}
+
+
+@pytest.fixture(scope="module")
+def ref_rows(fitted):
+    """Unpreempted reference rows from a plain (no-tenant) engine — the
+    bit-identity baseline every preempt/resume/failover leg compares
+    against."""
+    eng = _mk(fitted)
+    hs = {k: eng.submit(**kw) for k, kw in REQS.items()}
+    eng.run_until_idle()
+    assert eng.kv_blocks_in_use == 0
+    assert eng.stats["preemptions"] == 0
+    return {k: h.result() for k, h in hs.items()}
+
+
+def _wait(pred, timeout=60.0, poll=0.005, what="condition"):
+    t0 = time.perf_counter()
+    while not pred():
+        if time.perf_counter() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for {what}")
+        time.sleep(poll)
+
+
+def _step_until(eng, pred, max_steps=400, what="condition"):
+    for _ in range(max_steps):
+        if pred():
+            return
+        eng.step()
+    assert pred(), f"never reached {what} in {max_steps} inline steps"
+
+
+# ---------------------------------------------------------------------------
+# policy surface: validation, registration, clone
+# ---------------------------------------------------------------------------
+
+def test_tenant_policy_validation():
+    with pytest.raises(ValueError):
+        TenantPolicy("")
+    with pytest.raises(ValueError):
+        TenantPolicy("t", weight=0.0)
+    with pytest.raises(ValueError):
+        TenantPolicy("t", rate=-1.0)
+    with pytest.raises(ValueError):
+        TenantPolicy("t", rate=1.0, burst=0.5)
+    with pytest.raises(ValueError):
+        TenantPolicy("t", tier="gold")
+    with pytest.raises(ValueError):
+        TenantPolicy("t", deadline_s=0.0)
+    # QuotaExceeded IS backpressure to untyped callers
+    assert issubclass(QuotaExceeded, QueueFull)
+
+
+def test_register_tenant_and_clone(fitted):
+    eng = _mk(fitted, tenants=[_bulk(), _live()])
+    with pytest.raises(ValueError):
+        eng.register_tenant("not-a-policy")
+    p = TenantPolicy("metered", rate=10.0, burst=2.0)
+    p._tokens = 0.0  # drained bucket
+    c = p.clone()
+    assert c._tokens == c.burst == 2.0  # clone never inherits bucket debt
+    assert (c.name, c.rate, c.tier) == ("metered", 10.0, "batch")
+    eng.register_tenant(p)
+    assert eng._tenants["metered"] is p
+
+
+# ---------------------------------------------------------------------------
+# admission order: WFQ stride, tiers, priority, FIFO degenerate
+# ---------------------------------------------------------------------------
+
+def test_weighted_fair_pop_order(fitted):
+    """Interactive tier pops strictly first; within the batch tier the
+    stride schedule gives a weight-2 tenant two admissions per weight-1
+    admission (deterministic sequence, not just a ratio)."""
+    eng = _mk(fitted, tenants=[TenantPolicy("a", weight=2.0),
+                               TenantPolicy("b", weight=1.0), _live()])
+    a = [eng.submit(P6, 4, tenant="a", block=False) for _ in range(4)]
+    b = [eng.submit(P6, 4, tenant="b", block=False) for _ in range(4)]
+    i0 = eng.submit(P6, 4, tenant="live", block=False)
+    with eng._qlock:
+        order = [eng._q_pop_locked() for _ in range(9)]
+        assert eng._q_pop_locked() is None
+    want = [i0, a[0], b[0], a[1], a[2], b[1], a[3], b[2], b[3]]
+    assert [h.id for h in order] == [h.id for h in want]
+
+
+def test_priority_within_tenant(fitted):
+    eng = _mk(fitted, tenants=[_bulk()])
+    p0 = eng.submit(P6, 4, tenant="bulk", priority=0, block=False)
+    p5a = eng.submit(P6, 4, tenant="bulk", priority=5, block=False)
+    p1 = eng.submit(P6, 4, tenant="bulk", priority=1, block=False)
+    p5b = eng.submit(P6, 4, tenant="bulk", priority=5, block=False)
+    with eng._qlock:
+        order = [eng._q_pop_locked() for _ in range(4)]
+    # highest priority first, FIFO among equals
+    assert [h.id for h in order] == [p5a.id, p5b.id, p1.id, p0.id]
+
+
+def test_defaults_degenerate_to_fifo(fitted):
+    """No tenants registered: the WFQ pop IS the pre-QoS FIFO, requests
+    land under the lazily-created ``"default"`` tenant, and the load
+    snapshot shows no interactive backlog."""
+    eng = _mk(fitted)
+    hs = [eng.submit(P6, 4, block=False) for _ in range(3)]
+    assert eng.load()["queued_interactive"] == 0
+    with eng._qlock:
+        order = [eng._q_pop_locked() for _ in range(3)]
+    assert [h.id for h in order] == [h.id for h in hs]
+    assert all(h.tenant == "default" and h.priority == 0 for h in hs)
+    assert eng.stats["tenants"]["default"]["submitted"] == 3
+
+
+# ---------------------------------------------------------------------------
+# quotas + tier deadline bands + shed accounting
+# ---------------------------------------------------------------------------
+
+def test_quota_token_bucket(fitted):
+    eng = _mk(fitted, tenants=[TenantPolicy("metered", rate=0.001,
+                                            burst=2.0)])
+    eng.submit(P6, 4, tenant="metered", block=False)
+    eng.submit(P6, 4, tenant="metered", block=False)
+    # quota is policy, not backpressure: block=True raises immediately too
+    with pytest.raises(QuotaExceeded):
+        eng.submit(P6, 4, tenant="metered", block=True)
+    s = eng.stats
+    assert s["quota_refused"] == 1
+    assert s["requests_submitted"] == 2  # refusal precedes the submit count
+    ts = s["tenants"]["metered"]
+    assert (ts["submitted"], ts["quota_refused"]) == (2, 1)
+    # other tenants are unaffected (unregistered = unlimited quota)
+    eng.submit(P6, 4, tenant="other", block=False)
+    assert s["tenants"]["other"]["quota_refused"] == 0
+
+
+def test_tier_deadline_band(fitted):
+    eng = _mk(fitted, tenants=[_live(deadline_s=5.0), _bulk()])
+    now = time.perf_counter()
+    h = eng.submit(P6, 4, tenant="live", block=False)
+    assert h.deadline is not None and 4.0 < h.deadline - now <= 5.5
+    # an explicit per-request deadline still wins over the tier band
+    h2 = eng.submit(P6, 4, tenant="live", deadline_s=0.5, block=False)
+    assert h2.deadline - now <= 1.0
+    # batch tier has no band here; engine default_deadline_s is None
+    h3 = eng.submit(P6, 4, tenant="bulk", block=False)
+    assert h3.deadline is None
+
+
+def test_per_tenant_shed_accounting(fitted):
+    eng = _mk(fitted, queue_capacity=1)
+    eng.submit(P6, 4, tenant="a", block=False)  # fills the queue
+    for t in ("b", "c"):
+        with pytest.raises(QueueFull):
+            eng.submit(P6, 4, tenant=t, block=False)
+        ts = eng.stats["tenants"][t]
+        # sheds are terminal, so they count as submissions too — the
+        # per-tenant balance is submitted == completed + shed
+        assert (ts["submitted"], ts["shed"]) == (1, 1)
+    assert eng.stats["requests_rejected"] == 2
+    assert eng.stats["tenants"]["a"]["shed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# preemption: swap-out, bit-identical resume, starvation victim choice
+# ---------------------------------------------------------------------------
+
+def test_preempt_resume_bit_identical(fitted, ref_rows):
+    """Explicit preempt mid-decode: blocks gather to host (d2h moves),
+    the slot frees (zero blocks in use while suspended), and the resumed
+    stream — reinstalled through the jitted ingest program (h2d moves) —
+    matches the unpreempted reference bit for bit."""
+    eng = _mk(fitted, tenants=[_bulk(), _live()])
+    h = eng.submit(tenant="bulk", **REQS["bulk_sampled"])
+    _step_until(eng, lambda: len(h.tokens) >= 6, what="6 decoded tokens")
+    d2h0 = eng.stats["d2h_transfers"]
+    assert eng.preempt(h) is True
+    _step_until(eng, lambda: h.id in eng._suspended, what="suspension")
+    assert h.slot is None and h.finish is None
+    assert eng.kv_blocks_in_use == 0  # every block back in the pool
+    s = eng.stats
+    assert s["preemptions"] == 1
+    assert s["kv_blocks_swapped_out"] > 0
+    assert s["kv_block_bytes_swapped_out"] > 0
+    assert s["d2h_transfers"] > d2h0  # the gather crossed to host
+    assert len(s["preempt_swap_ms"]) == 1
+    h2d0 = s["h2d_transfers"]
+    eng.run_until_idle()
+    assert h.finish in ("eos", "length")
+    np.testing.assert_array_equal(h.result(), ref_rows["bulk_sampled"])
+    assert s["resumes"] == 1
+    assert s["h2d_transfers"] > h2d0  # the ingest crossed back
+    assert s["kv_blocks_resumed"] == s["kv_blocks_swapped_out"]
+    assert s["kv_block_bytes_resumed"] == s["kv_block_bytes_swapped_out"]
+    assert len(s["preempt_resume_ms"]) == 1
+    assert eng.kv_blocks_in_use == 0
+    ts = s["tenants"]["bulk"]
+    assert (ts["preemptions"], ts["resumes"], ts["completed"]) == (1, 1, 1)
+
+
+def test_starvation_preempts_lowest_priority(fitted, ref_rows):
+    """A starved interactive submission suspends a running batch-tier
+    request — the LOWEST-priority one first — and every stream (victims
+    included) still matches its unpreempted reference."""
+    eng = _mk(fitted, tenants=[_bulk(), _live()])
+    lo = eng.submit(tenant="bulk", priority=0, **REQS["bulk_lo"])
+    hi = eng.submit(tenant="bulk", priority=5, **REQS["bulk_hi"])
+    _step_until(eng, lambda: lo.slot is not None and hi.slot is not None,
+                what="both batch requests decoding")
+    it = eng.submit(tenant="live", **REQS["interactive"])
+    _step_until(eng, lambda: eng._suspended, what="starvation preemption")
+    assert lo.id in eng._suspended  # victim choice: lowest priority first
+    eng.run_until_idle()
+    for h, name in ((lo, "bulk_lo"), (hi, "bulk_hi"), (it, "interactive")):
+        assert h.finish in ("eos", "length")
+        np.testing.assert_array_equal(h.result(), ref_rows[name])
+    s = eng.stats
+    assert s["preemptions"] >= 1
+    assert s["resumes"] == s["preemptions"]  # every victim came back
+    assert s["tenants"]["live"]["preemptions"] == 0
+    assert eng.kv_blocks_in_use == 0
+
+
+def test_cancel_and_deadline_while_suspended(fitted):
+    """A suspended request holds no slot and no blocks — cancel and
+    deadline expiry while swapped out are pure bookkeeping: the host-side
+    record drops, the handle retires typed, nothing resumes."""
+    eng = _mk(fitted, tenants=[_bulk(), _live()])
+    # --- cancel while suspended
+    h = eng.submit(tenant="bulk", **REQS["bulk_sampled"])
+    _step_until(eng, lambda: len(h.tokens) >= 2, what="decode progress")
+    assert eng.preempt(h)
+    _step_until(eng, lambda: h.id in eng._suspended, what="suspension")
+    assert eng.cancel(h) is True
+    _step_until(eng, lambda: h.finish is not None, what="cancel retire")
+    assert h.finish == "cancel"
+    assert not eng._suspended and eng.kv_blocks_in_use == 0
+    # --- deadline expiry while suspended
+    h2 = eng.submit(tenant="bulk", deadline_s=0.05, **REQS["bulk_lo"])
+    _step_until(eng, lambda: len(h2.tokens) >= 2, what="decode progress")
+    assert eng.preempt(h2)
+    _step_until(eng, lambda: h2.id in eng._suspended, what="suspension")
+    time.sleep(0.06)  # let the (tiny) deadline lapse while swapped out
+    _step_until(eng, lambda: h2.finish is not None, what="deadline retire")
+    assert h2.finish == "deadline"
+    assert not eng._suspended and eng.kv_blocks_in_use == 0
+    assert eng.stats["resumes"] == 0  # neither request ever came back
+    assert eng.stats["preemptions"] == 2
+
+
+# ---------------------------------------------------------------------------
+# drain / shutdown with suspended requests (satellite: typed, never hangs)
+# ---------------------------------------------------------------------------
+
+def test_drain_inline_resumes_suspended(fitted, ref_rows):
+    """Happy path: drain on an inline engine steps the scheduler, which
+    resumes the suspended request and finishes it — clean drain, stream
+    still bit-identical."""
+    eng = _mk(fitted, tenants=[_bulk(), _live()])
+    h = eng.submit(tenant="bulk", **REQS["bulk_sampled"])
+    _step_until(eng, lambda: len(h.tokens) >= 4, what="decode progress")
+    assert eng.preempt(h)
+    _step_until(eng, lambda: h.id in eng._suspended, what="suspension")
+    assert eng.drain(timeout=60.0) is True
+    np.testing.assert_array_equal(h.result(), ref_rows["bulk_sampled"])
+    assert eng.kv_blocks_in_use == 0
+    assert eng.stats["resumes"] == 1
+
+
+def test_declare_dead_fails_suspended_typed(fitted):
+    eng = _mk(fitted, tenants=[_bulk(), _live()])
+    h = eng.submit(tenant="bulk", **REQS["bulk_sampled"])
+    _step_until(eng, lambda: len(h.tokens) >= 2, what="decode progress")
+    assert eng.preempt(h)
+    _step_until(eng, lambda: h.id in eng._suspended, what="suspension")
+    eng.declare_dead("supervisor kill")
+    with pytest.raises(EngineDead, match="swapped out"):
+        h.result(timeout=5.0)
+    assert h.finish == "error"
+    assert not eng._suspended
+    assert eng.stats["requests_failed"] == 1
+
+
+def test_drain_timeout_fails_suspended_typed(fitted):
+    """A started engine whose only slot is held by interactive work
+    cannot resume the suspended batch request — drain must time out and
+    fail it TYPED (the reason names the swap-out) instead of hanging the
+    waiter forever."""
+    eng = _mk(fitted, num_slots=1, tenants=[_bulk(), _live()])
+    eng.start()
+    try:
+        h = eng.submit(tenant="bulk", prompt=P6, num_steps=24,
+                       temperature=0.8, seed=7)
+        _wait(lambda: h.slot is not None, what="decode start")
+        # queue interactive work FIRST (the freed slot goes to it, so the
+        # suspended request cannot resume), then preempt the batch run
+        it = eng.submit(tenant="live", prompt=P6, num_steps=24)
+        assert eng.preempt(h)
+        _wait(lambda: h.id in eng._suspended, what="suspension")
+        assert eng.drain(timeout=0.0, poll=0.001) is False
+        with pytest.raises(EngineDead, match="swapped out"):
+            h.result(timeout=5.0)
+        with pytest.raises(EngineDead):
+            it.result(timeout=5.0)
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# wire: tenant/priority on 'q', typed quota kind, disconnect-while-suspended
+# ---------------------------------------------------------------------------
+
+def test_wire_tenant_priority_quota_and_disconnect(fitted, ref_rows):
+    eng = _mk(fitted, tenants=[
+        _bulk(), _live(),
+        TenantPolicy("metered", rate=0.001, burst=1.0)])
+    with ServingServer(eng) as srv:
+        with ServingClient(*srv.addr) as c:
+            # tenant + priority ride the 'q' frame into the engine handle
+            rid = c.submit(tenant="live", priority=3, **REQS["wire_greedy"])
+            h = srv._handles[rid]
+            assert (h.tenant, h.priority) == ("live", 3)
+            row = None
+            for _, done in c.stream(rid):
+                if done is not None:
+                    row = done["row"]
+            np.testing.assert_array_equal(row, ref_rows["wire_greedy"])
+            # quota refusals come back as their own typed kind, distinct
+            # from backpressure, and still catchable as QueueFull
+            rid_m = c.submit(tenant="metered", **REQS["wire_greedy"])
+            with pytest.raises(QuotaExceeded):
+                c.submit(tenant="metered", **REQS["wire_greedy"])
+            assert eng.stats["tenants"]["metered"]["quota_refused"] == 1
+            # let the admitted metered request finish before this client
+            # closes, so the disconnect leg below reclaims exactly one
+            _wait(lambda: srv._handles[rid_m].finish is not None,
+                  what="metered completion")
+        # disconnect while suspended: the dead client's swapped-out
+        # request is reclaimed like any other — cancelled, record
+        # dropped, zero blocks leaked
+        c2 = ServingClient(*srv.addr)
+        rid2 = c2.submit(tenant="bulk", **REQS["bulk_sampled"])
+        h2 = srv._handles[rid2]
+        _wait(lambda: len(h2.tokens) >= 2, what="decode progress")
+        assert eng.preempt(h2)
+        _wait(lambda: rid2 in eng._suspended, what="suspension")
+        c2.close()
+        _wait(lambda: h2.finish is not None, what="disconnect reclaim")
+        assert h2.finish == "cancel"
+        _wait(lambda: not eng._suspended, what="swap record drop")
+        assert eng.kv_blocks_in_use == 0
+        assert srv.disconnect_cancels == 1
+
+
+# ---------------------------------------------------------------------------
+# router: tenant-aware dispatch + scale_down over suspended requests
+# ---------------------------------------------------------------------------
+
+def test_router_tenant_spill_dispatch(fitted):
+    """Batch-tier submissions spill off an affine replica with
+    interactive backlog (``tenant_spills``); interactive submissions keep
+    their affinity — they are what the backlog drains into."""
+    e1, e2 = _mk(fitted), _mk(fitted)
+    r = ServingRouter(replicas=[e1, e2], affinity="prefix",
+                      affinity_blocks=1, block_size=4,
+                      tenants=[_live(), _bulk()])
+    prompt = np.arange(1, 10, dtype=np.int32)
+    key = np.asarray(prompt[:4], np.int32).tobytes()
+    reps = list(r._replicas)
+    affine = max(reps, key=lambda rep: zlib.crc32(
+        key + rep.uid.to_bytes(4, "little")))
+    other = next(rep for rep in reps if rep is not affine)
+    # affine replica: NOT saturated (no affinity spill) but with an
+    # interactive request queued; the other replica is least-loaded
+    affine.load = lambda: {"queue_depth": 1, "active": 1, "slots_free": 1,
+                           "slots_total": 2, "queued_interactive": 1}
+    other.load = lambda: {"queue_depth": 0, "active": 0, "slots_free": 2,
+                          "slots_total": 2, "queued_interactive": 0}
+    assert r._dispatch_order(prompt, tenant="bulk")[0][0] is other
+    assert r.counters["tenant_spills"] == 1
+    assert r._dispatch_order(prompt, tenant="live")[0][0] is affine
+    assert r.counters["affinity_routed"] == 1
+    # untenanted traffic is batch-tier on a tenanted fleet: it spills too
+    assert r._dispatch_order(prompt, tenant=None)[0][0] is other
+    assert r.counters["tenant_spills"] == 2
+    # fleet QoS reached every in-process replica as an unshared clone
+    for e in (e1, e2):
+        assert set(e._tenants) == {"live", "bulk"}
+        assert e._tenants["live"] is not r._tenants["live"]
+
+
+def test_router_scale_down_resubmits_suspended(fitted, ref_rows):
+    """scale_down on a replica holding a SUSPENDED request: the drain
+    timeout fails it typed, the relay resubmits to the surviving replica,
+    and the client-visible stream is still bit-identical — zero loss."""
+    e1 = _mk(fitted, num_slots=1)
+    e2 = _mk(fitted, num_slots=1)
+    r = ServingRouter(replicas=[e1, e2], affinity="prefix", block_size=4,
+                      tenants=[_live(), _bulk()])
+    r.start()
+    try:
+        h = r.submit(tenant="bulk", **REQS["bulk_sampled"])
+        rec = r._live[h.id]
+        _wait(lambda: rec.upstream is not None
+              and rec.upstream.slot is not None, what="decode start")
+        eng, uid = rec.replica.engine, rec.replica.uid
+        survivor = e2 if eng is e1 else e1
+        # queue interactive work on the owning replica, then preempt the
+        # upstream: the freed (only) slot goes to the interactive
+        # request, so the suspended upstream cannot resume
+        it = eng.submit(tenant="live", prompt=P6, num_steps=24)
+        assert eng.preempt(rec.upstream)
+        _wait(lambda: rec.upstream.id in eng._suspended,
+              what="suspension")
+        assert r.scale_down(uid=uid, timeout=0.0) == uid
+        row = h.result(timeout=60.0)
+        np.testing.assert_array_equal(row, ref_rows["bulk_sampled"])
+        assert r.counters["requests_failed"] == 0
+        assert r.counters["resubmissions"] >= 1
+        with pytest.raises(EngineDead):  # the direct submit died typed
+            it.result(timeout=5.0)
+        _wait(lambda: survivor.kv_blocks_in_use == 0, what="survivor idle")
+    finally:
+        r.stop()
+
+
+# ---------------------------------------------------------------------------
+# overload: loadgen QoS leg (fast deterministic tier-1 + slow soak)
+# ---------------------------------------------------------------------------
+
+def _overload(num_requests, qps, seed, queue_capacity=16):
+    from examples import loadgen
+
+    _, eng = loadgen.build_engine(num_slots=2, max_len=32, paged=True,
+                                  block_size=8,
+                                  queue_capacity=queue_capacity)
+    for p in loadgen.qos_policies(3):
+        eng.register_tenant(p)
+    trace = loadgen.make_trace(num_requests, num_steps=8, seed=seed,
+                               tenants=3, tier_mix=0.3)
+    assert any(t["tenant"] == "interactive" for t in trace)
+    assert any(t["tenant"] != "interactive" for t in trace)
+    try:
+        return eng, loadgen.run_overload(eng, trace, qps=qps,
+                                         timeout_s=120.0)
+    finally:
+        eng.stop()
+
+
+def test_overload_fast_leg():
+    eng, point = _overload(num_requests=10, qps=500.0, seed=3)
+    for k in ("interactive_p99_ms", "batch_completion_rate",
+              "preempt_resume_ms", "quota_refused", "tenants"):
+        assert k in point
+    assert 0.0 <= point["batch_completion_rate"] <= 1.0
+    assert point["interactive_completion_rate"] > 0.0
+    assert point["interactive_p99_ms"] is not None
+    assert eng.kv_blocks_in_use == 0
+    s = eng.stats
+    assert (s["requests_submitted"] == s["requests_completed"]
+            + s["requests_failed"] + s["requests_rejected"])
+
+
+@pytest.mark.slow
+def test_overload_soak_interactive_holds():
+    """An overload burst (arrivals far faster than service, queue deep
+    enough that nothing sheds): the interactive tier holds its latency
+    band — weighted-fair admission pops it strictly first, so the batch
+    tier absorbs ALL the queueing delay — and everything still
+    completes."""
+    eng, point = _overload(num_requests=40, qps=400.0, seed=5,
+                           queue_capacity=64)
+    assert point["shed_interactive"] == point["shed_batch"] == 0
+    assert point["interactive_completion_rate"] == 1.0
+    assert point["batch_completion_rate"] == 1.0
+    assert point["interactive_p99_ms"] is not None
+    assert point["batch_p99_ms"] is not None
+    assert point["interactive_p99_ms"] <= point["batch_p99_ms"]
+    assert eng.kv_blocks_in_use == 0
+    assert point["resumes"] == point["preemptions"]
