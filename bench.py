@@ -1005,6 +1005,60 @@ def serving_fleet_bench(budget_s: float = 90.0):
     return out
 
 
+def serving_wire_bench(budget_s: float = 90.0):
+    """Wire-transport scaling observables (PR 19): the same seeded trace
+    through a :class:`ServingServer` over loopback sockets at 8 and 64
+    concurrent wire clients, once per transport core —
+
+     - ``serving_connection_scaling`` — tokens/sec keyed by core
+       (``"threaded"`` / ``"event"``) then client count (``"8"`` /
+       ``"64"``), each point also recording the peak per-connection
+       server thread count sampled mid-flight: the threaded core holds
+       one relay thread per connection (O(N)); the event core's single
+       selector thread holds ZERO (the O(1) the acceptance bar asserts).
+     - ``serving_event_tokens_per_sec`` — the event core at 64 clients,
+       the headline compared against the threaded core's 64-client point
+       (event must not be behind: one loop thread replaces 64 without
+       giving up throughput).
+
+    Returns Nones on overrun/failure — never fatal to the artifact.
+    """
+    sys.path.insert(0, os.path.join(_REPO, "examples"))
+    import loadgen
+    from distkeras_tpu.serving import ServingServer
+
+    none = {"serving_event_tokens_per_sec": None,
+            "serving_connection_scaling": None}
+    if budget_s < 10.0:
+        return none
+    t0 = time.perf_counter()
+    trace = loadgen.make_trace(96, num_steps=8)
+    scaling = {}
+    for core in ("threaded", "event"):
+        scaling[core] = {}
+        for clients in (8, 64):
+            _, engine = loadgen.build_engine(num_slots=4,
+                                             queue_capacity=128)
+            srv = ServingServer(engine, server_core=core,
+                                poll_s=0.01).start()
+            try:
+                m = loadgen.run_wire_closed_loop(srv.addr, trace,
+                                                 concurrency=clients,
+                                                 timeout_s=budget_s)
+            finally:
+                srv.stop()
+                engine.stop()
+            scaling[core][str(clients)] = {
+                "tokens_per_sec": m["tokens_per_sec"],
+                "server_conn_threads": m["server_conn_threads_peak"]}
+            if time.perf_counter() - t0 > budget_s:
+                return {"serving_event_tokens_per_sec": None,
+                        "serving_connection_scaling": scaling}
+    ev64 = scaling["event"]["64"]["tokens_per_sec"]
+    return {"serving_connection_scaling": scaling,
+            "serving_event_tokens_per_sec": ev64}
+
+
 def main():
     t_start = time.perf_counter()
     debug = os.environ.get("DISTKERAS_BENCH_DEBUG", "") == "1"
@@ -1328,6 +1382,19 @@ def main():
             print(f"[bench] serving fleet bench failed: {e}",
                   file=sys.stderr)
     result.update(fleet_fields)
+    # wire-transport scaling (PR 19): tokens/sec at 8 vs 64 concurrent
+    # wire clients through both server cores + the thread-count deltas
+    stage("serving wire transport")
+    wire_fields = {"serving_event_tokens_per_sec": None,
+                   "serving_connection_scaling": None}
+    wire_remaining = budget - (time.perf_counter() - t_start)
+    if wire_remaining > 45:
+        try:
+            wire_fields = serving_wire_bench(budget_s=wire_remaining)
+        except Exception as e:
+            print(f"[bench] serving wire bench failed: {e}",
+                  file=sys.stderr)
+    result.update(wire_fields)
     # the train-while-serve loop (deployment_online.py): freshness
     # percentiles + served accuracy under drift on the live deployment
     stage("online deployment")

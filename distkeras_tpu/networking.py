@@ -25,16 +25,23 @@ hosts exactly where the reference rode the Spark driver network.
 
 from __future__ import annotations
 
+import collections
+import heapq
 import json
+import logging
 import random
 import select
+import selectors
 import socket
 import struct
 import threading
 import time
-from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
+                    Sequence, Tuple)
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 MAGIC = b"DKT1"
 _U32 = struct.Struct("<I")
@@ -704,12 +711,19 @@ class ClientPool:
     The free lists are lock-protected; the clients themselves are NOT
     made thread-safe by pooling — one acquirer uses one client at a time,
     which is exactly the borrow/return discipline the pool enforces.
+    Eviction (a ``release`` past ``max_idle_per_addr``) and ``close`` both
+    decide under the lock and close OUTSIDE it; a ``release`` racing
+    ``close`` cannot re-park a client into a closed pool (the ``_closed``
+    latch closes it instead — regression-tested in
+    tests/test_serving_event.py, where the leak was an unclosed socket per
+    race won).
     """
 
     def __init__(self, factory, max_idle_per_addr: int = 4):
         self._factory = factory
         self._idle: Dict[Any, List[Any]] = {}
         self._lock = threading.Lock()
+        self._closed = False
         self.max_idle_per_addr = int(max_idle_per_addr)
         self.dials = 0     # fresh clients built
         self.reuses = 0    # acquisitions served from the free list
@@ -726,10 +740,11 @@ class ClientPool:
 
     def release(self, addr, client) -> None:
         with self._lock:
-            free = self._idle.setdefault(addr, [])
-            if len(free) < self.max_idle_per_addr:
-                free.append(client)
-                return
+            if not self._closed:
+                free = self._idle.setdefault(addr, [])
+                if len(free) < self.max_idle_per_addr:
+                    free.append(client)
+                    return
         self._close_one(client)
 
     def discard(self, client) -> None:
@@ -739,6 +754,7 @@ class ClientPool:
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             clients = [c for free in self._idle.values() for c in free]
             self._idle.clear()
         for c in clients:
@@ -892,12 +908,18 @@ class FrameParser:
     corrupt or hostile frame raises ``ValueError`` *before* any oversized
     allocation, and the server drops the connection exactly as it does on
     a torn frame today.
+
+    ``frame_ops=None`` selects the BARE-frame mode: the stream carries no
+    opcode bytes, every message is a codec frame back to back (the
+    server→client half of the serving protocol — reply/chunk frames), and
+    ``messages()`` yields ``(None, message)`` pairs.  Same zero-copy /
+    reassembly / validation machinery, one byte less of framing.
     """
 
     __slots__ = ("buf", "frame_ops", "_filled", "_need", "_src", "_off",
                  "_retired")
 
-    def __init__(self, frame_ops: bytes = b"cu"):
+    def __init__(self, frame_ops: Optional[bytes] = b"cu"):
         self.frame_ops = frame_ops
         # reassembly buffer for a frame torn across chunks: preallocated to
         # the frame's total size as soon as the header has arrived, so a
@@ -955,6 +977,14 @@ class FrameParser:
                 return
             yield item
 
+    @property
+    def midframe(self) -> bool:
+        """True when a partial frame is buffered — EOF now is a torn
+        frame (the blocking path's ``recv_data`` raising mid-recv), not a
+        clean close.  Meaningful between ``messages()`` drains."""
+        return bool(self._filled) or self._src is not None or \
+            self._need is not None
+
     def _take_buffer(self, capacity: int) -> bytearray:
         """A frame buffer of at least ``capacity`` bytes — the retired
         previous frame buffer when it fits (its views were consumed before
@@ -1002,23 +1032,27 @@ class FrameParser:
         if not self._filled:
             return None
         buf = self.buf
-        op = bytes(buf[:1])
-        if op not in self.frame_ops:
-            del buf[:1]
-            self._filled -= 1
-            return op, None
+        if self.frame_ops is None:
+            pre = 0  # bare-frame mode: no opcode byte before the frame
+        else:
+            op = bytes(buf[:1])
+            if op not in self.frame_ops:
+                del buf[:1]
+                self._filled -= 1
+                return op, None
+            pre = 1
         if self._need is None:
-            if self._filled < 9:
+            if self._filled < pre + 8:
                 return None
-            if buf[1:5] != MAGIC:
+            if buf[pre:pre + 4] != MAGIC:
                 raise ValueError("Bad magic on wire message")
-            (hlen,) = _U32.unpack_from(buf, 5)
+            (hlen,) = _U32.unpack_from(buf, pre + 4)
             if hlen > MAX_HEADER_BYTES:
                 raise ValueError(f"Header too large: {hlen}")
-            if self._filled < 9 + hlen:
+            if self._filled < pre + 8 + hlen:
                 return None
-            header = json.loads(bytes(buf[9:9 + hlen]).decode())
-            self._need = 9 + hlen + self._payload_size(header)
+            header = json.loads(bytes(buf[pre + 8:pre + 8 + hlen]).decode())
+            self._need = pre + 8 + hlen + self._payload_size(header)
             if len(buf) < self._need:
                 new = self._take_buffer(self._need)
                 new[:self._filled] = memoryview(buf)[:self._filled]
@@ -1060,20 +1094,25 @@ class FrameParser:
         n = len(mv)
         if off >= n:
             return None, off
-        op = bytes(mv[off:off + 1])
-        if op not in self.frame_ops:
-            return (op, None), off + 1
-        if n - off < 9:
+        if self.frame_ops is None:
+            op = None  # bare-frame mode: the frame starts at ``off``
+            fo = off
+        else:
+            op = bytes(mv[off:off + 1])
+            if op not in self.frame_ops:
+                return (op, None), off + 1
+            fo = off + 1
+        if n - fo < 8:
             return None, off
-        if bytes(mv[off + 1:off + 5]) != MAGIC:
+        if bytes(mv[fo:fo + 4]) != MAGIC:
             raise ValueError("Bad magic on wire message")
-        (hlen,) = _U32.unpack_from(mv, off + 5)
+        (hlen,) = _U32.unpack_from(mv, fo + 4)
         if hlen > MAX_HEADER_BYTES:
             raise ValueError(f"Header too large: {hlen}")
-        hdr_end = off + 9 + hlen
+        hdr_end = fo + 8 + hlen
         if n < hdr_end:
             return None, off
-        header = json.loads(bytes(mv[off + 9:hdr_end]).decode())
+        header = json.loads(bytes(mv[fo + 8:hdr_end]).decode())
         expected: dict = {}
         _expected_buffer_sizes(header["tree"], expected)
         payload = 0
@@ -1097,6 +1136,210 @@ class FrameParser:
                     f"buffer {i} carries {v.nbytes} bytes, header expects "
                     f"{expected.get(i)}")
         return (op, _decode_node(header["tree"], views, copy=False)), end
+
+
+class EventLoop:
+    """ONE selector thread shared by N I/O endpoints — the serving-side
+    event transport's substrate (the ``SocketParameterServer`` I/O-loop
+    shape, factored out so the :class:`serving.ServingServer` event core,
+    the :class:`serving.ServingRouter` stream relay, and the
+    :class:`serving.DisaggPair` hand-off can all multiplex their sockets,
+    timers, and cross-thread wakeups on one loop instead of holding a
+    thread per connection or per in-flight request).
+
+    Surface:
+
+     - ``add(sock, callback, mask)`` / ``set_mask`` / ``remove`` — fd
+       registration.  ON-LOOP ONLY (call from a callback/timer, or get
+       there via ``call_soon``): mutating a selector under a concurrent
+       ``select`` is not portable.
+     - ``call_soon(fn)`` — thread-safe: enqueue ``fn`` on the loop and
+       wake it (the socketpair waker; this is how an engine thread's
+       token push reaches the loop without a per-connection thread).
+     - ``call_later(delay_s, fn)`` — thread-safe one-shot timer.  Timers
+       are never cancelled; a stale timer's ``fn`` is expected to re-check
+       state and no-op.
+     - ``start()`` / ``stop(join_timeout)`` / ``wake()``.
+
+    Socket callbacks are invoked as ``callback(mask)``; ``call_soon`` /
+    ``call_later`` callables take no arguments.  All of them run on the
+    loop thread, so state touched only by callbacks needs no lock.  An
+    exception out of a callback is logged and the loop SURVIVES — one
+    hostile peer or lost race must not take down every other stream
+    multiplexed on the loop.
+    """
+
+    def __init__(self, name: str = "dkt-event-loop"):
+        self.name = str(name)
+        self._sel: Optional[selectors.BaseSelector] = None
+        self._waker: Optional[tuple] = None  # (recv side, send side)
+        self._thread: Optional[threading.Thread] = None
+        self._pending: collections.deque = collections.deque()
+        self._timers: List[tuple] = []  # heap of (when, seq, fn)
+        self._seq = 0
+        self._lock = threading.Lock()  # guards: _running, _timers, _seq
+        self._running = False
+        #: callables run ON the loop thread as it exits (before the
+        #: selector and waker close) — owners hang their connection
+        #: teardown/flush here so stop() drains through the loop itself
+        self.stop_hooks: List[Callable[[], None]] = []
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "EventLoop":
+        r, w = socket.socketpair()
+        r.setblocking(False)
+        self._waker = (r, w)
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(r, selectors.EVENT_READ, None)
+        with self._lock:
+            self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=self.name)
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout: float = 5.0) -> bool:
+        """Ask the loop to exit and join it.  Returns False when the loop
+        thread outlived ``join_timeout`` (wedged inside a callback — the
+        loop itself never blocks on a socket); the caller owns any
+        force-close escalation, exactly like the PS core's ``stop``."""
+        with self._lock:
+            self._running = False
+        self.wake()
+        t = self._thread
+        if t is None or t is threading.current_thread():
+            return True
+        t.join(timeout=join_timeout)
+        return not t.is_alive()
+
+    @property
+    def alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    @property
+    def thread(self) -> Optional[threading.Thread]:
+        """The loop thread — owners expose it where callers expect a
+        per-server I/O thread handle (supervisor liveness probes)."""
+        return self._thread
+
+    def wake(self) -> None:
+        w = self._waker
+        if w is not None:
+            try:
+                w[1].send(b"\0")
+            except OSError:
+                pass
+
+    # -- cross-thread scheduling --------------------------------------------
+    def call_soon(self, fn: Callable[[], None]) -> None:
+        self._pending.append(fn)  # deque.append is atomic
+        self.wake()
+
+    def call_later(self, delay_s: float, fn: Callable[[], None]) -> None:
+        with self._lock:
+            self._seq += 1
+            heapq.heappush(
+                self._timers,
+                (time.monotonic() + float(delay_s), self._seq, fn))
+        self.wake()
+
+    # -- fd registration (ON-LOOP ONLY) -------------------------------------
+    def add(self, sock, callback: Callable[[int], None],
+            mask: int = selectors.EVENT_READ) -> None:
+        self._sel.register(sock, mask, callback)
+
+    def set_mask(self, sock, mask: int) -> None:
+        key = self._sel.get_key(sock)
+        if key.events != mask:
+            self._sel.modify(sock, mask, key.data)
+
+    def remove(self, sock) -> None:
+        try:
+            self._sel.unregister(sock)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def registered(self) -> int:
+        """Registered endpoint count, waker excluded (test surface for the
+        zero-leaked-fd assertions)."""
+        sel = self._sel
+        if sel is None:
+            return 0
+        try:
+            fd_map = sel.get_map()
+        except RuntimeError:
+            return 0
+        if fd_map is None:  # selector closed
+            return 0
+        return max(0, len(fd_map) - 1)
+
+    # -- the loop -----------------------------------------------------------
+    def _invoke(self, fn, *args) -> None:
+        try:
+            fn(*args)
+        except Exception:
+            logger.exception("event-loop callback failed on %s", self.name)
+
+    def _run(self) -> None:
+        sel = self._sel
+        try:
+            while True:
+                with self._lock:
+                    if not self._running:
+                        return
+                    timeout = (max(0.0, self._timers[0][0]
+                                   - time.monotonic())
+                               if self._timers else None)
+                try:
+                    events = sel.select(timeout=timeout)
+                except OSError:
+                    continue  # fds hard-closed under us; re-check and go on
+                for key, mask in events:
+                    if (self._waker is not None
+                            and key.fileobj is self._waker[0]):
+                        try:
+                            self._waker[0].recv(4096)
+                        except OSError:
+                            pass
+                        continue
+                    if key.data is not None:
+                        self._invoke(key.data, mask)
+                now = time.monotonic()
+                due = []
+                with self._lock:
+                    while self._timers and self._timers[0][0] <= now:
+                        due.append(heapq.heappop(self._timers)[2])
+                for fn in due:
+                    self._invoke(fn)
+                while True:
+                    try:
+                        fn = self._pending.popleft()
+                    except IndexError:
+                        break
+                    self._invoke(fn)
+        finally:
+            self._shutdown()
+
+    def _shutdown(self) -> None:
+        for hook in list(self.stop_hooks):
+            try:
+                hook()
+            except Exception:
+                logger.exception("event-loop stop hook failed on %s",
+                                 self.name)
+        if self._sel is not None:
+            try:
+                self._sel.close()
+            except OSError:
+                pass
+        if self._waker is not None:
+            for s in self._waker:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._waker = None
 
 
 #: Serving-protocol opcodes (``serving.ServingServer`` — its OWN opcode

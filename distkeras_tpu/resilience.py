@@ -499,6 +499,12 @@ class EngineSupervisor:
     (``server.engine = new``), so new submissions land on the fresh
     engine while ``ServingClient.generate(retry_policy=...)`` resubmits
     the failed ones (deterministic seeds make the retry idempotent).
+    The server itself — transport core included (``server_core=``, its
+    own ``respawn_clone`` carries the knob): a restart swaps the ENGINE
+    behind the server; live connections, the event loop or handler
+    threads, and the listening socket are untouched, so a supervised
+    restart never silently changes the transport a fleet was deployed
+    on.
     ``recoveries`` records one entry per detection (with ``restarted`` and
     ``recovery_ms``), ``max_restarts`` bounds the budget.
 

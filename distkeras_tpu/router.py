@@ -76,6 +76,10 @@ _REPLICA_LOST = (EngineDead, ConnectionError, OSError)
 #: again, or every OTHER replica momentarily full/draining.
 _RESUBMIT_RETRY_ON = _REPLICA_LOST + (QueueFull, Draining)
 
+#: event-relay scratch recv size: token-stream reply frames are small —
+#: 64 KiB amortizes syscalls without hoarding per-stream buffers.
+_RELAY_RECV_CHUNK = 1 << 16
+
 
 class _EngineReplica:
     """One in-process replica: a unified :class:`ServingEngine` plus the
@@ -273,6 +277,13 @@ class ServingRouter:
                     rep.engine.register_tenant(p.clone())
         self._rng = np.random.default_rng(self.seed)  # "random" policy
         self._live: Dict[int, _RouterRequest] = {}
+        #: shared event relay (PR 19): ONE selector loop pumps every
+        #: in-flight stream — engine attachments via handle listeners,
+        #: wire attachments via non-blocking reads over the bare-frame
+        #: parser.  Threads are spent on failover recovery only, so the
+        #: router's thread count is O(concurrent failures), not
+        #: O(in-flight requests).  Lazily started on first submit.
+        self._relay_loop: Optional[networking.EventLoop] = None
         self._attributions: Dict[int, Tuple[int, int]] = {}
         self._next_id = 0
         self._started = False
@@ -316,9 +327,27 @@ class ServingRouter:
         for t in threads:                           # relays cost one timeout,
             if t is not None:                       # not N of them
                 t.join(timeout=max(0.0, deadline - time.monotonic()))
+        self._ev_wait_idle(max(0.0, deadline - time.monotonic()))
         for rep in reps:
             rep.close()
+        with self._lock:
+            loop, self._relay_loop = self._relay_loop, None
+        if loop is not None:
+            loop.stop(join_timeout=max(0.5, deadline - time.monotonic()))
         self._pool.close()
+
+    def _ev_wait_idle(self, timeout: float) -> None:
+        """Bounded wait for loop-owned relays (in-flight requests with no
+        failover thread to join) to retire: stopping/draining the engines
+        makes their upstream handles terminal, and the shared loop pumps
+        those final laps out asynchronously."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                busy = any(r.thread is None for r in self._live.values())
+            if not busy or time.monotonic() >= deadline:
+                return
+            time.sleep(0.005)
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful fleet drain: stop admission at the router, drain every
@@ -336,6 +365,7 @@ class ServingRouter:
         for t in threads:
             if t is not None:
                 t.join(timeout=5.0)
+        self._ev_wait_idle(5.0)
         return clean
 
     def __enter__(self) -> "ServingRouter":
@@ -491,10 +521,7 @@ class ServingRouter:
         with self._lock:
             self._live[proxy.id] = rec
             self.counters["requests_submitted"] += 1
-            rec.thread = threading.Thread(
-                target=self._relay, args=(rec,), daemon=True,
-                name=f"dkt-router-relay-{proxy.id}")
-            rec.thread.start()
+        self._ev_watch(rec)
         return proxy
 
     def _admit_once(self, rec: _RouterRequest) -> None:
@@ -557,21 +584,190 @@ class ServingRouter:
                 rec.cancel_fn()
 
     # -------------------------------------------------------------- relays
-    def _relay(self, rec: _RouterRequest) -> None:
-        """Per-request relay thread: stream the attached replica's tokens
-        into the proxy; when the replica dies mid-flight (typed
-        :class:`EngineDead` or a broken wire), resubmit elsewhere under
-        ``retry_policy`` — the ONE retry machinery
-        ``ServingClient.generate`` also runs on — replaying the
-        already-delivered prefix silently."""
-        try:
-            try:
-                self._stream_once(rec)
+    #
+    # Steady state rides the shared event loop: an engine attachment's
+    # handle listener wakes the loop per progress transition and the loop
+    # pumps ``next_chunk(timeout=0)`` into the proxy; a wire attachment's
+    # socket goes non-blocking and the loop decodes reply frames off a
+    # bare-frame parser.  Only a LOST attachment spends a thread: the
+    # failover thread re-runs the blocking resubmit+stream path under
+    # ``retry_policy`` — the exact recovery contract the per-request
+    # relay threads implemented, at O(failures) threads instead of
+    # O(requests).
+
+    def _ev_loop(self) -> networking.EventLoop:
+        with self._lock:
+            loop = self._relay_loop
+            if loop is None or not loop.alive:
+                loop = networking.EventLoop(name="dkt-router-relay")
+                loop.start()
+                self._relay_loop = loop
+            return loop
+
+    def _ev_watch(self, rec: _RouterRequest) -> None:
+        """Hook a freshly-admitted request onto the shared relay."""
+        loop = self._ev_loop()
+        if rec.upstream is not None:
+            h = rec.upstream
+            h.set_listener(lambda: loop.call_soon(
+                lambda: self._ev_pump_engine(rec, h)))
+            # catch-up pump: progress that predates the listener
+            loop.call_soon(lambda: self._ev_pump_engine(rec, h))
+        else:
+            loop.call_soon(lambda: self._ev_wire_begin(rec))
+
+    def _ev_pump_engine(self, rec: _RouterRequest, h) -> None:
+        """Loop-side engine relay: drain whatever the upstream handle has
+        ready (never blocks), replaying nothing — this path only ever
+        runs on a request's FIRST attachment, so the proxy is exactly
+        ``rec.relayed`` tokens behind the upstream."""
+        if rec.upstream is not h:
+            return  # stale wake: the request failed over elsewhere
+        while True:
+            chunk, done = h.next_chunk(timeout=0)
+            for t in chunk:
+                rec.proxy._push(int(t))
+                rec.relayed += 1
+            if done:
+                h.set_listener(None)
+                rec.upstream = None  # claim the terminal transition: a
+                # second queued pump (the listener fires per transition)
+                # must not fail the same request over twice
+                err = h.error
+                if err is None:
+                    self._retire(rec, finish=h.finish)
+                elif isinstance(err, _REPLICA_LOST):
+                    self._ev_failover(rec)  # EngineDead → resubmit
+                elif isinstance(err, ValueError):
+                    self._retire(rec, error=err)
+                else:
+                    self._retire(rec, error=EngineDead(str(err)))
                 return
-            except _REPLICA_LOST:
-                if rec.cancelled:
-                    self._retire(rec, finish="cancel")
-                    return
+            if not len(chunk):
+                return  # drained; the listener wakes us on more
+
+    def _ev_wire_begin(self, rec: _RouterRequest) -> None:
+        """Loop-side wire relay start: send the stream request, flip the
+        pooled client's socket non-blocking, and register it — reply
+        frames (no opcode byte) decode off a bare-frame parser."""
+        client = rec.client
+        try:
+            networking.send_opcode(client.sock,
+                                   networking.SERVING_OP_STREAM)
+            networking.send_data(client.sock, {"id": int(rec.rid)},
+                                 pool=client._send_pool)
+            client.sock.setblocking(False)
+        except (ConnectionError, OSError):
+            self._pool.discard(client)
+            self._ev_failover(rec)
+            return
+        with self._lock:
+            loop = self._relay_loop
+        if loop is None:  # stop() raced the registration
+            self._pool.discard(client)
+            return
+        parser = networking.FrameParser(frame_ops=None)
+        scratch = networking.BufferPool()
+        loop.add(client.sock,
+                 lambda mask: self._ev_wire_read(rec, parser, scratch))
+
+    def _ev_wire_read(self, rec: _RouterRequest, parser, scratch) -> None:
+        sock = rec.client.sock
+        while True:
+            target = parser.writable()
+            fed_scratch = target is None
+            if fed_scratch:
+                target = memoryview(scratch.get(_RELAY_RECV_CHUNK))
+            try:
+                n = sock.recv_into(target)
+            except (BlockingIOError, InterruptedError):
+                return
+            except (ConnectionError, OSError):
+                self._ev_wire_lost(rec)
+                return
+            if not n:
+                self._ev_wire_lost(rec)  # EOF mid-stream = lost replica
+                return
+            if fed_scratch:
+                parser.feed(target[:n])
+            else:
+                parser.advance(n)
+            try:
+                for _op, msg in parser.messages():
+                    if self._ev_wire_frame(rec, msg):
+                        return  # stream detached (done / typed / lost)
+            except ValueError:
+                self._ev_wire_lost(rec)  # garbage frame = broken wire
+                return
+
+    def _ev_wire_frame(self, rec: _RouterRequest, msg) -> bool:
+        """One reply frame, mirroring ``ServingClient.stream`` +
+        ``_stream_wire``'s verdicts.  Returns True when the socket left
+        the loop (stream over, typed death, or protocol error)."""
+        if msg.get("error"):
+            kind = msg.get("kind")
+            if kind in ("engine_dead", "stall"):
+                # typed death: the transport is intact, the engine
+                # behind it is not — keep the connection, fail over
+                self._ev_wire_detach(rec, keep=True)
+                self._ev_failover(rec)
+            else:
+                self._ev_wire_detach(rec, keep=False)
+                self._retire(rec, error=ValueError(str(msg["error"])))
+            return True
+        for t in msg["tokens"]:
+            rec.proxy._push(int(t))
+            rec.relayed += 1
+        if msg["done"]:
+            self._ev_wire_detach(rec, keep=True)
+            self._retire(rec, finish=msg["finish"])
+            return True
+        return False
+
+    def _ev_wire_detach(self, rec: _RouterRequest, keep: bool) -> None:
+        """Unregister the wire attachment's socket; ``keep`` re-parks the
+        client for reuse (socket back to blocking), else it is torn
+        down."""
+        client, rep = rec.client, rec.replica
+        with self._lock:
+            loop = self._relay_loop
+        if loop is not None:
+            loop.remove(client.sock)
+        if keep:
+            try:
+                client.sock.setblocking(True)
+            except OSError:
+                keep = False
+        if keep:
+            self._pool.release(rep.addr, client)
+        else:
+            self._pool.discard(client)
+
+    def _ev_wire_lost(self, rec: _RouterRequest) -> None:
+        self._ev_wire_detach(rec, keep=False)
+        self._ev_failover(rec)
+
+    def _ev_failover(self, rec: _RouterRequest) -> None:
+        """The attachment is gone (typed death or broken wire).  Retire a
+        cancelled request; otherwise hand recovery to a transient thread
+        — resubmission blocks (admission retries, backoff, a full
+        re-stream with replay-skip), which must not stall the loop the
+        OTHER N-1 streams are riding."""
+        if rec.cancelled:
+            self._retire(rec, finish="cancel")
+            return
+        t = threading.Thread(
+            target=self._failover_relay, args=(rec,), daemon=True,
+            name=f"dkt-router-failover-{rec.proxy.id}")
+        with self._lock:
+            rec.thread = t  # stop()/drain() join it like the old relays
+        t.start()
+
+    def _failover_relay(self, rec: _RouterRequest) -> None:
+        """Failover thread: resubmit elsewhere under ``retry_policy`` —
+        the ONE retry machinery ``ServingClient.generate`` also runs on —
+        replaying the already-delivered prefix silently."""
+        try:
             self.retry_policy.call(lambda: self._resubmit_once(rec),
                                    retry_on=_RESUBMIT_RETRY_ON)
         except _RESUBMIT_RETRY_ON as e:

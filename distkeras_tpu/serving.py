@@ -93,10 +93,11 @@ from __future__ import annotations
 import collections
 import logging
 import select
+import selectors
 import socket
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -256,7 +257,8 @@ class RequestHandle:
                  "top_p", "eos_id", "pad_id", "key", "tokens", "finish",
                  "slot", "submitted_at", "started_at", "first_token_at",
                  "finished_at", "deadline", "error", "cancelled_at",
-                 "kvblocks", "tenant", "priority", "_cond", "_chunk_read")
+                 "kvblocks", "tenant", "priority", "_cond", "_chunk_read",
+                 "_listener")
 
     def __init__(self, rid: int, prompt: np.ndarray, num_steps: int,
                  temperature: float, top_k: Optional[int],
@@ -291,6 +293,11 @@ class RequestHandle:
         self.priority = int(priority)
         self._cond = threading.Condition()
         self._chunk_read = 0            # tokens already handed out as chunks
+        #: event-transport hook: a no-arg callable invoked (OUTSIDE
+        #: ``_cond``) whenever tokens arrive or the handle retires — how
+        #: the selector cores get poked without a polling thread per
+        #: stream.  Set via ``set_listener``; polling consumers ignore it.
+        self._listener: Optional[Callable[[], None]] = None
 
     @property
     def done(self) -> bool:
@@ -310,6 +317,9 @@ class RequestHandle:
                 self.first_token_at = time.perf_counter()
             self.tokens.append(int(token))
             self._cond.notify_all()
+            fire = self._listener
+        if fire is not None:  # invoked OUTSIDE _cond: the listener hops
+            fire()            # threads (call_soon) and must not nest locks
 
     def _finish(self, reason: str) -> bool:
         """Returns whether THIS call made the handle terminal — the engine
@@ -321,7 +331,10 @@ class RequestHandle:
             self.finish = reason
             self.finished_at = time.perf_counter()
             self._cond.notify_all()
-            return True
+            fire = self._listener
+        if fire is not None:
+            fire()
+        return True
 
     def _fail(self, exc: BaseException, reason: str = "error") -> bool:
         """Terminal failure: ``result()`` raises ``exc`` instead of
@@ -334,7 +347,20 @@ class RequestHandle:
             self.finish = reason
             self.finished_at = time.perf_counter()
             self._cond.notify_all()
-            return True
+            fire = self._listener
+        if fire is not None:
+            fire()
+        return True
+
+    def set_listener(self, fn: Optional[Callable[[], None]]) -> None:
+        """Install (or clear, with None) the progress listener — fired
+        after every token push and on retirement, outside the handle's
+        lock.  One listener at a time; the event transports each attach
+        their loop-poke here while they own the stream."""
+        with self._cond:
+            self._listener = fn
+        if fn is not None and (self.done or len(self.tokens)):
+            fn()  # catch up on progress that predates the listener
 
     def _expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -4096,11 +4122,103 @@ OP_CANCEL = networking.SERVING_OP_CANCEL
 OP_KVBLOCKS = networking.SERVING_OP_KVBLOCKS
 OP_STATS = networking.SERVING_OP_STATS
 
+#: the selectable serving transport cores (``server_core=`` on
+#: :class:`ServingServer`): ``"threaded"`` is the seed's
+#: thread-per-connection handler, ``"event"`` the one-selector I/O loop
+#: (the ``parameter_servers.PS_CORES`` twin — same knob idiom)
+SERVING_CORES = ("threaded", "event")
+
+#: event-core receive chunk: big enough that a steady-state request frame
+#: lands complete in ONE recv (the parser's zero-copy fast path); larger
+#: frames reassemble through the parser accumulator
+_EV_RECV_CHUNK = 1 << 20
+
+#: frames coalesced per ``sendmsg`` — comfortably under IOV_MAX, and one
+#: loop wake rarely owes a connection more than a few token chunks
+_EV_SENDMSG_BATCH = 64
+
+
+class _EvPoisoned:
+    """A deferred KV-block payload that failed its transport-boundary
+    ``validate()`` while being deep-copied out of the receive scratch —
+    the rejection is replayed when the deferred op is dispatched, so a
+    hostile pipelined ``'k'`` sheds the connection through the same
+    ``ProtocolError`` path the threaded core uses."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: str):
+        self.error = str(error)
+
+
+def _deepcopy_wire_msg(msg: Dict[str, Any]) -> Dict[str, Any]:
+    """Deep-copy a parsed wire message whose ndarray leaves are zero-copy
+    views into the connection's receive scratch.  Deferred (pipelined)
+    ops outlive that scratch — the next ``recv_into`` overwrites it — so
+    views must be promoted to owned memory at deferral time."""
+    out: Dict[str, Any] = {}
+    for k, v in msg.items():
+        if isinstance(v, np.ndarray):
+            out[k] = np.array(v, copy=True)
+        elif isinstance(v, networking.KVBlocks):
+            try:
+                out[k] = v.validate().decoded()
+            except ValueError as e:  # replayed at dispatch (see above)
+                out[k] = _EvPoisoned(str(e))
+        else:
+            out[k] = v
+    return out
+
+
+class _ServingConn:
+    """Per-connection state on the serving event loop: the incremental
+    frame parser over a pooled receive scratch, the pending-write queue
+    with its encode pool, and the streaming-relay state (the handle being
+    pumped, ops the client pipelined past it, backpressure flags).
+
+    Touched ONLY on the loop thread — no lock.  The decoded-view lifetime
+    contract matches the PS event core: every parsed op is consumed (or
+    deep-copied into ``deferred``) before this connection's next
+    ``recv_into`` can overwrite the scratch."""
+
+    __slots__ = ("sock", "parser", "out", "out_bytes", "recv_pool",
+                 "send_pool", "want_write", "paused", "stream", "deferred",
+                 "last_progress", "closed")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.parser = networking.FrameParser(
+            frame_ops=OP_ENQUEUE + OP_STREAM + OP_CANCEL + OP_KVBLOCKS)
+        self.out: List[memoryview] = []
+        self.out_bytes = 0
+        self.recv_pool = networking.BufferPool()
+        self.send_pool = networking.BufferPool()
+        self.want_write = False
+        self.paused = False   # backpressure: reads masked off, pump held
+        self.stream: Optional[RequestHandle] = None  # handle mid-relay
+        self.deferred: List[Tuple[bytes, Dict[str, Any]]] = []
+        self.last_progress = 0.0  # perf_counter of the last stream chunk
+        self.closed = False
+
 
 class ServingServer:
     """TCP front-end for a :class:`ServingEngine` — same accept-loop /
     frame-codec / BufferPool idiom as ``SocketParameterServer``, so serving
     clients speak the exact wire the PS stack already speaks.
+
+    Two transport cores behind one constructor knob (``server_core``, the
+    ``parameter_servers.PS_CORES`` idiom): ``"threaded"`` (default) keeps
+    the seed's thread-per-connection handler bit-identical; ``"event"``
+    multiplexes every connection on ONE selector I/O thread
+    (``dkt-serving-io``) — per-connection read/write buffers over the
+    incremental ``networking.FrameParser``, token frames flushed through
+    a socketpair waker when the engine thread pushes (no per-connection
+    thread), non-blocking coalesced writes so a slow client never pins
+    the relay, and a per-connection outbound cap (``max_conn_buffer``)
+    that stops reading from — and pumping to — a client that stops
+    reading us.  Protocol, typed errors, counters, and the failure matrix
+    below are identical on both cores (docs/serving.md "Event
+    transport").
 
     Per connection: ``'q'`` + request frame → ack ``{"ok": True, "id": n}``
     or a typed rejection (``kind`` ``"backpressure"`` / ``"draining"`` /
@@ -4136,13 +4254,25 @@ class ServingServer:
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 0, stream_timeout_s: float = 60.0,
-                 poll_s: float = 0.02, cancel_on_disconnect: bool = True):
+                 poll_s: float = 0.02, cancel_on_disconnect: bool = True,
+                 server_core: str = "threaded",
+                 max_conn_buffer: int = 1 << 20):
+        if server_core not in SERVING_CORES:
+            raise ValueError(f"server_core must be one of "
+                             f"{sorted(SERVING_CORES)}, got {server_core!r}")
         self.engine = engine
         self.host = host
         self.port = int(port)
         self.stream_timeout_s = float(stream_timeout_s)
         self.poll_s = float(poll_s)
         self.cancel_on_disconnect = bool(cancel_on_disconnect)
+        self.server_core = server_core
+        #: event core only: per-connection outbound-buffer cap in bytes.
+        #: A client that stops reading its token stream grows the pending
+        #: write queue; past this cap the loop stops reading from AND
+        #: pumping to that connection until the flush drains below half
+        #: the cap (the PS core's oversize-guard idiom, per connection).
+        self.max_conn_buffer = int(max_conn_buffer)
         self._handles: Dict[int, RequestHandle] = {}
         #: request id → owning connection (submitting conn, re-claimed by
         #: the streaming conn) — the disconnect-reclamation bookkeeping
@@ -4151,8 +4281,14 @@ class ServingServer:
         self._server: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: List[socket.socket] = []
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _conns
+        #: lock-free stop flag: written once by start()/stop(), polled by
+        #: the accept path on either core — monotonic, so races are benign
         self._running = False
+        #: event core: the shared I/O loop and its per-socket conn state
+        #: (the latter touched ONLY on the loop thread — no lock)
+        self._loop: Optional[networking.EventLoop] = None
+        self._econns: Dict[socket.socket, _ServingConn] = {}
         self.disconnects = 0       # transport faults / EOF mid-frame
         self.protocol_errors = 0   # corrupt frames (bad magic, length lies)
         self.disconnect_cancels = 0  # requests reclaimed from dead clients
@@ -4177,13 +4313,48 @@ class ServingServer:
         self.port = self._server.getsockname()[1]
         self._server.listen(128)
         self._running = True
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True, name="dkt-serving-accept")
-        self._accept_thread.start()
+        if self.server_core == "event":
+            self._server.setblocking(False)
+            self._loop = networking.EventLoop(name="dkt-serving-io")
+            self._loop.stop_hooks.append(self._ev_shutdown)
+            self._loop.start()
+            self._loop.call_soon(
+                lambda: self._loop.add(self._server, self._ev_accept))
+            # the name is load-bearing: supervisors probe server liveness
+            # through ``_accept_thread.is_alive()`` on either core
+            self._accept_thread = self._loop.thread
+        else:
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, daemon=True,
+                name="dkt-serving-accept")
+            self._accept_thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, join_timeout: float = 5.0) -> None:
         self._running = False
+        if self.server_core == "event":
+            loop = self._loop
+            if loop is not None and not loop.stop(join_timeout=join_timeout):
+                # wedged inside a callback (the loop itself never blocks
+                # on a socket): force-close everything from here so the
+                # wedged thread fails fast on its next socket op and a
+                # same-address respawn can bind
+                logger.warning(
+                    "serving I/O loop still alive after stop(join_timeout="
+                    "%.1fs); force-closing its connections and listener",
+                    join_timeout)
+                with self._lock:
+                    conns = list(self._conns)
+                    self._conns.clear()
+                for c in conns:
+                    networking._hard_close(c)
+                if self._server is not None:
+                    try:
+                        self._server.close()
+                    except OSError:
+                        pass
+            self.engine.stop()
+            return
         if self._server is not None:
             try:  # wake the blocked accept()
                 socket.create_connection((self.host, self.port),
@@ -4191,7 +4362,7 @@ class ServingServer:
             except OSError:
                 pass
         if self._accept_thread is not None:
-            self._accept_thread.join(timeout=5.0)
+            self._accept_thread.join(timeout=join_timeout)
         if self._server is not None:
             try:
                 self._server.close()
@@ -4210,6 +4381,23 @@ class ServingServer:
             except OSError:
                 pass
         self.engine.stop()
+
+    def respawn_clone(self, engine: Optional[ServingEngine] = None
+                      ) -> "ServingServer":
+        """A same-core replacement server on this address with every
+        transport knob carried over — ``server_core`` included, so a
+        supervisor restart never silently changes the I/O architecture.
+        ``engine`` defaults to this server's (the ``EngineSupervisor``
+        already re-points ``.engine`` in place; this seam is for the
+        whole-server restart path, mirroring
+        ``SocketParameterServer.respawn_clone``)."""
+        return ServingServer(
+            engine if engine is not None else self.engine,
+            host=self.host, port=self.port,
+            stream_timeout_s=self.stream_timeout_s, poll_s=self.poll_s,
+            cancel_on_disconnect=self.cancel_on_disconnect,
+            server_core=self.server_core,
+            max_conn_buffer=self.max_conn_buffer)
 
     def __enter__(self) -> "ServingServer":
         return self.start()
@@ -4545,6 +4733,500 @@ class ServingServer:
         # EOF (b"") or mid-stream protocol violation: the client is gone
         return "dead"
 
+    # -- the event core ------------------------------------------------------
+    # One selector I/O thread ("dkt-serving-io") multiplexes every client
+    # connection: accept, parse, dispatch, stream-relay, and flush all run
+    # as EventLoop callbacks, so 64 concurrent wire streams cost 64
+    # registered fds instead of 64 handler threads.  Token frames reach
+    # the loop through RequestHandle.set_listener → call_soon (the
+    # socketpair waker), and every method below runs ON the loop thread —
+    # _econns and _ServingConn state need no lock.  Semantics (typed
+    # rejections, mid-stream 'x', pipelining, stall bounds, disconnect
+    # reclamation, counters) mirror the threaded handler above, clause
+    # for clause.
+
+    def _ev_accept(self, mask: int) -> None:
+        while True:
+            try:
+                sock, _ = self._server.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            if not self._running:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            try:
+                sock.setblocking(False)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            conn = _ServingConn(sock)
+            self._econns[sock] = conn
+            with self._lock:
+                self._conns.append(sock)
+            self._loop.add(sock, lambda m, c=conn: self._ev_io(c, m))
+
+    def _ev_io(self, conn: _ServingConn, mask: int) -> None:
+        if conn.closed:
+            return
+        if mask & selectors.EVENT_WRITE:
+            self._ev_flush(conn)
+        if conn.closed or conn.paused:
+            return
+        if mask & selectors.EVENT_READ:
+            self._ev_read(conn)
+
+    def _ev_read(self, conn: _ServingConn) -> None:
+        # drain ops already parsed first (a mid-batch backpressure pause
+        # abandons the messages() walk; the resume path re-enters here
+        # with no new bytes owed by the socket)
+        if self._ev_drain_parsed(conn):
+            return
+        while not conn.closed and not conn.paused:
+            # direct-fill continuation for a frame torn across recvs,
+            # else land the bytes in the pooled scratch and decode
+            # zero-copy views over it (the PS event core's read path)
+            target = conn.parser.writable()
+            fed_scratch = target is None
+            if fed_scratch:
+                target = memoryview(conn.recv_pool.get(_EV_RECV_CHUNK))
+            try:
+                n = conn.sock.recv_into(target)
+            except (BlockingIOError, InterruptedError):
+                return
+            except (ConnectionError, OSError):
+                self._ev_conn_lost(conn, fault=conn.parser.midframe)
+                return
+            if not n:
+                # EOF: clean at a frame boundary (no counter — the
+                # threaded recv_opcode contract), a torn frame otherwise
+                self._ev_conn_lost(conn, fault=conn.parser.midframe)
+                return
+            if fed_scratch:
+                conn.parser.feed(target[:n])
+            else:
+                conn.parser.advance(n)
+            if self._ev_drain_parsed(conn):
+                return  # dispatched >= 1 op: yield the loop (fairness);
+                # the level-triggered selector re-arms for the rest
+
+    def _ev_drain_parsed(self, conn: _ServingConn) -> bool:
+        """Dispatch every op the parser has buffered.  Returns True when
+        at least one op was dispatched or the connection died (the read
+        loop yields), False when more bytes are needed."""
+        got = False
+        try:
+            for op, msg in conn.parser.messages():
+                got = True
+                self._ev_dispatch(conn, op, msg)
+                if conn.closed or conn.paused:
+                    return True
+        except ValueError:
+            if conn.stream is not None:
+                # mid-stream garbage/torn frame: the threaded core's
+                # _poll_client "dead" verdict — cancel + shed, no counter
+                self._ev_conn_lost(conn, fault=False)
+            else:
+                self.protocol_errors += 1  # corrupt frame: shed silently
+                self._ev_close(conn)
+            return True
+        except Exception:
+            logger.exception(
+                "serving event dispatch failed; shedding the connection "
+                "(threaded-core parity: its handler thread died with it)")
+            self._ev_close(conn)
+            return True
+        return got
+
+    def _ev_dispatch(self, conn: _ServingConn, op: Optional[bytes],
+                     msg) -> None:
+        if conn.stream is not None:
+            # mid-stream: the threaded core's _poll_client contract
+            if op == OP_CANCEL:
+                with self._hlock:
+                    target = self._handles.get(int(msg["id"]))
+                if target is not None:
+                    self.engine.cancel(target)
+                return  # unacked: the stream's final frame acknowledges
+            if op in (OP_ENQUEUE, OP_STREAM, OP_KVBLOCKS):
+                # pipelined next request: deferred past the final frame,
+                # deep-copied out of the recv scratch its views die with
+                conn.deferred.append((op, _deepcopy_wire_msg(msg)))
+                return
+            self._ev_conn_lost(conn, fault=False)  # protocol violation
+            return
+        if msg is None:
+            if op == OP_STATS:
+                # load probe, answered inline on the loop (no request
+                # body): the engine's lock-free snapshot, piggybacked on
+                # whatever flush this wake already owes the connection
+                self._ev_queue(conn, {"ok": True,
+                                      "load": self.engine.load()})
+            else:
+                self._ev_close(conn)  # protocol violation: drop silently
+            return
+        if op in (OP_ENQUEUE, OP_KVBLOCKS):
+            self._ev_submit(conn, op, msg)
+        elif op == OP_STREAM:
+            rid = int(msg["id"])
+            with self._hlock:
+                h = self._handles.get(rid)
+                if h is not None:
+                    self._owner[rid] = conn.sock  # stream claims it
+            if h is None:
+                self._ev_queue(conn, {"ok": False, "done": True,
+                                      "kind": "unknown_id",
+                                      "error": f"unknown id {rid}"})
+                return
+            self._ev_start_stream(conn, h)
+        elif op == OP_CANCEL:
+            with self._hlock:
+                h = self._handles.get(int(msg["id"]))
+            ok = h is not None and self.engine.cancel(h)
+            self._ev_queue(conn, {"ok": True, "cancelled": bool(ok)})
+
+    def _ev_submit(self, conn: _ServingConn, op: bytes, msg) -> None:
+        """``'q'``/``'k'`` admission with the threaded core's exact typed
+        rejection chain.  A ``ProtocolError`` (hostile KV payload)
+        re-raises past the bad_request catch so the connection is shed
+        and counted as a protocol error, with no engine call made."""
+        try:
+            if op == OP_KVBLOCKS:
+                kvb = msg.get("blocks")
+                if isinstance(kvb, _EvPoisoned):
+                    raise networking.ProtocolError(kvb.error)
+                if not isinstance(kvb, networking.KVBlocks):
+                    raise networking.ProtocolError(
+                        "kv-block frame carries no KVBlocks payload")
+                kvb = kvb.validate().decoded()
+                h = self.engine.submit_prefilled(
+                    kvb, np.array(msg["prompt"], np.int32, copy=True),
+                    int(msg["first_token"]), int(msg["num_steps"]),
+                    temperature=float(msg.get("temperature", 0.0)),
+                    top_k=msg.get("top_k"), top_p=msg.get("top_p"),
+                    eos_id=msg.get("eos_id"), pad_id=msg.get("pad_id"),
+                    deadline_s=msg.get("deadline_s"),
+                    tenant=msg.get("tenant"),
+                    priority=int(msg.get("priority", 0)), block=False)
+            else:
+                h = self.engine.submit(
+                    np.array(msg["prompt"], np.int32, copy=True),
+                    int(msg["num_steps"]),
+                    temperature=float(msg.get("temperature", 0.0)),
+                    top_k=msg.get("top_k"), top_p=msg.get("top_p"),
+                    eos_id=msg.get("eos_id"), pad_id=msg.get("pad_id"),
+                    seed=int(msg.get("seed", 0)),
+                    deadline_s=msg.get("deadline_s"),
+                    tenant=msg.get("tenant"),
+                    priority=int(msg.get("priority", 0)), block=False)
+        except QuotaExceeded as e:
+            self._ev_queue(conn, {"ok": False, "error": str(e),
+                                  "kind": "quota"})
+            return
+        except QueueFull:
+            self._ev_queue(conn, {"ok": False, "error": "queue full",
+                                  "kind": "backpressure"})
+            return
+        except Draining as e:
+            self._ev_queue(conn, {"ok": False, "error": str(e),
+                                  "kind": "draining"})
+            return
+        except EngineDead as e:
+            self._ev_queue(conn, {"ok": False, "error": str(e),
+                                  "kind": "engine_dead"})
+            return
+        except networking.ProtocolError:
+            raise  # transport-boundary rejection: shed, don't reply
+        except ValueError as e:
+            self._ev_queue(conn, {"ok": False, "error": str(e),
+                                  "kind": "bad_request"})
+            return
+        with self._hlock:
+            self._handles[h.id] = h
+            self._owner[h.id] = conn.sock
+        self._ev_queue(conn, {"ok": True, "id": h.id})
+
+    # -- event-core stream relay --------------------------------------------
+    def _ev_start_stream(self, conn: _ServingConn,
+                         h: RequestHandle) -> None:
+        conn.stream = h
+        conn.last_progress = time.perf_counter()
+        loop = self._loop
+
+        def poke(c=conn, hh=h):
+            loop.call_soon(lambda: self._ev_pump(c, hh))
+
+        h.set_listener(poke)  # fires once now if progress predates it
+        self._ev_schedule_stall(conn, h)
+        self._ev_pump(conn, h)
+
+    def _ev_pump(self, conn: _ServingConn, h: RequestHandle) -> None:
+        """Relay every token chunk ``h`` has ready onto ``conn``'s write
+        queue — the event twin of ``_stream``'s relay body.  Invoked via
+        the handle's listener on every engine push (duplicate wakes are
+        cheap no-ops) and from the backpressure resume path."""
+        if conn.closed or conn.stream is not h or conn.paused:
+            return
+        while True:
+            chunk, done = h.next_chunk(timeout=0)
+            if not done and not len(chunk):
+                return
+            conn.last_progress = time.perf_counter()
+            reply: Dict[str, Any] = {"id": h.id, "tokens": chunk,
+                                     "done": done}
+            if done:
+                reply["finish"] = h.finish
+                if h.error is not None:
+                    reply["ok"] = False
+                    reply["kind"] = "engine_dead"
+                    reply["error"] = str(h.error)
+                else:
+                    reply["row"] = h.result()
+            self._ev_queue(conn, reply)
+            if conn.closed:
+                return  # the flush tore the connection down mid-relay
+            if done:
+                self._ev_end_stream(conn, h)
+                return
+            if conn.paused:
+                return  # backpressure: the flush path resumes the pump
+
+    def _ev_end_stream(self, conn: _ServingConn,
+                       h: RequestHandle) -> None:
+        with self._hlock:
+            self._handles.pop(h.id, None)
+            self._owner.pop(h.id, None)
+        h.set_listener(None)
+        conn.stream = None
+        self._ev_drain_deferred(conn)
+
+    def _ev_drain_deferred(self, conn: _ServingConn) -> None:
+        """Dispatch ops the client pipelined during a stream (the
+        threaded core's ``pending_op``, processed after the final
+        frame).  A deferred ``'r'`` re-enters streaming; anything still
+        queued behind it stays deferred, in order, for that stream's
+        end."""
+        while (conn.deferred and not conn.closed and not conn.paused
+                and conn.stream is None):
+            op, msg = conn.deferred.pop(0)
+            try:
+                self._ev_dispatch(conn, op, msg)
+            except ValueError:
+                if conn.stream is not None:
+                    self._ev_conn_lost(conn, fault=False)
+                else:
+                    self.protocol_errors += 1
+                    self._ev_close(conn)
+                return
+            except Exception:
+                logger.exception("serving event dispatch failed; "
+                                 "shedding the connection")
+                self._ev_close(conn)
+                return
+
+    def _ev_schedule_stall(self, conn: _ServingConn,
+                           h: RequestHandle) -> None:
+        grace = max(1.0, 4 * self.poll_s)
+        now = time.perf_counter()
+        if h.deadline is not None:
+            delay = h.deadline + grace - now
+        else:
+            delay = conn.last_progress + self.stream_timeout_s - now
+        self._loop.call_later(max(self.poll_s, delay),
+                              lambda: self._ev_check_stall(conn, h))
+
+    def _ev_check_stall(self, conn: _ServingConn,
+                        h: RequestHandle) -> None:
+        """Stall watchdog: a stream with no progress past the request
+        deadline (+ grace) or ``stream_timeout_s`` gets the typed
+        ``"stall"`` error frame instead of pinning the relay — the
+        threaded core's bounded-wait contract, on a timer instead of a
+        poll loop.  Stale timers (stream already retired) no-op."""
+        if conn.closed or conn.stream is not h:
+            return
+        grace = max(1.0, 4 * self.poll_s)
+        now = time.perf_counter()
+        if h.deadline is not None:
+            # one empty poll slice of silence required, like the threaded
+            # loop which only diagnoses a stall from an empty slice
+            stalled = (now > h.deadline + grace
+                       and now - conn.last_progress >= self.poll_s)
+        else:
+            stalled = now - conn.last_progress >= self.stream_timeout_s
+        if not stalled:
+            self._ev_schedule_stall(conn, h)
+            return
+        with self._hlock:
+            self._handles.pop(h.id, None)
+            self._owner.pop(h.id, None)
+        self._ev_queue(conn, {"id": h.id, "ok": False, "done": True,
+                              "tokens": np.zeros(0, np.int32),
+                              "finish": "error", "kind": "stall",
+                              "error": f"no progress on request {h.id} "
+                                       f"(engine stalled)"})
+        if conn.closed:
+            return
+        h.set_listener(None)
+        conn.stream = None
+        self._ev_drain_deferred(conn)
+
+    # -- event-core write path ----------------------------------------------
+    def _ev_queue(self, conn: _ServingConn, obj) -> None:
+        if conn.closed:
+            return
+        if conn.out:
+            # the pooled buffer still backs an in-flight frame: encode
+            # into fresh bytes (the PS _queue_reply discipline)
+            data = memoryview(networking.encode_message(obj))
+        else:
+            data = memoryview(networking.encode_message_into(
+                obj, conn.send_pool))
+        conn.out.append(data)
+        conn.out_bytes += len(data)
+        self._ev_flush(conn)
+
+    def _ev_flush(self, conn: _ServingConn) -> None:
+        if conn.closed:
+            return
+        was_paused = conn.paused
+        while conn.out:
+            try:
+                if len(conn.out) > 1:
+                    # write batching: every frame owed to this connection
+                    # in ONE syscall — token chunks queued by successive
+                    # pumps coalesce per loop wake
+                    sent = conn.sock.sendmsg(conn.out[:_EV_SENDMSG_BATCH])
+                else:
+                    sent = conn.sock.send(conn.out[0])
+            except (BlockingIOError, InterruptedError):
+                break
+            except (ConnectionError, OSError):
+                self._ev_conn_lost(conn, fault=True)
+                return
+            conn.out_bytes -= sent
+            while conn.out and sent >= len(conn.out[0]):
+                sent -= len(conn.out[0])
+                conn.out.pop(0)
+            if sent:
+                conn.out[0] = conn.out[0][sent:]
+                break  # partial write: the kernel buffer is full
+        self._ev_update_mask(conn)
+        if was_paused and not conn.paused and not conn.closed:
+            self._loop.call_soon(lambda: self._ev_resume(conn))
+
+    def _ev_update_mask(self, conn: _ServingConn) -> None:
+        if conn.closed:
+            return
+        if conn.paused:
+            if conn.out_bytes <= self.max_conn_buffer // 2:
+                conn.paused = False  # drained: resume reads + pump
+        elif conn.out_bytes > self.max_conn_buffer:
+            conn.paused = True  # never-reading client: stop reading too
+        want = bool(conn.out)
+        conn.want_write = want
+        mask = ((0 if conn.paused else selectors.EVENT_READ)
+                | (selectors.EVENT_WRITE if want else 0))
+        if not mask:  # unreachable (paused implies pending writes), but
+            mask = selectors.EVENT_READ  # a 0 mask would be an error
+        self._loop.set_mask(conn.sock, mask)
+
+    def _ev_resume(self, conn: _ServingConn) -> None:
+        """Backpressure release: re-pump the stream (tokens queued while
+        paused sit in the handle — bounded by its ``num_steps``), then
+        re-drain parsed/deferred ops before going back to the socket."""
+        if conn.closed or conn.paused:
+            return
+        if conn.stream is not None:
+            self._ev_pump(conn, conn.stream)
+        if conn.closed or conn.paused:
+            return
+        if conn.stream is None:
+            self._ev_drain_deferred(conn)
+        if not conn.closed and not conn.paused:
+            self._ev_read(conn)
+
+    # -- event-core teardown -------------------------------------------------
+    def _ev_conn_lost(self, conn: _ServingConn, fault: bool) -> None:
+        """Transport-level death.  Counting mirrors the threaded core:
+        mid-stream death is ``_poll_client``'s "dead" verdict (cancel the
+        streamed request, no counter); outside a stream a torn frame or
+        send fault counts ``disconnects``; a clean EOF counts nothing."""
+        if conn.closed:
+            return
+        h = conn.stream
+        if h is not None:
+            if self.cancel_on_disconnect:
+                self.engine.cancel(h)
+        elif fault:
+            self.disconnects += 1
+        self._ev_close(conn)
+
+    def _ev_close(self, conn: _ServingConn) -> None:
+        if conn.closed:
+            return
+        conn.closed = True
+        h = conn.stream
+        conn.stream = None
+        if h is not None:
+            h.set_listener(None)
+        if self._loop is not None:
+            self._loop.remove(conn.sock)
+        self._econns.pop(conn.sock, None)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            if conn.sock in self._conns:
+                self._conns.remove(conn.sock)
+        del conn.out[:]
+        conn.out_bytes = 0
+        del conn.deferred[:]
+        self._release_owned(conn.sock)
+
+    def _ev_shutdown(self) -> None:
+        """Loop-exit hook (runs ON the loop thread, before the selector
+        and waker close): flush pending writes bounded-best-effort, close
+        every registered connection, reclaim their owned requests, close
+        the listener.  ``stop(join_timeout)`` drains through here — zero
+        leaked fds (tests/test_serving_event.py)."""
+        conns = list(self._econns.values())
+        self._econns.clear()
+        with self._lock:
+            self._conns.clear()
+        for conn in conns:
+            if conn.out:
+                try:
+                    conn.sock.setblocking(True)
+                    conn.sock.settimeout(0.5)
+                    for buf in conn.out:
+                        conn.sock.sendall(buf)
+                except (ConnectionError, OSError, socket.timeout):
+                    pass
+            h = conn.stream
+            conn.stream = None
+            if h is not None:
+                h.set_listener(None)
+            conn.closed = True
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+            self._release_owned(conn.sock)
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+
 
 def _raise_typed(kind: Optional[str], err: str):
     """Map a typed error reply back to the exception the engine raised."""
@@ -4699,14 +5381,18 @@ class _DisaggRequest:
     (prefill first, decode after the hand-off), and a cancel relay that
     always points at whichever engine owns the upstream right now."""
 
-    __slots__ = ("proxy", "upstream", "cancel_fn", "cancelled", "thread")
+    __slots__ = ("proxy", "upstream", "cancel_fn", "cancelled", "thread",
+                 "kw", "attempts")
 
-    def __init__(self, proxy: RequestHandle):
+    def __init__(self, proxy: RequestHandle, kw: Optional[Dict[str, Any]]
+                 = None):
         self.proxy = proxy
         self.upstream: Optional[RequestHandle] = None
         self.cancel_fn = None
         self.cancelled = False
         self.thread: Optional[threading.Thread] = None
+        self.kw: Dict[str, Any] = dict(kw or {})
+        self.attempts = 1  # prefill admissions so far (re-route budget)
 
 
 class DisaggPair:
@@ -4766,6 +5452,11 @@ class DisaggPair:
         self._live: Dict[int, _DisaggRequest] = {}
         self._next_id = 0
         self._rr = 0  # round-robin cursor over prefill engines
+        #: shared event relay (PR 19): ONE loop watches every in-flight
+        #: request across both halves — prefill completion, the KV
+        #: hand-off, and the decode token relay — instead of a routing
+        #: thread per request.  Lazily started on first submit.
+        self._relay_loop: Optional[networking.EventLoop] = None
         # the pair's OWN terminal accounting: engine counters double-count
         # a re-routed request (every attempt is a submission somewhere), so
         # client-facing totals live here
@@ -4794,26 +5485,22 @@ class DisaggPair:
     def stop(self, join_timeout: float = 10.0) -> None:
         for e in self.engines:
             e.stop(join_timeout=join_timeout)
+        self._ev_wait_idle(join_timeout)
         with self._lock:
-            threads = [r.thread for r in self._live.values()]
-        for t in threads:
-            if t is not None:
-                t.join(timeout=join_timeout)
+            loop, self._relay_loop = self._relay_loop, None
+        if loop is not None:
+            loop.stop(join_timeout=join_timeout)
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful drain, prefill side first (no new hand-offs) then the
-        decode engine; router threads are joined last so every proxy
-        reaches a terminal state."""
+        decode engine; the event relay pumps the final laps out so every
+        proxy reaches a terminal state before this returns."""
         with self._lock:
             pres, dec = list(self._prefills), self._decode
         clean = all([e.drain(timeout=timeout) for e in pres])
         if dec is not None:
             clean = dec.drain(timeout=timeout) and clean
-        with self._lock:
-            threads = [r.thread for r in self._live.values()]
-        for t in threads:
-            if t is not None:
-                t.join(timeout=5.0)
+        self._ev_wait_idle(5.0)
         return clean
 
     def __enter__(self) -> "DisaggPair":
@@ -4844,15 +5531,12 @@ class DisaggPair:
                 float(kw.get("temperature", 0.0)), kw.get("top_k"),
                 kw.get("top_p"), kw.get("eos_id"), kw.get("pad_id"),
                 ph.key, deadline_s=kw.get("deadline_s"))
-            rec = _DisaggRequest(proxy)
+            rec = _DisaggRequest(proxy, kw)
             rec.upstream = ph
             rec.cancel_fn = (lambda e=eng, h=ph: e.cancel(h))
             self._live[proxy.id] = rec
             self.counters["requests_submitted"] += 1
-            rec.thread = threading.Thread(
-                target=self._route, args=(rec, dict(kw)), daemon=True,
-                name=f"dkt-disagg-route-{proxy.id}")
-            rec.thread.start()
+        self._ev_watch_prefill(rec, ph)
         return proxy
 
     def _submit_prefill(self, prompt, num_steps, kw, first: bool,
@@ -4887,94 +5571,150 @@ class DisaggPair:
             "no live prefill engine")
 
     # -------------------------------------------------------------- routing
-    def _route(self, rec: _DisaggRequest, kw: Dict[str, Any]) -> None:
-        """Per-request router thread: wait out the prefill half (re-routing
-        across prefill deaths), ship the block set, then relay the decode
-        engine's tokens into the proxy."""
-        proxy = rec.proxy
-        attempts = 1
-        while True:
-            ph = rec.upstream
-            ph.wait()
-            if ph.finish == "prefilled":
-                break
-            if ph.error is not None:
-                # prefill engine died with the request in flight: re-route
-                # with the ORIGINAL key so the retry is bit-identical
-                with self._lock:
-                    budget = len(self._prefills) + 1
-                if attempts >= budget:
-                    self._retire(rec, error=EngineDead(
-                        f"request {proxy.id}: every prefill re-route "
-                        f"failed ({ph.error})"))
-                    return
-                with self._lock:
-                    self.counters["prefill_reroutes"] += 1
-                    cancelled = rec.cancelled
-                if cancelled:
-                    self._retire(rec, finish="cancel")
-                    return
-                try:
-                    ph, eng = self._submit_prefill(
-                        proxy.prompt, proxy.num_steps, kw, first=False,
-                        rng=proxy.key)
-                except (EngineDead, QueueFull, Draining) as e:
-                    self._retire(rec, error=e)
-                    return
-                with self._lock:
-                    rec.upstream = ph
-                    rec.cancel_fn = (lambda e=eng, h=ph: e.cancel(h))
-                    if rec.cancelled:
-                        rec.cancel_fn()
-                attempts += 1
-                continue
-            # cancel / deadline / drain on the prefill half: mirror it
-            self._retire(rec, finish=ph.finish)
-            return
-        kvb = ph.kvblocks
-        first_token = int(ph.tokens[0])
+    #
+    # The whole request lifecycle rides the pair's shared event loop
+    # (PR 19): the prefill handle's listener wakes the loop when its half
+    # retires, the KV hand-off runs as a loop callback (non-blocking
+    # decode admission, with a ``call_later`` retry while the decode
+    # queue is full), and the decode half relays listener-driven — no
+    # per-request routing thread anywhere on the path.
+
+    def _ev_loop(self) -> "networking.EventLoop":
         with self._lock:
-            dec = self._decode  # in-flight relays keep their decode engine
-        try:
+            loop = self._relay_loop
+            if loop is None or not loop.alive:
+                loop = networking.EventLoop(name="dkt-disagg-relay")
+                loop.start()
+                self._relay_loop = loop
+            return loop
+
+    def _ev_watch_prefill(self, rec: _DisaggRequest,
+                          ph: RequestHandle) -> None:
+        loop = self._ev_loop()
+        ph.set_listener(lambda: loop.call_soon(
+            lambda: self._ev_prefill_done(rec, ph)))
+        loop.call_soon(lambda: self._ev_prefill_done(rec, ph))
+
+    def _ev_prefill_done(self, rec: _DisaggRequest,
+                         ph: RequestHandle) -> None:
+        """Loop-side prefill watcher: when the prefill half retires, hand
+        off (``finish="prefilled"``), re-route a death with the ORIGINAL
+        key (bit-identical retry, bounded by one attempt per engine), or
+        mirror a cancel/deadline/drain finish."""
+        proxy = rec.proxy
+        if rec.upstream is not ph or not ph.done:
+            return  # stale wake, or woken by a token push mid-prefill
+        ph.set_listener(None)
+        rec.upstream = None  # claim the transition exactly once
+        if ph.finish == "prefilled":
+            kvb = ph.kvblocks
+            first_token = int(ph.tokens[0])
+            with self._lock:
+                dec = self._decode  # in-flight hand-offs keep their engine
             if dec is not None:
-                self._relay_local(rec, kvb, first_token, kw, dec)
+                self._ev_handoff_local(rec, kvb, first_token, dec)
             else:
-                self._relay_wire(rec, kvb, first_token, kw)
-        except (EngineDead, ConnectionError, OSError) as e:
+                self._ev_handoff_wire(rec, kvb, first_token)
+            return
+        if ph.error is not None:
+            # prefill engine died with the request in flight: re-route
+            # with the ORIGINAL key so the retry is bit-identical
+            with self._lock:
+                budget = len(self._prefills) + 1
+            if rec.attempts >= budget:
+                self._retire(rec, error=EngineDead(
+                    f"request {proxy.id}: every prefill re-route "
+                    f"failed ({ph.error})"))
+                return
+            with self._lock:
+                self.counters["prefill_reroutes"] += 1
+                cancelled = rec.cancelled
+            if cancelled:
+                self._retire(rec, finish="cancel")
+                return
+            try:
+                nph, eng = self._submit_prefill(
+                    proxy.prompt, proxy.num_steps, rec.kw, first=False,
+                    rng=proxy.key)
+            except (EngineDead, QueueFull, Draining) as e:
+                self._retire(rec, error=e)
+                return
+            with self._lock:
+                rec.upstream = nph
+                rec.cancel_fn = (lambda e=eng, h=nph: e.cancel(h))
+                if rec.cancelled:
+                    rec.cancel_fn()
+            rec.attempts += 1
+            self._ev_watch_prefill(rec, nph)
+            return
+        # cancel / deadline / drain on the prefill half: mirror it
+        self._retire(rec, finish=ph.finish)
+
+    def _ev_handoff_local(self, rec: _DisaggRequest, kvb,
+                          first_token: int, dec: ServingEngine) -> None:
+        """In-process hand-off on the loop: non-blocking decode admission,
+        re-armed via ``call_later`` while the decode queue is full (the
+        event-core analogue of the old thread's ``block=True`` park)."""
+        proxy = rec.proxy
+        if rec.cancelled:
+            self._retire(rec, finish="cancel")
+            return
+        try:
+            dh = dec.submit_prefilled(
+                kvb, proxy.prompt, first_token, proxy.num_steps,
+                temperature=proxy.temperature, top_k=proxy.top_k,
+                top_p=proxy.top_p, eos_id=proxy.eos_id,
+                pad_id=proxy.pad_id, deadline_s=rec.kw.get("deadline_s"),
+                block=False)
+        except QueueFull:
+            self._relay_loop.call_later(
+                self.poll_s, lambda: self._ev_handoff_local(
+                    rec, kvb, first_token, dec))
+            return
+        except (EngineDead, Draining) as e:
             # decode death is terminal (typed), never silently re-routed:
             # the decode engine owns all live KV state
-            self._retire(rec, error=e if isinstance(e, EngineDead)
-                         else EngineDead(f"decode engine unreachable: "
-                                         f"{e!r}"))
+            self._retire(rec, error=e)
+            return
         except ValueError as e:
             self._retire(rec, error=e)
-
-    def _relay_local(self, rec: _DisaggRequest, kvb, first_token: int,
-                     kw: Dict[str, Any], dec: ServingEngine) -> None:
-        proxy = rec.proxy
-        dh = dec.submit_prefilled(
-            kvb, proxy.prompt, first_token, proxy.num_steps,
-            temperature=proxy.temperature, top_k=proxy.top_k,
-            top_p=proxy.top_p, eos_id=proxy.eos_id, pad_id=proxy.pad_id,
-            deadline_s=kw.get("deadline_s"), block=True)
+            return
         with self._lock:
             rec.upstream = dh
             rec.cancel_fn = (lambda e=dec, h=dh: e.cancel(h))
             if rec.cancelled:
                 rec.cancel_fn()
+        loop = self._relay_loop
+        dh.set_listener(lambda: loop.call_soon(
+            lambda: self._ev_pump_decode(rec, dh)))
+        self._ev_pump_decode(rec, dh)
+
+    def _ev_pump_decode(self, rec: _DisaggRequest,
+                        dh: RequestHandle) -> None:
+        """Loop-side decode relay: drain ready chunks into the proxy."""
+        if rec.upstream is not dh:
+            return  # stale wake
+        proxy = rec.proxy
         while True:
-            chunk, done = dh.next_chunk(timeout=self.poll_s)
+            chunk, done = dh.next_chunk(timeout=0)
             for t in chunk:
                 proxy._push(int(t))
             if done:
+                dh.set_listener(None)
+                rec.upstream = None
                 if dh.error is not None:
                     self._retire(rec, error=dh.error)
                 else:
                     self._retire(rec, finish=dh.finish)
                 return
+            if not len(chunk):
+                return  # drained; the listener wakes us on more
 
-    def _relay_wire(self, rec: _DisaggRequest, kvb, first_token: int,
-                    kw: Dict[str, Any]) -> None:
+    def _ev_handoff_wire(self, rec: _DisaggRequest, kvb,
+                         first_token: int) -> None:
+        """Wire hand-off on the loop: ship the block set to the remote
+        decode server (``SERVING_OP_KVBLOCKS``), then relay its reply
+        stream non-blocking off a bare-frame parser."""
         proxy = rec.proxy
         client = ServingClient(*self._decode_addr)
         try:
@@ -4982,22 +5722,115 @@ class DisaggPair:
                 kvb, proxy.prompt, first_token, proxy.num_steps,
                 temperature=proxy.temperature, top_k=proxy.top_k,
                 top_p=proxy.top_p, eos_id=proxy.eos_id,
-                pad_id=proxy.pad_id, deadline_s=kw.get("deadline_s"))
-            with self._lock:
-                rec.upstream = None
-                rec.cancel_fn = (lambda c=client, r=rid:
-                                 c.cancel(r, await_ack=False))
-                if rec.cancelled:
-                    rec.cancel_fn()
-            for tokens, done in client.stream(rid):
-                for t in tokens:
-                    proxy._push(int(t))
-                if done is not None:
-                    self._retire(rec, finish=done["finish"])
-                    return
-            raise ConnectionError("stream ended without a done frame")
-        finally:
+                pad_id=proxy.pad_id, deadline_s=rec.kw.get("deadline_s"))
+            networking.send_opcode(client.sock, OP_STREAM)
+            networking.send_data(client.sock, {"id": int(rid)},
+                                 pool=client._send_pool)
+            client.sock.setblocking(False)
+        except (EngineDead, ConnectionError, OSError) as e:
             client.close()
+            self._retire(rec, error=e if isinstance(e, EngineDead)
+                         else EngineDead(f"decode engine unreachable: "
+                                         f"{e!r}"))
+            return
+        except ValueError as e:
+            client.close()
+            self._retire(rec, error=e)
+            return
+        with self._lock:
+            rec.cancel_fn = (lambda c=client, r=rid:
+                             c.cancel(r, await_ack=False))
+            if rec.cancelled:
+                try:
+                    rec.cancel_fn()
+                except (ConnectionError, OSError):
+                    pass
+        parser = networking.FrameParser(frame_ops=None)
+        scratch = networking.BufferPool()
+        loop = self._relay_loop
+        if loop is None:
+            client.close()
+            return
+        loop.add(client.sock,
+                 lambda mask: self._ev_wire_read(rec, client, parser,
+                                                 scratch))
+
+    def _ev_wire_read(self, rec: _DisaggRequest, client, parser,
+                      scratch) -> None:
+        sock = client.sock
+        while True:
+            target = parser.writable()
+            fed_scratch = target is None
+            if fed_scratch:
+                target = memoryview(scratch.get(_EV_RECV_CHUNK))
+            try:
+                n = sock.recv_into(target)
+            except (BlockingIOError, InterruptedError):
+                return
+            except (ConnectionError, OSError) as e:
+                self._ev_wire_lost(rec, client, e)
+                return
+            if not n:
+                self._ev_wire_lost(rec, client,
+                                   ConnectionError("stream ended without "
+                                                   "a done frame"))
+                return
+            if fed_scratch:
+                parser.feed(target[:n])
+            else:
+                parser.advance(n)
+            try:
+                for _op, msg in parser.messages():
+                    if self._ev_wire_frame(rec, client, msg):
+                        return  # stream finished / typed failure
+            except ValueError as e:
+                self._ev_wire_lost(rec, client, e)
+                return
+
+    def _ev_wire_frame(self, rec: _DisaggRequest, client, msg) -> bool:
+        """One decode-server reply frame.  Returns True when the stream
+        detached (done or failed) — decode death is terminal, typed."""
+        if msg.get("error"):
+            kind = msg.get("kind")
+            err = str(msg["error"])
+            self._ev_wire_detach(rec, client)
+            if kind in ("engine_dead", "stall"):
+                self._retire(rec, error=EngineDead(err))
+            else:
+                self._retire(rec, error=ValueError(err))
+            return True
+        for t in msg["tokens"]:
+            rec.proxy._push(int(t))
+        if msg["done"]:
+            self._ev_wire_detach(rec, client)
+            self._retire(rec, finish=msg["finish"])
+            return True
+        return False
+
+    def _ev_wire_detach(self, rec: _DisaggRequest, client) -> None:
+        loop = self._relay_loop
+        if loop is not None:
+            loop.remove(client.sock)
+        client.close()
+
+    def _ev_wire_lost(self, rec: _DisaggRequest, client,
+                      err: BaseException) -> None:
+        self._ev_wire_detach(rec, client)
+        self._retire(rec, error=err if isinstance(err, EngineDead)
+                     else EngineDead(f"decode engine unreachable: "
+                                     f"{err!r}"))
+
+    def _ev_wait_idle(self, timeout: float) -> None:
+        """Bounded wait for the loop to retire the in-flight requests —
+        stopping/draining the engines makes their handles terminal, and
+        the loop pumps those final laps out asynchronously."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                busy = bool(self._live)
+            if not busy or time.monotonic() >= deadline:
+                return
+            time.sleep(0.005)
 
     def _retire(self, rec: _DisaggRequest, finish: Optional[str] = None,
                 error: Optional[BaseException] = None) -> None:

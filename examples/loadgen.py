@@ -422,6 +422,86 @@ def run_open_loop(engine, trace: Sequence[Dict[str, Any]], qps: float,
     return out
 
 
+def run_wire_closed_loop(addr, trace: Sequence[Dict[str, Any]],
+                         concurrency: int = 8,
+                         timeout_s: float = 300.0) -> Dict[str, Any]:
+    """``concurrency`` WIRE clients — one TCP connection each — against a
+    :class:`distkeras_tpu.serving.ServingServer` address, each submitting
+    its next trace request the moment its previous one completes: the
+    closed loop of :func:`run_closed_loop` moved onto real sockets, so
+    what it measures is the server's transport core, not just the engine.
+    At 64 clients the thread-per-connection core holds 64 server-side
+    relay threads while the event core holds ONE selector thread —
+    ``server_conn_threads_peak`` samples that difference mid-flight (the
+    O(1)-vs-O(N) observable ``bench.py``'s ``serving_connection_scaling``
+    field records alongside tokens/sec per core × client count)."""
+    from distkeras_tpu.serving import ServingClient
+
+    it = iter(trace)
+    lock = threading.Lock()
+    latencies: List[float] = []
+    errors: List[BaseException] = []
+    tokens = [0]
+
+    def user():
+        try:
+            with ServingClient(*addr) as c:
+                while True:
+                    with lock:
+                        req = next(it, None)
+                    if req is None:
+                        return
+                    kw = dict(req)
+                    prompt = kw.pop("prompt")
+                    steps = kw.pop("num_steps")
+                    r0 = time.perf_counter()
+                    rid = c.submit(prompt, steps, **kw)
+                    got = 0
+                    for toks, done in c.stream(rid):
+                        got += len(toks)
+                        if done is not None:
+                            break
+                    with lock:
+                        tokens[0] += got
+                        latencies.append(time.perf_counter() - r0)
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            with lock:
+                errors.append(e)
+
+    threads = [threading.Thread(target=user, name=f"loadgen-wire-{i}")
+               for i in range(int(concurrency))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    # sample the server's per-connection thread count while streams are
+    # live (threads named dkt-serving-conn*: the threaded core's O(N))
+    peak_conn_threads = 0
+    deadline = t0 + timeout_s
+    while any(t.is_alive() for t in threads):
+        n = sum(1 for t in threading.enumerate()
+                if t.name.startswith("dkt-serving-conn"))
+        peak_conn_threads = max(peak_conn_threads, n)
+        if time.perf_counter() > deadline:
+            break
+        time.sleep(0.005)
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.perf_counter()) + 1.0)
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    return {
+        "clients": int(concurrency),
+        "completed": len(latencies),
+        "tokens": tokens[0],
+        "wall_s": round(wall, 3),
+        "tokens_per_sec": (round(tokens[0] / wall, 1)
+                           if wall > 0 else None),
+        "p50_ms": _percentile_ms(latencies, 50),
+        "p99_ms": _percentile_ms(latencies, 99),
+        "server_conn_threads_peak": peak_conn_threads,
+    }
+
+
 def sequential_baseline(fitted, trace: Sequence[Dict[str, Any]],
                         max_len: int) -> Dict[str, Any]:
     """The same trace, one request at a time through offline ``generate``
@@ -753,6 +833,15 @@ def main():
     ap.add_argument("--tier-mix", type=float, default=0.25,
                     help="fraction of requests on the interactive tenant "
                          "(with --tenants)")
+    ap.add_argument("--server-core", choices=("threaded", "event"),
+                    default=None,
+                    help="run the trace over REAL sockets: wrap the "
+                         "engine in a ServingServer with this transport "
+                         "core and drive it with --concurrency wire "
+                         "clients (closed loop); prints tokens/sec plus "
+                         "the mid-flight per-connection server thread "
+                         "count — the O(1)-vs-O(N) transport comparison "
+                         "(PR 19)")
     ap.add_argument("--overload", type=float, default=None,
                     help="run the QoS overload leg instead of the closed "
                          "loop: open-loop arrivals at this offered QPS "
@@ -807,6 +896,18 @@ def main():
                        prefix_len=args.prefix_len,
                        tenants=args.tenants, tier_mix=args.tier_mix)
     try:
+        if args.server_core is not None:
+            from distkeras_tpu.serving import ServingServer
+            srv = ServingServer(engine, server_core=args.server_core,
+                                poll_s=0.01).start()
+            try:
+                wire = run_wire_closed_loop(srv.addr, trace,
+                                            concurrency=args.concurrency)
+            finally:
+                srv.stop()
+            print(json.dumps({"mode": "wire_closed_loop",
+                              "server_core": args.server_core, **wire}))
+            return
         if args.overload is not None:
             point = run_overload(engine, trace, qps=args.overload)
             print(json.dumps({"mode": "qos_overload",

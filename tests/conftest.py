@@ -56,6 +56,7 @@ _FILE_COST = {  # mean s/test on the CPU gate machine; unlisted -> 3.0
     "test_speculative.py": 4.44, "test_ulysses.py": 4.50,
     "test_parallelism.py": 4.69, "test_attention.py": 4.91,
     "test_packing.py": 5.10, "test_parallel_transformer.py": 5.47,
+    "test_serving_event.py": 5.1,
     "test_serving_resilience.py": 5.49, "test_zero.py": 5.55,
     "test_serving_fastpath.py": 6.12, "test_tpu_smoke.py": 6.43,
     "test_fsdp.py": 7.41,
@@ -79,6 +80,25 @@ def eight_devices():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(params=["threaded", "event"])
+def server_core(request, monkeypatch):
+    """Parametrize ``ServingServer``'s transport core (PR 19): a
+    wire-touching test that pulls this fixture runs once per core —
+    thread-per-connection and one-selector event loop — with no edits at
+    its construction sites; the fixture rebinds the constructor's
+    DEFAULT, so explicit ``server_core=`` arguments still win."""
+    from distkeras_tpu import serving
+    core = request.param
+    orig = serving.ServingServer.__init__
+
+    def _init(self, *args, **kw):
+        kw.setdefault("server_core", core)
+        orig(self, *args, **kw)
+
+    monkeypatch.setattr(serving.ServingServer, "__init__", _init)
+    return core
 
 
 def pytest_configure(config):

@@ -104,7 +104,7 @@ def test_single_replica_router_bit_identical_in_process(fitted):
                        top_k=8))
 
 
-def test_single_replica_router_bit_identical_over_wire(fitted):
+def test_single_replica_router_bit_identical_over_wire(fitted, server_core):
     with ServingServer(_engine(fitted)) as srv:
         with ServingRouter(addrs=[srv.addr]) as r:
             greedy = r.submit(PROMPT, 8).result(timeout=30)
@@ -195,7 +195,7 @@ def test_trie_node_counter_survives_eviction(fitted):
     assert eng._pool.trie_nodes == eng._pool.cached_blocks()
 
 
-def test_wire_stats_probe_matches_engine_load(fitted):
+def test_wire_stats_probe_matches_engine_load(fitted, server_core):
     with ServingServer(_engine(fitted)) as srv:
         c = ServingClient(*srv.addr)
         try:
@@ -389,7 +389,7 @@ def test_kill_under_load_loses_zero_requests_in_process(fitted):
         r.stop()
 
 
-def test_kill_resubmits_over_wire_typed_death(fitted):
+def test_kill_resubmits_over_wire_typed_death(fitted, server_core):
     # typed EngineDead through the wire: the dead server answers probes
     # (dead=True) and streams error frames; requests fail over to the
     # live server
@@ -407,7 +407,7 @@ def test_kill_resubmits_over_wire_typed_death(fitted):
             assert r.counters["requests_completed"] == 4
 
 
-def test_kill_resubmits_over_wire_transport_fault(fitted):
+def test_kill_resubmits_over_wire_transport_fault(fitted, server_core):
     # the server process "dies" (socket torn, probes unreachable): relays
     # must fail over on the raw ConnectionError, not just typed frames
     s0 = ServingServer(_engine(fitted)).start()

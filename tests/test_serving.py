@@ -234,7 +234,7 @@ def test_background_thread_drives_requests(fitted):
     np.testing.assert_array_equal(h.result(), want)
 
 
-def test_wire_server_roundtrip_and_streaming(fitted):
+def test_wire_server_roundtrip_and_streaming(fitted, server_core):
     with ServingServer(ServingEngine(fitted, num_slots=2, max_len=24)) as srv:
         with ServingClient(*srv.addr) as c:
             rid = c.submit(PROMPT, 6, temperature=0.7, top_k=5, seed=11)
@@ -256,7 +256,7 @@ def test_wire_server_roundtrip_and_streaming(fitted):
                 np.asarray(fitted.generate(PROMPT[None], 6, max_len=24))[0])
 
 
-def test_wire_server_backpressure_reply(fitted):
+def test_wire_server_backpressure_reply(fitted, server_core):
     eng = ServingEngine(fitted, num_slots=1, max_len=24, queue_capacity=1)
     with ServingServer(eng) as srv:
         with ServingClient(*srv.addr) as c:
@@ -267,7 +267,7 @@ def test_wire_server_backpressure_reply(fitted):
     assert eng.stats["requests_rejected"] >= 1
 
 
-def test_wire_server_bad_request_reply(fitted):
+def test_wire_server_bad_request_reply(fitted, server_core):
     with ServingServer(ServingEngine(fitted, num_slots=1, max_len=16)) as srv:
         with ServingClient(*srv.addr) as c:
             with pytest.raises(ValueError, match="max_len"):

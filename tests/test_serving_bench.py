@@ -253,6 +253,83 @@ def test_fleet_bench_fields_shape():
     assert all(v is None for v in out.values())
 
 
+# ---------------------------------------------------------------------------
+# wire transport scaling (PR 19): the fast legs are tier-1 (small trace over
+# loopback, bounded waits); the 64-client scaling comparison is slow
+# ---------------------------------------------------------------------------
+
+def test_wire_bench_fields_shape():
+    """bench.serving_wire_bench returns exactly the transport-scaling
+    field set (None allowed — the artifact contract)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    out = bench.serving_wire_bench(budget_s=0.0)  # force the overrun path
+    assert set(out) == {"serving_event_tokens_per_sec",
+                        "serving_connection_scaling"}
+    assert all(v is None for v in out.values())
+
+
+def test_wire_closed_loop_lossless_both_cores():
+    """Tier-1 deterministic wire leg: a small trace through a
+    ServingServer over loopback completes losslessly on BOTH transport
+    cores, and the event core's mid-flight per-connection server thread
+    count is ZERO while the threaded core's is positive."""
+    from distkeras_tpu.serving import ServingServer
+
+    trace = loadgen.make_trace(6, num_steps=6, temperature=0.5)
+    conn_threads = {}
+    for core in ("threaded", "event"):
+        _, engine = loadgen.build_engine(num_slots=2, queue_capacity=16)
+        srv = ServingServer(engine, server_core=core, poll_s=0.01).start()
+        try:
+            m = loadgen.run_wire_closed_loop(srv.addr, trace,
+                                             concurrency=4,
+                                             timeout_s=120.0)
+        finally:
+            srv.stop()
+            engine.stop()
+        assert m["completed"] == 6, (core, m)
+        assert m["tokens"] == 6 * 6
+        assert m["tokens_per_sec"] > 0
+        assert m["p50_ms"] is not None and m["p99_ms"] >= m["p50_ms"]
+        conn_threads[core] = m["server_conn_threads_peak"]
+    assert conn_threads["event"] == 0, conn_threads
+    assert conn_threads["threaded"] >= 1, conn_threads
+
+
+@pytest.mark.slow
+def test_wire_event_core_holds_throughput_at_64_clients():
+    """The PR 19 acceptance comparison: at 64 concurrent wire clients the
+    event core's ONE selector thread sustains at least the threaded
+    core's tokens/sec (64 relay threads), with zero per-connection
+    server threads."""
+    from distkeras_tpu.serving import ServingServer
+
+    trace = loadgen.make_trace(96, num_steps=8)
+    tps = {}
+    for core in ("threaded", "event"):
+        _, engine = loadgen.build_engine(num_slots=4, queue_capacity=128)
+        srv = ServingServer(engine, server_core=core, poll_s=0.01).start()
+        try:
+            m = loadgen.run_wire_closed_loop(srv.addr, trace,
+                                             concurrency=64,
+                                             timeout_s=300.0)
+        finally:
+            srv.stop()
+            engine.stop()
+        assert m["completed"] == 96, (core, m)
+        tps[core] = m["tokens_per_sec"]
+        if core == "event":
+            assert m["server_conn_threads_peak"] == 0, m
+        else:
+            assert m["server_conn_threads_peak"] >= 32, m
+    # one loop thread replaces 64 relay threads without losing
+    # throughput (10% guard band: both cores are engine-bound here,
+    # the margin absorbs scheduler noise on a loaded CI host)
+    assert tps["event"] >= tps["threaded"] * 0.9, tps
+
+
 @pytest.mark.router
 def test_closed_loop_router_fleet_lossless():
     """Tier-1 deterministic fleet leg: the closed loop drives a 2-replica

@@ -280,7 +280,7 @@ def test_pair_supervisor_restart_seam(fitted):
 # the wire path: SERVING_OP_KVBLOCKS through ServingServer
 # ---------------------------------------------------------------------------
 
-def test_pair_over_wire_token_identical(fitted):
+def test_pair_over_wire_token_identical(fitted, server_core):
     """decode_addr mode: blocks ship over loopback through the serving
     protocol's 'k' opcode; the client-visible stream is unchanged."""
     reqs = [
@@ -312,7 +312,7 @@ def _prefilled(fitted, num_steps=6):
     return h.kvblocks, int(h.tokens[0])
 
 
-def test_hostile_kvblocks_frame_sheds_pool_untouched(fitted):
+def test_hostile_kvblocks_frame_sheds_pool_untouched(fitted, server_core):
     """A 'k' frame whose payload lies about its own geometry dies in
     validate() (typed ProtocolError → the server's shed path) BEFORE any
     engine call: protocol_errors increments, the decode pool never
@@ -348,7 +348,7 @@ def test_hostile_kvblocks_frame_sheds_pool_untouched(fitted):
         assert srv.engine.kv_blocks_in_use == 0
 
 
-def test_geometry_mismatch_rejected_typed(fitted):
+def test_geometry_mismatch_rejected_typed(fitted, server_core):
     """A self-consistent block set that doesn't match the DECODE engine's
     arena geometry is a typed bad_request (engine-level ValueError), not
     a dropped connection."""
@@ -362,7 +362,7 @@ def test_geometry_mismatch_rejected_typed(fitted):
         assert srv.engine.kv_blocks_in_use == 0
 
 
-def test_torn_kvblocks_transfer_decode_pool_untouched(fitted):
+def test_torn_kvblocks_transfer_decode_pool_untouched(fitted, server_core):
     """ChaosProxy tears the 'k' frame mid-transfer (half the payload,
     then RST): the decode server sheds the torn frame with its pool
     untouched and keeps serving the next, intact transfer."""

@@ -397,7 +397,8 @@ def test_drain_timeout_fails_suspended_typed(fitted):
 # wire: tenant/priority on 'q', typed quota kind, disconnect-while-suspended
 # ---------------------------------------------------------------------------
 
-def test_wire_tenant_priority_quota_and_disconnect(fitted, ref_rows):
+def test_wire_tenant_priority_quota_and_disconnect(fitted, ref_rows,
+                                                    server_core):
     eng = _mk(fitted, tenants=[
         _bulk(), _live(),
         TenantPolicy("metered", rate=0.001, burst=1.0)])
@@ -426,10 +427,23 @@ def test_wire_tenant_priority_quota_and_disconnect(fitted, ref_rows):
         # request is reclaimed like any other — cancelled, record
         # dropped, zero blocks leaked
         c2 = ServingClient(*srv.addr)
+        # Pace decode while arming the preempt: a warm engine runs all 18
+        # steps of bulk_sampled in ~7ms — inside one _wait poll — so an
+        # unthrottled race can see the request finish before preempt
+        # lands (flaky on both server cores). The throttle changes only
+        # timing, never token values.
+        orig_decode = eng._decode_once
+
+        def paced_decode():
+            time.sleep(0.02)
+            return orig_decode()
+
+        eng._decode_once = paced_decode
         rid2 = c2.submit(tenant="bulk", **REQS["bulk_sampled"])
         h2 = srv._handles[rid2]
         _wait(lambda: len(h2.tokens) >= 2, what="decode progress")
         assert eng.preempt(h2)
+        eng._decode_once = orig_decode
         _wait(lambda: rid2 in eng._suspended, what="suspension")
         c2.close()
         _wait(lambda: h2.finish is not None, what="disconnect reclaim")

@@ -183,7 +183,7 @@ def test_deadline_mid_chunked_prefill_frees_slot(fitted):
     _assert_slots_reclaimed(eng)
 
 
-def test_disconnect_mid_chunked_prefill_reclaims(fitted):
+def test_disconnect_mid_chunked_prefill_reclaims(fitted, server_core):
     """A client that dies while its request is mid-chunked-prefill: the
     server's disconnect reclamation cancels it, and the scheduler aborts
     the prefill and frees the slot — no handle or slot leaks."""
@@ -260,7 +260,7 @@ def test_cancel_queued_and_running(fitted):
     _assert_slots_reclaimed(eng)
 
 
-def test_cancel_wire_opcode_and_finish_reason(fitted):
+def test_cancel_wire_opcode_and_finish_reason(fitted, server_core):
     with ServingServer(ServingEngine(fitted, num_slots=1, max_len=24),
                        poll_s=0.01) as srv:
         with ServingClient(*srv.addr) as c:
@@ -278,7 +278,7 @@ def test_cancel_wire_opcode_and_finish_reason(fitted):
     assert srv.engine.stats["requests_cancelled"] == 1
 
 
-def test_midstream_cancel_same_socket(fitted):
+def test_midstream_cancel_same_socket(fitted, server_core):
     """A cancel sent on the SAME socket mid-stream is consumed between
     chunk frames (unacked); the stream's final frame carries
     finish="cancel"."""
@@ -298,7 +298,7 @@ def test_midstream_cancel_same_socket(fitted):
     _assert_slots_reclaimed(eng)
 
 
-def test_client_disconnect_mid_stream_reclaims_slot(fitted):
+def test_client_disconnect_mid_stream_reclaims_slot(fitted, server_core):
     """A client that RSTs mid-stream has its request cancelled within one
     poll slice — the slot is back in the pool long before the request
     would have decoded to completion."""
@@ -322,7 +322,7 @@ def test_client_disconnect_mid_stream_reclaims_slot(fitted):
             assert rid not in srv._handles and rid not in srv._owner
 
 
-def test_submit_then_die_reclaims_ownership(fitted):
+def test_submit_then_die_reclaims_ownership(fitted, server_core):
     """A connection that submitted (but never streamed) and died has its
     owned request cancelled — a dead client pins neither slot nor handle
     entry."""
@@ -347,7 +347,8 @@ def test_submit_then_die_reclaims_ownership(fitted):
 
 
 @pytest.mark.parametrize("codec", ["python", "native"])
-def test_half_frame_disconnect_sheds_connection(fitted, codec, monkeypatch):
+def test_half_frame_disconnect_sheds_connection(fitted, codec, monkeypatch,
+                                                server_core):
     """Half a serving request frame then RST (both codecs): the handler
     sheds the connection silently — live bookkeeping decrements, pooled
     buffers go with the handler — and the engine keeps serving."""
@@ -373,7 +374,7 @@ def test_half_frame_disconnect_sheds_connection(fitted, codec, monkeypatch):
                                           _want(fitted, PROMPT, 6))
 
 
-def test_stalled_engine_sends_typed_error_frame(fitted):
+def test_stalled_engine_sends_typed_error_frame(fitted, server_core):
     """Satellite: the handler's stream wait is bounded (stream_timeout_s /
     request deadline), not a hardcoded minute — a wedged engine yields a
     typed "stall" error frame, and the connection stays usable."""
@@ -421,7 +422,7 @@ def test_drain_inline_engine(fitted):
     assert h.finish == "length"
 
 
-def test_drain_over_the_wire_is_typed(fitted):
+def test_drain_over_the_wire_is_typed(fitted, server_core):
     eng = ServingEngine(fitted, num_slots=1, max_len=24)
     with ServingServer(eng) as srv:
         with ServingClient(*srv.addr) as c:
@@ -518,7 +519,7 @@ def test_blocked_submit_raises_draining_on_drain(fitted):
     assert h1.finish == "length"
 
 
-def test_pipelined_enqueue_mid_stream_keeps_connection(fitted):
+def test_pipelined_enqueue_mid_stream_keeps_connection(fitted, server_core):
     """Regression: a client that pipelines its next 'q' on the same socket
     while a stream is still relaying is NOT a dead client — the server
     stashes the opcode, finishes the stream, then processes the enqueue,
@@ -616,7 +617,8 @@ def test_stop_join_timeout_surfaces_wedged_thread(fitted):
 # EngineSupervisor: detect crash + wedge, restart, client retry
 # ---------------------------------------------------------------------------
 
-def test_supervisor_restarts_crashed_engine_and_client_retries(fitted, lock_order_audit):
+def test_supervisor_restarts_crashed_engine_and_client_retries(
+        fitted, lock_order_audit, server_core):
     eng = ServingEngine(fitted, num_slots=2, max_len=24).warmup()
     want = _want(fitted, PROMPT, 6)
     with ServingServer(eng, poll_s=0.01) as srv:
@@ -642,7 +644,8 @@ def test_supervisor_restarts_crashed_engine_and_client_retries(fitted, lock_orde
             _assert_slots_reclaimed(srv.engine)
 
 
-def test_supervisor_detects_wedged_engine_via_heartbeat(fitted, lock_order_audit):
+def test_supervisor_detects_wedged_engine_via_heartbeat(
+        fitted, lock_order_audit, server_core):
     eng = ServingEngine(fitted, num_slots=2, max_len=24).warmup()
     want = _want(fitted, PROMPT, 6)
     release = _wedge(eng)
@@ -712,7 +715,7 @@ def test_warmup_refuses_active_engine_and_keeps_bit_identity(fitted):
 # the serving chaos matrix (ChaosProxy serving protocol)
 # ---------------------------------------------------------------------------
 
-def test_chaos_proxy_serving_clean_relay(fitted):
+def test_chaos_proxy_serving_clean_relay(fitted, server_core):
     eng = ServingEngine(fitted, num_slots=2, max_len=24)
     with ServingServer(eng, poll_s=0.01) as srv:
         with ChaosProxy(*srv.addr, protocol="serving") as px:
@@ -728,7 +731,8 @@ def test_chaos_proxy_serving_clean_relay(fitted):
     ChaosFault(0, 1, "cut_stream", 2),  # RST mid-stream after 2 chunks
     ChaosFault(0, 0, "delay", 0.05),  # delayed but successful
 ])
-def test_chaos_matrix_slot_reclaimed_others_bit_identical(fitted, fault):
+def test_chaos_matrix_slot_reclaimed_others_bit_identical(fitted, fault,
+                                                          server_core):
     """For each scripted fault at an exact (conn, opcode) point: the
     affected slot is reclaimed, no handle blocks forever, and an
     unaffected concurrent request (direct connection) stays bit-identical
@@ -770,7 +774,7 @@ def test_chaos_matrix_slot_reclaimed_others_bit_identical(fitted, fault):
             assert not srv._handles and not srv._owner
 
 
-def test_chaos_client_stall_reclaims_via_deadline(fitted):
+def test_chaos_client_stall_reclaims_via_deadline(fitted, server_core):
     """The "client stall" row of the matrix: a client that submits and
     never streams (connection held open, nothing read) cannot pin a slot
     past the request deadline."""
@@ -864,7 +868,7 @@ def test_deadline_mid_round_and_mid_chunked_prefill(fitted, spec_draft):
     _assert_slots_reclaimed(eng)
 
 
-def test_disconnect_mid_round_reclaims_speculating_slot(fitted):
+def test_disconnect_mid_round_reclaims_speculating_slot(fitted, server_core):
     """A client RST while its request is mid-speculative-round: the wire
     server's disconnect reclamation cancels it and both KV pools' rows
     free — the engine keeps serving, bit-identical."""
@@ -890,7 +894,7 @@ def test_disconnect_mid_round_reclaims_speculating_slot(fitted):
     ChaosFault(0, 0, "reset"),
     ChaosFault(0, 1, "cut_stream", 2),
 ])
-def test_chaos_matrix_under_speculation(fitted, fault):
+def test_chaos_matrix_under_speculation(fitted, fault, server_core):
     """The PR 8 chaos matrix rows re-run against a SPECULATIVE engine:
     the faulted slot reclaims (draft pool included), the unaffected
     concurrent request stays bit-identical to offline generate."""
@@ -920,7 +924,7 @@ def test_chaos_matrix_under_speculation(fitted, fault):
             assert not srv._handles and not srv._owner
 
 
-def test_supervisor_restart_preserves_spec_and_quant(fitted):
+def test_supervisor_restart_preserves_spec_and_quant(fitted, server_core):
     """An engine crash under supervision: the respawned clone carries the
     draft + quantization state (satellite contract) and the retried
     request completes — greedy speculation still token-identical."""
@@ -1046,7 +1050,7 @@ def test_attach_ps_keeps_serving_when_ps_dies_mid_pull(fitted):
 # ---------------------------------------------------------------------------
 
 @pytest.mark.slow
-def test_soak_killed_clients_and_engine_crash_zero_leaks(fitted):
+def test_soak_killed_clients_and_engine_crash_zero_leaks(fitted, server_core):
     """~10% of clients RST mid-stream, and the engine is crashed once
     mid-run under supervision: zero slot leaks, zero lost surviving
     requests, every surviving row bit-identical to offline generate."""
@@ -1205,7 +1209,7 @@ def test_paged_retirement_matrix_zero_block_leaks(fitted, reason, spec):
 
 
 @pytest.mark.paged
-def test_paged_disconnect_and_drain_zero_block_leaks(fitted):
+def test_paged_disconnect_and_drain_zero_block_leaks(fitted, server_core):
     """Wire disconnect reclamation and graceful drain on the paged pool:
     a client RST mid-stream cancels its request and frees its blocks; a
     drain finishes in-flight work and leaves the allocator at baseline
@@ -1236,7 +1240,8 @@ def test_paged_disconnect_and_drain_zero_block_leaks(fitted):
     ChaosFault(0, 0, "reset"),
     ChaosFault(0, 1, "cut_stream", 2),
 ])
-def test_paged_chaos_matrix_survivors_bit_identical(fitted, fault):
+def test_paged_chaos_matrix_survivors_bit_identical(fitted, fault,
+                                                    server_core):
     """The PR 8 chaos-matrix rows against the paged pool: the faulted
     request's blocks free, the unaffected concurrent request stays
     bit-identical, and the allocator returns to baseline."""
@@ -1265,7 +1270,7 @@ def test_paged_chaos_matrix_survivors_bit_identical(fitted, fault):
 
 
 @pytest.mark.paged
-def test_paged_supervisor_restart_carries_knobs(fitted):
+def test_paged_supervisor_restart_carries_knobs(fitted, server_core):
     """Engine crash under supervision: the respawned clone keeps
     paged/block_size/kv_blocks (same arena shape) with a FRESH trie, and
     the retried request completes generate-identically."""
