@@ -306,13 +306,6 @@ class OnlineDeployment:
                 "OnlineDeployment drives the streaming horizon loop — "
                 "construct the trainer with stream=True "
                 "(execution='host_ps')")
-        if int(getattr(trainer, "ps_shards", 1) or 1) != 1:
-            raise ValueError(
-                "OnlineDeployment needs ps_shards=1: the engine's "
-                "attach_ps pull ('p') returns one server's slice, and a "
-                "sharded center would hot-reload torn weights "
-                "(recovery=True still works — the N=1 plan is the "
-                "identity partition)")
         if not isinstance(source, StreamSource):
             raise ValueError(
                 f"source must be a streaming.StreamSource, got "
@@ -396,8 +389,20 @@ class OnlineDeployment:
     def _on_ps_ready(self, server, addr: Tuple[str, int]) -> None:
         self.ps_addr = (str(addr[0]), int(addr[1]))
         eng, _ = self._current()
+        # sharded training PS (ps_shards>1): the streaming run hands this
+        # hook the live ShardedServerGroup — attach the engine with its
+        # plan + per-shard ports so every hot-reload pull gathers the FULL
+        # center (attach_ps's all-or-nothing sharded path), never one
+        # shard's torn slice.  The advertise host comes from `addr`; the
+        # group's ports are bind-side but port numbers are host-agnostic.
+        plan = getattr(server, "plan", None)
+        shard_kw = {}
+        if plan is not None and getattr(plan, "num_shards", 1) > 1:
+            shard_kw = {"shard_plan": plan,
+                        "shard_addrs": [(self.ps_addr[0], int(p))
+                                        for p in server.ports]}
         eng.attach_ps(*self.ps_addr, every=self.reload_every,
-                      retry_policy=self.reload_retry_policy)
+                      retry_policy=self.reload_retry_policy, **shard_kw)
         self._ps_ready.set()
 
     def _on_horizon(self, h: int, fitted) -> None:

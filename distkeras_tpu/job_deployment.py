@@ -47,7 +47,8 @@ class Job:
                  env: Optional[Dict[str, str]] = None,
                  python: str = sys.executable,
                  coordinator_port: int = COORDINATOR_PORT,
-                 coordinated: bool = True):
+                 coordinated: bool = True,
+                 process_ids: Optional[Sequence[int]] = None):
         self.name = name
         self.script = script
         self.args = list(args)
@@ -55,6 +56,12 @@ class Job:
         self.env = dict(env or {})
         self.python = python
         self.coordinator_port = int(coordinator_port)
+        # process_ids: explicit id per host slot (default: the slot index).
+        # Lets a supervisor respawn ONE member under a FRESH id through the
+        # same runner — a single-host Job whose process_ids=[7] renders
+        # DISTKERAS_TPU_PROCESS_ID=7, not 0.
+        self.process_ids = (None if process_ids is None
+                            else [int(i) for i in process_ids])
         # coordinated=False: processes are independent (no jax.distributed
         # group) — e.g. PS workers that only speak the socket wire; one
         # crashing must not stall the others at an init barrier
@@ -69,6 +76,8 @@ class Job:
         num = max(len(self.hosts), 1)
         coordinator = (self.hosts[0] if self.hosts else "127.0.0.1")
         env = dict(self.env)
+        if self.process_ids is not None:
+            process_id = self.process_ids[process_id]
         env["DISTKERAS_TPU_PROCESS_ID"] = str(process_id)
         if self.coordinated:
             env.update({
@@ -111,7 +120,8 @@ class Job:
         return {"name": self.name, "script": self.script, "args": self.args,
                 "hosts": self.hosts, "env": self.env, "python": self.python,
                 "coordinator_port": self.coordinator_port,
-                "coordinated": self.coordinated}
+                "coordinated": self.coordinated,
+                "process_ids": self.process_ids}
 
     @classmethod
     def from_record(cls, rec: dict) -> "Job":
@@ -119,7 +129,8 @@ class Job:
                    rec.get("hosts"), rec.get("env"),
                    rec.get("python", sys.executable),
                    rec.get("coordinator_port", COORDINATOR_PORT),
-                   rec.get("coordinated", True))
+                   rec.get("coordinated", True),
+                   rec.get("process_ids"))
 
 
 class JobRunner:

@@ -29,9 +29,11 @@ import collections
 import heapq
 import json
 import logging
+import os
 import random
 import select
 import selectors
+import signal
 import socket
 import struct
 import threading
@@ -1398,6 +1400,10 @@ def recv_opcode(sock: socket.socket) -> bytes:
     """Receive a 1-byte opcode; returns b'' on clean EOF (worker hung up)."""
     try:
         op = sock.recv(1)
+    except socket.timeout:
+        # an idle_deadline elapsed on a socket with settimeout() armed —
+        # half-open peer detection, not EOF; let the server's handler reap
+        raise
     except (ConnectionError, OSError):
         return b""
     return op
@@ -1445,6 +1451,26 @@ class ChaosFault(NamedTuple):
       relay the stream request, forward ``arg`` reply chunk frames
       (default 1), then RST both sides: the deterministic client-reset
       MID-stream, driving the server's disconnect-reclamation path.
+
+    WAN-grade actions (simulated-DCN chaos — docs/DEPLOY.md §2):
+
+    - ``"partition"`` — a network partition between every worker behind
+      this proxy and the upstream: the request is dropped, EVERY live
+      relay pair is RST in both directions, and for ``arg`` seconds
+      (default 0.5) new connections through the proxy are refused with an
+      RST — then the partition HEALS and relaying resumes.  A worker's
+      reconnect-resume keeps re-dialing into the partition (refused
+      dials are retryable) and succeeds on heal; the injection point is
+      scripted, the heal is the wall clock.
+    - ``"delay_up"`` / ``"delay_down"`` — asymmetric per-direction
+      latency: sleep before forwarding the *request* upstream
+      (``delay_up``) or before relaying the *reply* back down
+      (``delay_down``).  ``arg`` is seconds, or ``(base, jitter)`` where
+      the actual delay is ``base + jitter * u`` with ``u`` drawn from the
+      connection's seeded rng stream — jittered yet reproducible.
+    - ``"bandwidth"`` — shape this op's request frame and its reply to
+      ``arg`` bytes/second (default 1 MiB/s) by relaying in paced chunks,
+      the deterministic stand-in for a thin cross-DC link.
     """
 
     conn: int
@@ -1498,7 +1524,8 @@ class ChaosProxy:
         self.auto = dict(auto or {})
         self.injected: List[tuple] = []
         self.connections = 0
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _pairs, connections, _partition_until
+        self._partition_until = 0.0  # monotonic deadline; 0 = healed
         self._running = True
         self._stall = threading.Event()  # released by stop(): frees 'stall'
         self._pairs: List[tuple] = []  # live (client, upstream) socket pairs
@@ -1525,6 +1552,14 @@ class ChaosProxy:
     def stop(self):
         self._running = False
         self._stall.set()  # unblock connections wedged on a 'stall' fault
+        try:  # closing an fd does not reliably interrupt a blocked accept()
+            # on Linux — wake it with a self-connection; the loop sees
+            # _running=False and returns instead of serving it
+            wake = socket.create_connection((self.host, self.port),
+                                            timeout=1.0)
+            wake.close()
+        except OSError:
+            pass  # listener already dead — accept has returned
         try:
             self._server.close()
         except OSError:
@@ -1565,7 +1600,43 @@ class ChaosProxy:
                 return ChaosFault(conn, op_index, action, arg)
         return None
 
+    def _partitioned(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._partition_until
+
+    def _begin_partition(self, heal_after: float):
+        """Drop both directions: RST every live relay pair and refuse new
+        connections until the heal deadline."""
+        with self._lock:
+            self._partition_until = max(
+                self._partition_until, time.monotonic() + heal_after)
+            pairs = list(self._pairs)
+            self._pairs.clear()
+        for a, b in pairs:
+            _hard_close(a)
+            _hard_close(b)
+
+    @staticmethod
+    def _jittered(arg, rng: random.Random, default: float = 0.05) -> float:
+        if isinstance(arg, (tuple, list)):
+            base, jitter = arg
+            return float(base) + float(jitter) * rng.random()
+        return float(arg if arg is not None else default)
+
+    @staticmethod
+    def _send_shaped(sock: socket.socket, data, rate: float,
+                     chunk: int = 4096) -> None:
+        """Relay ``data`` at ``rate`` bytes/second in paced chunks."""
+        mv = memoryview(data)
+        for i in range(0, len(mv), chunk):
+            piece = mv[i:i + chunk]
+            sock.sendall(piece)
+            time.sleep(len(piece) / max(rate, 1.0))
+
     def _serve(self, idx: int, client: socket.socket):
+        if self._partitioned():
+            _hard_close(client)  # dials into the partition are refused
+            return
         try:
             upstream = socket.create_connection(self.upstream, timeout=10.0)
         except OSError:
@@ -1589,6 +1660,8 @@ class ChaosProxy:
                 op = client.recv(1)
                 if not op:
                     return
+                if self._partitioned():
+                    return  # mid-partition: finally RSTs both sides
                 frame = read_frame(client) if op in frame_ops else None
                 fault = self._fault_for(idx, op_index, rng)
                 op_index += 1
@@ -1596,6 +1669,11 @@ class ChaosProxy:
                     self.injected.append((idx, op_index - 1, fault.action))
                     if fault.action == "delay":
                         time.sleep(float(fault.arg or 0.05))
+                    elif fault.action == "delay_up":
+                        time.sleep(self._jittered(fault.arg, rng))
+                    elif fault.action == "partition":
+                        self._begin_partition(float(fault.arg or 0.5))
+                        return  # this pair was just hard-closed
                     elif fault.action == "stall":
                         # hold the connection open but relay nothing more:
                         # the worker wedges in its recv until the proxy
@@ -1611,9 +1689,15 @@ class ChaosProxy:
                         if frame is not None:
                             upstream.sendall(frame[:max(9, len(frame) // 2)])
                         return
+                shaped = (fault is not None and fault.action == "bandwidth")
+                rate = (self._jittered(fault.arg, rng, default=1 << 20)
+                        if shaped else 0.0)
                 upstream.sendall(op)
                 if frame is not None:
-                    upstream.sendall(frame)
+                    if shaped:
+                        self._send_shaped(upstream, frame, rate)
+                    else:
+                        upstream.sendall(frame)
                 if serving and op == b"r":
                     cut_after = (max(int(fault.arg or 1), 1)
                                  if fault is not None
@@ -1623,7 +1707,12 @@ class ChaosProxy:
                         return  # finally RSTs both sides mid-stream
                 elif op in reply_ops:
                     reply = read_frame(upstream)
-                    client.sendall(reply)
+                    if fault is not None and fault.action == "delay_down":
+                        time.sleep(self._jittered(fault.arg, rng))
+                    if shaped:
+                        self._send_shaped(client, reply, rate)
+                    else:
+                        client.sendall(reply)
                     if fault is not None and fault.action == "dup_reply":
                         client.sendall(reply)
         except (ConnectionError, OSError, ValueError):
@@ -1660,3 +1749,157 @@ class ChaosProxy:
                 msg = decode_message(reply)
                 if isinstance(msg, dict) and msg.get("done"):
                     return
+
+
+# ---------------------------------------------------------------------------
+# deterministic process-level fault injection
+# ---------------------------------------------------------------------------
+
+class ProcessFault(NamedTuple):
+    """One scripted process fault: ``at_s`` seconds after
+    :meth:`ProcessChaos.start`, send ``action`` to the process slot named
+    ``target``:
+
+    - ``"kill"`` — SIGKILL: the abrupt process death (no atexit, no final
+      flush, a half-written frame left on the wire);
+    - ``"stop"`` — SIGSTOP: the process freezes (connections stay OPEN,
+      no EOF, no RST — the wire signature of a wedged host);
+    - ``"cont"`` — SIGCONT: thaw a stopped process (schedule one after
+      every ``"stop"`` unless the test tears the process down itself).
+    """
+
+    target: str
+    at_s: float
+    action: str
+
+
+class ProcessChaos:
+    """Seeded SIGKILL/SIGSTOP/SIGCONT schedules over real OS processes —
+    the process-level twin of :class:`ChaosProxy` (ROADMAP item 1: chaos
+    for the ``ps_worker_main`` / PS-shard process rail).
+
+    ``targets`` maps slot names to the process behind them: an ``int``
+    pid, a ``subprocess.Popen``, or a zero-arg callable returning either
+    (or None) — the callable form tracks a supervised slot whose pid
+    changes across respawns.  Resolution happens at FIRE time, so a fault
+    always lands on the slot's *current* process.
+
+    The schedule is deterministic like the proxy's: explicit
+    :class:`ProcessFault` entries, plus an optional seeded auto mode —
+    ``auto={"kill": p, "stop": (p, freeze_s)}`` draws per (tick, target)
+    from one ``random.Random(seed)`` stream over ``horizon_s`` seconds of
+    ``tick_s`` ticks, a pure function of the constructor arguments (every
+    ``"stop"`` it draws schedules its own ``"cont"`` ``freeze_s`` later).
+    Execution is wall-clock best effort on a daemon thread; ``injected``
+    records ``(target, at_s, action, pid)`` per delivered signal, and
+    signals to already-dead slots are recorded with ``pid=None`` and
+    skipped.
+    """
+
+    _SIGNALS = {"kill": signal.SIGKILL, "stop": signal.SIGSTOP,
+                "cont": signal.SIGCONT}
+
+    def __init__(self, targets: Dict[str, Any],
+                 faults: Sequence[ProcessFault] = (),
+                 seed: int = 0,
+                 auto: Optional[Dict[str, Any]] = None,
+                 tick_s: float = 0.25,
+                 horizon_s: float = 5.0):
+        self.targets = dict(targets)
+        self.seed = int(seed)
+        self.injected: List[tuple] = []
+        self._schedule = [ProcessFault(*f) for f in faults]
+        rng = random.Random(self.seed)
+        for spec_action, spec in sorted((auto or {}).items()):
+            p, arg = (spec if isinstance(spec, (tuple, list))
+                      else (spec, None))
+            if spec_action not in self._SIGNALS:
+                raise ValueError(
+                    f"auto action must be one of {sorted(self._SIGNALS)}, "
+                    f"got {spec_action!r}")
+            t = float(tick_s)
+            while t <= float(horizon_s):
+                for name in sorted(self.targets):
+                    if rng.random() < float(p):
+                        self._schedule.append(
+                            ProcessFault(name, t, spec_action))
+                        if spec_action == "stop":
+                            self._schedule.append(ProcessFault(
+                                name, t + float(arg or tick_s), "cont"))
+                t += float(tick_s)
+        self._schedule.sort(key=lambda f: (f.at_s, f.target, f.action))
+        for f in self._schedule:
+            if f.action not in self._SIGNALS:
+                raise ValueError(
+                    f"action must be one of {sorted(self._SIGNALS)}, "
+                    f"got {f.action!r}")
+            if f.target not in self.targets:
+                raise ValueError(f"unknown target {f.target!r} "
+                                 f"(have {sorted(self.targets)})")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def schedule(self) -> List[ProcessFault]:
+        """The resolved (scripted + auto) schedule, fire order — a pure
+        function of the constructor arguments, assertable by tests."""
+        return list(self._schedule)
+
+    def _pid_of(self, name: str) -> Optional[int]:
+        tgt = self.targets.get(name)
+        if callable(tgt):
+            tgt = tgt()
+        if tgt is None:
+            return None
+        pid = getattr(tgt, "pid", tgt)
+        if getattr(tgt, "poll", None) is not None and tgt.poll() is not None:
+            return None  # already reaped: the pid may be reused
+        return int(pid)
+
+    def _fire(self, fault: ProcessFault) -> None:
+        pid = self._pid_of(fault.target)
+        if pid is not None:
+            try:
+                os.kill(pid, self._SIGNALS[fault.action])
+            except (ProcessLookupError, PermissionError):
+                pid = None
+        self.injected.append((fault.target, fault.at_s, fault.action, pid))
+
+    def start(self) -> "ProcessChaos":
+        t0 = time.monotonic()
+
+        def run():
+            for fault in self._schedule:
+                delay = fault.at_s - (time.monotonic() - t0)
+                if delay > 0 and self._stop.wait(delay):
+                    return
+                if self._stop.is_set():
+                    return
+                self._fire(fault)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="dkt-process-chaos")
+        self._thread.start()
+        return self
+
+    def stop(self, thaw: bool = True) -> None:
+        """Cancel undelivered faults.  ``thaw`` (default) sends SIGCONT to
+        every target so no test leaves a stopped process behind."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if thaw:
+            for name in sorted(self.targets):
+                pid = self._pid_of(name)
+                if pid is not None:
+                    try:
+                        os.kill(pid, signal.SIGCONT)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+
+    def __enter__(self) -> "ProcessChaos":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -46,6 +46,7 @@ import os
 import selectors
 import socket
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -393,6 +394,35 @@ class DynSGDParameterServer(ParameterServer):
         return 1.0 / (staleness + 1.0)
 
 
+def _enable_keepalive(sock: socket.socket,
+                      idle_deadline: Optional[float] = None) -> None:
+    """Kernel-level dead-peer detection on an accepted PS connection: a
+    host that vanished without a FIN (power loss, hard partition) stops
+    acking keepalive probes and the kernel errors the socket out of its
+    blocked recv — the transport-level half of half-open reaping (the
+    application-level half is ``idle_deadline``).  With a deadline set,
+    the probe schedule is tightened to fire WITHIN it (idle at half the
+    deadline, then up to 3 probes); without one, the OS defaults (hours)
+    apply.  Every knob is best-effort — platforms without TCP_KEEPIDLE
+    simply keep the plain SO_KEEPALIVE bit."""
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    except OSError:
+        return
+    if idle_deadline is None:
+        return
+    idle = max(1, int(idle_deadline / 2))
+    intvl = max(1, int(idle_deadline / 6))
+    for opt, val in (("TCP_KEEPIDLE", idle), ("TCP_KEEPINTVL", intvl),
+                     ("TCP_KEEPCNT", 3)):
+        if hasattr(socket, opt):
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP,
+                                getattr(socket, opt), val)
+            except OSError:
+                pass
+
+
 class ThreadedSocketParameterServer:
     """The seed-era thread-per-connection PS core (reference:
     ``SocketParameterServer.run`` — thread per connection, opcode dispatch).
@@ -409,7 +439,8 @@ class ThreadedSocketParameterServer:
     """
 
     def __init__(self, ps: ParameterServer, host: str = "127.0.0.1",
-                 port: int = 0, generation: int = 0):
+                 port: int = 0, generation: int = 0,
+                 idle_deadline: Optional[float] = None):
         self.ps = ps
         self.host = host
         self.port = port  # 0 → ephemeral; real port set by start()
@@ -418,6 +449,19 @@ class ThreadedSocketParameterServer:
         # an older generation are rejected (they were computed against a
         # center this restart rolled back) — the epoch/generation handshake.
         self.generation = int(generation)
+        # half-open reaping (docs/host_ps.md failure matrix): a WAN peer
+        # that vanished without a FIN (partition, SIGKILLed host, NAT state
+        # loss) leaves its handler blocked in recv forever.  idle_deadline
+        # seconds of silence reaps the connection — the worker re-dials and
+        # resumes under its RetryPolicy, so reaping costs one reconnect,
+        # never a lost commit.  None (default) keeps the seed behavior:
+        # only kernel keepalive (always on) eventually notices.
+        self.idle_deadline = (None if idle_deadline is None
+                              else float(idle_deadline))
+        if self.idle_deadline is not None and self.idle_deadline <= 0:
+            raise ValueError("idle_deadline must be > 0 (or None)")
+        #: connections reaped for idle_deadline silence (observability)
+        self.reaped = 0
         self._server: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._conn_threads: List[threading.Thread] = []
@@ -546,7 +590,8 @@ class ThreadedSocketParameterServer:
         generation bumped (resilience.ShardSupervisor.respawn_shard)."""
         return ThreadedSocketParameterServer(
             ps, host=self.host, port=self.port,
-            generation=self.generation + 1)
+            generation=self.generation + 1,
+            idle_deadline=self.idle_deadline)
 
     # -- service loops -------------------------------------------------------
     def _accept_loop(self):
@@ -563,6 +608,12 @@ class ThreadedSocketParameterServer:
                         pass
                     return
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _enable_keepalive(conn, self.idle_deadline)
+                if self.idle_deadline is not None:
+                    # blocked recv/send wakes with socket.timeout after
+                    # this much silence → the handler reaps the half-open
+                    # connection instead of pinning a thread forever
+                    conn.settimeout(self.idle_deadline)
                 t = threading.Thread(
                     target=self._handle_connection, args=(conn,),
                     daemon=True, name="dkt-ps-conn")
@@ -634,6 +685,12 @@ class ThreadedSocketParameterServer:
                         networking.send_data(conn, reply, pool=send_pool)
                 else:
                     return  # protocol violation: drop the connection
+        except socket.timeout:
+            # idle_deadline of silence: the peer is half-open (vanished
+            # without FIN) or wedged — reap the connection; a live worker
+            # re-dials under its RetryPolicy
+            self.reaped += 1
+            return
         except (ConnectionError, OSError):
             # worker died: reference behavior is silent handler exit; the
             # server keeps serving the others
@@ -673,7 +730,7 @@ class _EventConn:
     the pooled-``recv_data`` contract, per connection."""
 
     __slots__ = ("sock", "parser", "out", "recv_pool", "send_pool",
-                 "want_write")
+                 "want_write", "last_activity")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
@@ -682,6 +739,9 @@ class _EventConn:
         self.recv_pool = networking.BufferPool()
         self.send_pool = networking.BufferPool()
         self.want_write = False
+        #: monotonic instant of the last byte received (half-open reaping:
+        #: idle_deadline of silence → the loop drops this connection)
+        self.last_activity = time.monotonic()
 
 
 class SocketParameterServer:
@@ -725,7 +785,8 @@ class SocketParameterServer:
     """
 
     def __init__(self, ps: ParameterServer, host: str = "127.0.0.1",
-                 port: int = 0, generation: int = 0, coalesce: bool = True):
+                 port: int = 0, generation: int = 0, coalesce: bool = True,
+                 idle_deadline: Optional[float] = None):
         self.ps = ps
         self.host = host
         self.port = port  # 0 → ephemeral; real port set by start()
@@ -734,6 +795,17 @@ class SocketParameterServer:
         # commits are rejected (the epoch/generation handshake)
         self.generation = int(generation)
         self.coalesce = bool(coalesce)
+        # half-open reaping (docs/host_ps.md failure matrix): a peer gone
+        # without a FIN holds its fd registered forever.  idle_deadline
+        # seconds without a received byte reaps the registration (the
+        # worker re-dials under its RetryPolicy); None keeps reaping off
+        # and only kernel keepalive (always on) eventually notices.
+        self.idle_deadline = (None if idle_deadline is None
+                              else float(idle_deadline))
+        if self.idle_deadline is not None and self.idle_deadline <= 0:
+            raise ValueError("idle_deadline must be > 0 (or None)")
+        #: connections reaped for idle_deadline silence (observability)
+        self.reaped = 0
         self._server: Optional[socket.socket] = None
         self._selector: Optional[selectors.BaseSelector] = None
         self._waker: Optional[tuple] = None  # (recv side, send side)
@@ -899,22 +971,29 @@ class SocketParameterServer:
         (resilience.ShardSupervisor.respawn_shard)."""
         return SocketParameterServer(ps, host=self.host, port=self.port,
                                      generation=self.generation + 1,
-                                     coalesce=self.coalesce)
+                                     coalesce=self.coalesce,
+                                     idle_deadline=self.idle_deadline)
 
     # -- the event loop ------------------------------------------------------
     def _io_loop(self):
         sel = self._selector
         entries: List[tuple] = []
+        # with reaping on, the loop must wake even when every peer is
+        # silent — bound the select timeout well inside the deadline
+        timeout = (None if self.idle_deadline is None
+                   else min(max(self.idle_deadline / 4.0, 0.05), 1.0))
         try:
             while True:
                 with self._conn_lock:
                     if not self._running:
                         return
                 try:
-                    events = sel.select(timeout=None)
+                    events = sel.select(timeout=timeout)
                 except OSError:
                     # fds hard-closed under us (crash()); re-check and exit
                     continue
+                if self.idle_deadline is not None:
+                    self._reap_idle()
                 del entries[:]
                 for key, mask in events:
                     if key.fileobj is self._server:
@@ -957,6 +1036,7 @@ class SocketParameterServer:
                     sock.setblocking(False)
                     sock.setsockopt(socket.IPPROTO_TCP,
                                     socket.TCP_NODELAY, 1)
+                    _enable_keepalive(sock, self.idle_deadline)
                 except OSError:
                     try:
                         sock.close()
@@ -969,6 +1049,22 @@ class SocketParameterServer:
                 self._selector.register(sock, selectors.EVENT_READ, conn)
             except (KeyError, ValueError, OSError):
                 self._drop(conn)
+
+    def _reap_idle(self):
+        """Drop every registered connection silent past ``idle_deadline``
+        — the event-core half-open reap (the per-connection stamp is the
+        last received byte; writes don't count, a peer owing us nothing
+        but reading replies still acks into our recv path via the probe
+        traffic its client layer sends)."""
+        cutoff = time.monotonic() - self.idle_deadline
+        with self._conn_lock:
+            stale = [c for c in self._conns.values()
+                     if c.last_activity < cutoff]
+        for conn in stale:
+            self.reaped += 1
+            logger.info("reaping half-open PS connection (silent > %.1fs)",
+                        self.idle_deadline)
+            self._drop(conn)
 
     def _drop(self, conn: _EventConn):
         """Silent connection teardown (EOF, torn frame, protocol
@@ -1006,6 +1102,7 @@ class SocketParameterServer:
             if not n:
                 self._drop(conn)  # EOF; a partial frame dropped silently
                 return
+            conn.last_activity = time.monotonic()
             if fed_scratch:
                 conn.parser.feed(target[:n])
             else:
@@ -1237,17 +1334,21 @@ PS_CORES = {"event": SocketParameterServer,
 
 def make_socket_server(ps: ParameterServer, host: str = "127.0.0.1",
                        port: int = 0, generation: int = 0,
-                       ps_core: str = "event", coalesce: bool = True):
+                       ps_core: str = "event", coalesce: bool = True,
+                       idle_deadline: Optional[float] = None):
     """Construct the selected PS server core around ``ps``.  ``coalesce``
-    only applies to the event core (the threaded core has no drain)."""
+    only applies to the event core (the threaded core has no drain);
+    ``idle_deadline`` enables half-open reaping on either core."""
     if ps_core not in PS_CORES:
         raise ValueError(
             f"ps_core must be one of {sorted(PS_CORES)}, got {ps_core!r}")
     if ps_core == "threaded":
         return ThreadedSocketParameterServer(ps, host=host, port=port,
-                                             generation=generation)
+                                             generation=generation,
+                                             idle_deadline=idle_deadline)
     return SocketParameterServer(ps, host=host, port=port,
-                                 generation=generation, coalesce=coalesce)
+                                 generation=generation, coalesce=coalesce,
+                                 idle_deadline=idle_deadline)
 
 
 PS_CLASSES = {
@@ -1747,9 +1848,266 @@ def _worker_kwargs(trainer, n: int, rows: int) -> dict:
         wire_topk_dtype=getattr(trainer, "wire_topk_dtype", None),
         comm_overlap=getattr(trainer, "comm_overlap", False),
         fault_injection=getattr(trainer, "fault_injection", None))
+    pw = int(getattr(trainer, "partition_windows", 0) or 0)
+    if pw:
+        kw["partition_windows"] = pw
     if trainer.ALGORITHM in ("aeasgd", "eamsgd"):
         kw["rho"] = getattr(trainer, "rho", 5.0)
     return kw
+
+
+def _run_process_elastic(trainer, x, y, n: int, blob: dict, kw: dict,
+                         optimizer, algorithm: str) -> FittedModel:
+    """The supervised cross-process engine (``execution='process_ps'`` with
+    ``elastic=True``) — ROADMAP item 1's simulated-DCN topology.
+
+    Everything the in-process elastic engine proves in one interpreter runs
+    here across real process boundaries: worker *processes* lease row ranges
+    from a :class:`resilience.LeaseServer` over the wire, a
+    :class:`resilience.ProcessSupervisor` detects SIGKILLed (waitpid) and
+    SIGSTOPped (wire-heartbeat-silent) workers — revoking their leases so
+    survivors steal the work, and respawning replacements under fresh ids
+    through the :class:`job_deployment.Job` rail — and the per-epoch
+    ``assert_epoch_complete`` keeps the zero-data-loss contract.
+
+    The PS itself has two placements (``trainer.ps_placement``):
+
+    - ``"driver"`` (default): a ``ShardedServerGroup`` inside this driver
+      process — the PR 3 topology, now fed by worker processes.
+    - ``"process"``: one ``ps_shard_main`` OS process per shard, each
+      journaling to the shared scratch directory.  A shard that dies is
+      respawned **same-address** by the supervisor; the fresh process
+      restores its journal snapshot with its generation bumped, so
+      in-flight commits against the pre-crash center are rejected by the
+      existing generation handshake (bounded loss, zero protocol changes).
+
+    The full dataset ships to every worker once (one npz in scratch); each
+    epoch's global shuffle is reproduced bit-for-bit in every process from
+    ``seed + 7919 * epoch``, so a lease's row range means the same rows
+    everywhere — including to a replacement spawned mid-epoch.
+    """
+    import contextlib
+    import glob as globmod
+    import json
+    import tempfile
+    import time
+
+    from .job_deployment import Job, LocalJobRunner
+    from .ps_sharding import ShardedPSClient, make_shard_plan
+    from .ps_worker_main import save_model_blob
+    from .resilience import LeaseLedger, LeaseServer, ProcessSupervisor
+
+    num_shards = int(getattr(trainer, "ps_shards", 1) or 1)
+    placement = getattr(trainer, "ps_placement", "driver") or "driver"
+    if placement not in ("driver", "process"):
+        raise ValueError(
+            f"ps_placement must be 'driver' or 'process', got {placement!r}")
+    recovery = bool(getattr(trainer, "recovery", False))
+    bind_host, advertise_host = resolve_ps_hosts(trainer)
+    ps_core = getattr(trainer, "ps_core", "event") or "event"
+    coalesce = bool(getattr(trainer, "coalesce", True))
+    apply_kernel = getattr(trainer, "apply_kernel", None)
+
+    # lease geometry — identical to the in-process elastic engine
+    win_rows = trainer.communication_window * trainer.batch_size
+    total_windows = -(-len(x) // win_rows)
+    lease_windows = getattr(trainer, "lease_windows", None)
+    if lease_windows is None:
+        lease_windows = max(1, total_windows // (4 * n))
+    # cold-start deadline seed: compile + time the same window program the
+    # workers will build, × n for contention (their first window pays their
+    # own per-process compile; each worker's EWMA tightens from renewal #1)
+    head = WORKER_CLASSES[algorithm](
+        blob, worker_optimizer=trainer.worker_optimizer,
+        ps_host=advertise_host, ps_port=0, **kw)
+    t_window = head.compile_windows(x, y)
+    ledger = LeaseLedger(len(x), win_rows, lease_windows,
+                         min_deadline=getattr(trainer, "lease_timeout", 5.0),
+                         default_window_s=t_window * n)
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {"PYTHONPATH": os.pathsep.join(
+        p for p in (pkg_root, os.environ.get("PYTHONPATH")) if p)}
+
+    with contextlib.ExitStack() as stack:
+        scratch = getattr(trainer, "scratch_dir", None)
+        if scratch is None:
+            scratch = stack.enter_context(
+                tempfile.TemporaryDirectory(prefix="dkt_procel_"))
+        else:
+            os.makedirs(scratch, exist_ok=True)
+        model_path = os.path.join(scratch, "model.npz")
+        save_model_blob(model_path, blob)
+        data_path = os.path.join(scratch, "data.npz")
+        np.savez(data_path, x=x, y=y)
+        result_dir = os.path.join(scratch, "results")
+        os.makedirs(result_dir, exist_ok=True)
+
+        # -- bring up the PS ------------------------------------------------
+        group = None
+        ps_procs: List[Any] = []
+        respawn_ps = None
+        if placement == "driver":
+            group = ShardedServerGroup(algorithm, blob, n, num_shards,
+                                       host=bind_host, ps_core=ps_core,
+                                       coalesce=coalesce,
+                                       apply_kernel=apply_kernel)
+            group.start()
+            stack.callback(group.stop)
+            shard_addrs = [(advertise_host, int(p))
+                           for _, p in group.addrs]
+        else:
+            from .ps_shard_main import read_addr
+            addr_dir = os.path.join(scratch, "addrs")
+            journal_dir = os.path.join(scratch, "journal")
+            os.makedirs(addr_dir, exist_ok=True)
+            os.makedirs(journal_dir, exist_ok=True)
+            ps_cfg_path = os.path.join(scratch, "shard_config.json")
+            with open(ps_cfg_path, "w") as f:
+                json.dump({
+                    "algorithm": algorithm, "model_path": model_path,
+                    "num_workers": n, "num_shards": num_shards,
+                    "bind_host": bind_host, "addr_dir": addr_dir,
+                    "journal_dir": journal_dir, "ps_core": ps_core,
+                    "coalesce": coalesce, "apply_kernel": apply_kernel,
+                    "snapshot_interval":
+                        getattr(trainer, "snapshot_interval", 0.5),
+                }, f)
+
+            def spawn_shard(j: int):
+                job = Job(name=f"{algorithm}-ps-shard{j}", script="-m",
+                          args=["distkeras_tpu.ps_shard_main", ps_cfg_path,
+                                str(j)],
+                          hosts=["127.0.0.1"], env=env, coordinated=False)
+                job.run(LocalJobRunner(), wait=False)
+                return job.processes[0]
+
+            respawn_ps = spawn_shard
+            ps_procs = [spawn_shard(j) for j in range(num_shards)]
+
+            def _stop_shards():
+                procs = (trainer._process_supervisor.ps_procs
+                         if getattr(trainer, "_process_supervisor", None)
+                         else ps_procs)
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs:
+                    try:
+                        p.wait(timeout=15)
+                    except Exception:
+                        p.kill()
+
+            stack.callback(_stop_shards)
+            shard_addrs = []
+            deadline = time.monotonic() + 180  # cold jax imports
+            for j in range(num_shards):
+                path = os.path.join(addr_dir, f"shard_{j}.addr")
+                while not os.path.exists(path):
+                    if ps_procs[j].poll() is not None:
+                        raise RuntimeError(
+                            f"PS shard process {j} exited with code "
+                            f"{ps_procs[j].returncode} before publishing "
+                            "its address")
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"PS shard process {j} never published its "
+                            "address")
+                    time.sleep(0.05)
+                h, port, _gen = read_addr(path)
+                shard_addrs.append((advertise_host if h in _WILDCARD_HOSTS
+                                    else h, port))
+
+        # -- the lease rail --------------------------------------------------
+        lease_server = stack.enter_context(LeaseServer(ledger,
+                                                       host=bind_host))
+
+        wcfg = {**kw, "algorithm": algorithm, "model_path": model_path,
+                "data_path": data_path, "result_dir": result_dir,
+                "worker_optimizer": optimizer,
+                "lease_host": advertise_host,
+                "lease_port": lease_server.port,
+                "ps_host": shard_addrs[0][0], "ps_port": shard_addrs[0][1]}
+        if num_shards > 1:
+            wcfg["num_shards"] = num_shards
+            wcfg["shard_addrs"] = [[h, p] for h, p in shard_addrs]
+        if recovery:
+            wcfg["recovery"] = True
+        pw = int(getattr(trainer, "partition_windows", 0) or 0)
+        if pw:
+            wcfg["partition_windows"] = pw
+            wcfg["recovery"] = True  # heal-exhaustion falls back to resume
+        wcfg_path = os.path.join(scratch, "worker_config.json")
+        with open(wcfg_path, "w") as f:
+            json.dump(wcfg, f)
+
+        def spawn_worker(wid: int):
+            job = Job(name=f"{algorithm}-elastic-w{wid}", script="-m",
+                      args=["distkeras_tpu.ps_worker_main", wcfg_path,
+                            str(wid)],
+                      hosts=["127.0.0.1"], env=env, coordinated=False,
+                      process_ids=[wid])
+            job.run(LocalJobRunner(), wait=False)
+            return job.processes[0]
+
+        sup = ProcessSupervisor(
+            ledger, lease_server, spawn_worker, n,
+            freeze_deadline=getattr(trainer, "freeze_deadline", None),
+            max_respawns=getattr(trainer, "max_respawns", None),
+            ps_procs=ps_procs or None,
+            ps_addrs=shard_addrs if ps_procs else None,
+            respawn_ps=respawn_ps)
+        trainer._process_supervisor = sup  # observability (tests/bench)
+
+        epoch_reports: Dict[int, Any] = {}
+        try:
+            sup.start()
+            for epoch in range(trainer.num_epoch):
+                sup.run_epoch(epoch)
+                # the zero-data-loss contract, asserted per epoch
+                epoch_reports[epoch] = ledger.assert_epoch_complete(epoch)
+        finally:
+            sup.shutdown()
+            trainer.failed_workers = sorted(sup.failures)
+            trainer.worker_failures = dict(sup.failures)
+            trainer.elastic_stats = {**sup.stats(),
+                                     "lease_completions": epoch_reports}
+
+        # histories from every worker that ever ran (original ids +
+        # respawned fresh ids), id order — files are globbed because
+        # replacements land under ids the launch config never knew
+        trainer.history.clear()
+        results = globmod.glob(os.path.join(result_dir, "result_*.npz"))
+        for p in sorted(results, key=lambda q: int(
+                os.path.basename(q)[len("result_"):-len(".npz")])):
+            with np.load(p) as z:
+                trainer.history.extend(z["history"].tolist())
+
+        # -- final model -----------------------------------------------------
+        if group is not None:
+            trainer.ps_coalesce_stats = group.coalesce_stats
+            fitted = group.get_model()
+        else:
+            # gather the final center over the wire before retiring the
+            # shard processes (the ExitStack SIGTERMs them on the way out;
+            # each journals a final snapshot — clean handoff)
+            trainer.ps_coalesce_stats = None
+            weights = [np.asarray(w) for w in blob["weights"]]
+            plan = make_shard_plan([w.shape for w in weights],
+                                   [w.dtype for w in weights], num_shards)
+            client = ShardedPSClient(plan, shard_addrs, recovery=True)
+            try:
+                client.connect()
+                center = client.pull()
+            finally:
+                client.disconnect()
+            model, params = deserialize_model(
+                {"model": blob["model"], "weights": center})
+            fitted = FittedModel(model, params)
+
+    trainer._fitted = fitted
+    trainer.record_training_stop()
+    return fitted
 
 
 def run_process_ps_training(trainer, dataset, shuffle: bool = False
@@ -1793,12 +2151,14 @@ def run_process_ps_training(trainer, dataset, shuffle: bool = False
             "checkpoint/resume is not supported on execution='process_ps' "
             "(use 'host_ps' for epoch-wave checkpoints)")
     from .workers import parse_fault_injection
-    if any(k == "hang" for k, _ in parse_fault_injection(
-            getattr(trainer, "fault_injection", None)).values()):
+    if not getattr(trainer, "elastic", False) and any(
+            k == "hang" for k, _ in parse_fault_injection(
+                getattr(trainer, "fault_injection", None)).values()):
         raise ValueError(
             "fault_injection kind 'hang' wedges a worker process forever; "
-            "the process engine has no lease ledger to revoke its work — "
-            "use execution='host_ps' with elastic=True")
+            "the static process engine has no lease ledger to revoke its "
+            "work — use elastic=True (any execution) so the leases of a "
+            "wedged worker are revoked and stolen")
 
     trainer.record_training_start()
     trainer.failed_workers = []
@@ -1827,12 +2187,31 @@ def run_process_ps_training(trainer, dataset, shuffle: bool = False
             "worker processes — pass a name or config dict "
             "(e.g. 'warmup_cosine'), or use execution='host_ps'")
 
-    ps = allocate_parameter_server(
-        algorithm, blob, n,
-        apply_kernel=getattr(trainer, "apply_kernel", None))
-    server = make_socket_server(
-        ps, ps_core=getattr(trainer, "ps_core", "event") or "event",
-        coalesce=bool(getattr(trainer, "coalesce", True)))
+    if getattr(trainer, "elastic", False):
+        # the supervised cross-process engine: lease rail + process-level
+        # supervision + (optionally) PS shards as their own OS processes
+        return _run_process_elastic(trainer, x, y, n, blob, kw, optimizer,
+                                    algorithm)
+
+    num_shards = int(getattr(trainer, "ps_shards", 1) or 1)
+    bind_host, advertise_host = resolve_ps_hosts(trainer)
+    if num_shards > 1:
+        # sharded static path: the driver hosts a ShardedServerGroup and
+        # the worker processes scatter/gather through a ShardedPSClient —
+        # the process boundary is invisible to the shard wire protocol
+        server = ShardedServerGroup(
+            algorithm, blob, n, num_shards, host=bind_host,
+            ps_core=getattr(trainer, "ps_core", "event") or "event",
+            coalesce=bool(getattr(trainer, "coalesce", True)),
+            apply_kernel=getattr(trainer, "apply_kernel", None))
+    else:
+        ps = allocate_parameter_server(
+            algorithm, blob, n,
+            apply_kernel=getattr(trainer, "apply_kernel", None))
+        server = make_socket_server(
+            ps, host=bind_host,
+            ps_core=getattr(trainer, "ps_core", "event") or "event",
+            coalesce=bool(getattr(trainer, "coalesce", True)))
     server.start()
     try:
         with tempfile.TemporaryDirectory(prefix="dkt_procps_") as tmp:
@@ -1845,15 +2224,25 @@ def run_process_ps_training(trainer, dataset, shuffle: bool = False
                 shard_paths.append(p)
                 result_paths.append(os.path.join(tmp, f"result_{i}.npz"))
             cfg_path = os.path.join(tmp, "worker_config.json")
+            if num_shards > 1:
+                endpoint = {
+                    "ps_host": advertise_host,
+                    "ps_port": server.ports[0],
+                    "num_shards": num_shards,
+                    "shard_addrs": [[advertise_host, int(p)]
+                                    for _, p in server.addrs],
+                }
+            else:
+                endpoint = {"ps_host": advertise_host,
+                            "ps_port": server.port}
             with open(cfg_path, "w") as f:
                 json.dump({
                     **kw,
+                    **endpoint,
                     "algorithm": algorithm,
                     "model_path": model_path,
                     "shard_paths": shard_paths,
                     "result_paths": result_paths,
-                    "ps_host": "127.0.0.1",
-                    "ps_port": server.port,
                     "worker_optimizer": optimizer,
                 }, f)
 

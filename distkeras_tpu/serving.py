@@ -1188,6 +1188,12 @@ class ServingEngine:
         self._reload_sock: Optional[socket.socket] = None
         self._reload_pool = networking.BufferPool()
         self._reload_policy = None          # resilience.RetryPolicy or None
+        #: sharded attachment (attach_ps shard_plan/shard_addrs): pulls
+        #: gather the center across every shard through a ShardedPSClient
+        #: instead of one socket's 'p'
+        self._ps_shard_plan = None
+        self._ps_shard_addrs: Optional[List[Tuple[str, int]]] = None
+        self._reload_client = None          # ps_sharding.ShardedPSClient
         #: optional (t_monotonic, center_clock) callback fired after every
         #: SUCCESSFUL pull — the freshness seam deployment_online.py hooks
         #: (called on the decode thread; must be cheap and non-raising)
@@ -3596,6 +3602,12 @@ class ServingEngine:
             except OSError:
                 pass
             self._reload_sock = None
+        if self._reload_client is not None:
+            try:
+                self._reload_client.disconnect()
+            except (OSError, ConnectionError):
+                pass
+            self._reload_client = None
 
     def drain(self, timeout: Optional[float] = None,
               poll: float = 0.01) -> bool:
@@ -3767,7 +3779,9 @@ class ServingEngine:
             eng._fp_skel = self._fp_skel
         if self._ps_addr is not None:
             eng.attach_ps(*self._ps_addr, every=self._reload_every,
-                          retry_policy=self._reload_policy)
+                          retry_policy=self._reload_policy,
+                          shard_plan=self._ps_shard_plan,
+                          shard_addrs=self._ps_shard_addrs)
         # the freshness listener is engine-agnostic (a (time, clock)
         # callback) — carrying it over keeps the online deployment's
         # freshness chain intact across supervised restarts and
@@ -4029,7 +4043,8 @@ class ServingEngine:
 
     # ------------------------------------------------- hot reload (stretch)
     def attach_ps(self, host: str, port: int, every: int = 1,
-                  retry_policy=None) -> None:
+                  retry_policy=None, shard_plan=None,
+                  shard_addrs=None) -> None:
         """Hot weight reload: pull a fresh center from a live parameter
         server (the PS stack's ``'p'`` opcode — same wire the training
         workers speak) every ``every`` decode steps, so a training run and
@@ -4048,24 +4063,70 @@ class ServingEngine:
         bounded serving stall, never an unbounded one.  A pull that fails
         past the policy counts ``stats["reload_failures"]`` and KEEPS the
         current weights — hot reload stays best-effort by design; the
-        engine never dies on its PS."""
+        engine never dies on its PS.
+
+        A SHARDED training PS (``ps_shards>1``) attaches by passing
+        ``shard_plan`` + ``shard_addrs``: each pull gathers the center
+        across every shard through a ``ps_sharding.ShardedPSClient``
+        (scatter/gather over the same 'p' wire), so the engine never
+        hot-reloads one shard's torn slice.  The gathered view is
+        epoch-wave consistent — per-shard slices are each snapshotted
+        under their own apply lock, the same consistency the sharded
+        checkpoint path provides — and a pull that loses ANY shard past
+        the policy keeps the current weights wholesale (all-or-nothing,
+        never a partial swap).  ``(host, port)`` must be shard 0's
+        address (the canonical deployment handle)."""
         if every < 1:
             raise ValueError(f"every must be >= 1, got {every}")
+        if (shard_plan is None) != (shard_addrs is None):
+            raise ValueError(
+                "shard_plan and shard_addrs come as a pair — both for a "
+                "sharded PS attachment, neither for a single server")
+        if shard_addrs is not None and len(shard_addrs) < 2:
+            # the N=1 plan is the identity partition: the plain single-
+            # socket pull already returns the full center
+            shard_plan = shard_addrs = None
         self._ps_addr = (host, int(port))
         self._reload_policy = retry_policy
         self._reload_every = int(every)
+        self._ps_shard_plan = shard_plan
+        self._ps_shard_addrs = (None if shard_addrs is None else
+                                [(str(h), int(p)) for h, p in shard_addrs])
+
+    def _pull_sharded(self) -> Dict[str, Any]:
+        """One gathered pull over every shard (sharded attach_ps) —
+        returns the same ``{"weights", "clock"}`` shape the single-socket
+        'p' reply carries, with the clock summed over shards (each shard
+        counts its own applies; the sum is the total-updates center
+        generation, monotone across shard respawns by the client's
+        per-shard monotonic clock view)."""
+        if self._reload_client is None:
+            from .ps_sharding import ShardedPSClient
+            policy = (self._reload_policy if self._reload_policy
+                      is not None else DEFAULT_RELOAD_POLICY)
+            client = ShardedPSClient(self._ps_shard_plan,
+                                     self._ps_shard_addrs,
+                                     recovery=True, policy=policy)
+            client.connect(policy=policy)
+            self._reload_client = client
+        weights = self._reload_client.pull()
+        return {"weights": weights,
+                "clock": sum(self._reload_client._clocks)}
 
     def _pull_weights(self) -> None:
         try:
-            if self._reload_sock is None:
-                from . import resilience
-                policy = (self._reload_policy if self._reload_policy
-                          is not None else DEFAULT_RELOAD_POLICY)
-                self._reload_sock = resilience.dial(*self._ps_addr,
-                                                    policy=policy)
-            networking.send_opcode(self._reload_sock, b"p")
-            msg = networking.recv_data(self._reload_sock,
-                                       pool=self._reload_pool)
+            if self._ps_shard_addrs is not None:
+                msg = self._pull_sharded()
+            else:
+                if self._reload_sock is None:
+                    from . import resilience
+                    policy = (self._reload_policy if self._reload_policy
+                              is not None else DEFAULT_RELOAD_POLICY)
+                    self._reload_sock = resilience.dial(*self._ps_addr,
+                                                        policy=policy)
+                networking.send_opcode(self._reload_sock, b"p")
+                msg = networking.recv_data(self._reload_sock,
+                                           pool=self._reload_pool)
             if self.quantize is not None:
                 # re-quantize the pulled center through the SAME path the
                 # constructor used — never swap raw fp32 weights into a
@@ -4104,6 +4165,12 @@ class ServingEngine:
                 except OSError:
                     pass
                 self._reload_sock = None
+            if self._reload_client is not None:
+                try:
+                    self._reload_client.disconnect()
+                except (OSError, ConnectionError):
+                    pass
+                self._reload_client = None
 
 
 # ---------------------------------------------------------------------------

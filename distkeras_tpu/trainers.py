@@ -711,6 +711,10 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                  row_sparse=None,
                  ps_bind_host: Optional[str] = None,
                  ps_advertise_host: Optional[str] = None,
+                 ps_placement: str = "driver",
+                 partition_windows: int = 0,
+                 freeze_deadline: Optional[float] = None,
+                 scratch_dir: Optional[str] = None,
                  **kw):
         super().__init__(keras_model, **kw)
         self.parallelism_factor = int(parallelism_factor)
@@ -730,27 +734,40 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         self.ps_shards = int(ps_shards)
         if self.ps_shards < 1:
             raise ValueError("ps_shards must be >= 1")
-        if self.ps_shards > 1 and self.execution != "host_ps":
+        if self.ps_shards > 1 and self.execution not in ("host_ps",
+                                                         "process_ps"):
             raise ValueError(
-                "ps_shards > 1 requires execution='host_ps' (the SPMD "
-                "engine exchanges deltas over ICI — no PS to shard; the "
-                "process_ps engine ships config as JSON and keeps the "
-                "single-server topology)")
+                "ps_shards > 1 requires a PS engine (execution='host_ps'/"
+                "'process_ps'); the SPMD engine exchanges deltas over ICI "
+                "— no PS to shard")
         self.recovery = bool(recovery)
         self.recovery_policy = recovery_policy
-        if self.recovery and self.execution != "host_ps":
+        if self.recovery and self.execution not in ("host_ps",
+                                                    "process_ps"):
             raise ValueError(
-                "recovery=True requires execution='host_ps' (the SPMD "
-                "engine's recovery story is checkpoint_dir + train(resume="
-                "True); process_ps worker processes are respawned by the "
-                "job layer)")
+                "recovery=True requires a PS engine (execution='host_ps'/"
+                "'process_ps'); the SPMD engine's recovery story is "
+                "checkpoint_dir + train(resume=True)")
+        if self.recovery and self.execution == "process_ps" \
+                and self.recovery_policy is not None:
+            raise ValueError(
+                "process_ps cannot ship a recovery_policy object to worker "
+                "processes (config travels as JSON) — workers use "
+                "DEFAULT_RECOVERY_POLICY; tune it via host_ps or leave "
+                "recovery_policy=None")
         self.elastic = bool(elastic)
-        if self.elastic and self.execution != "host_ps":
+        if self.elastic and self.execution not in ("host_ps",
+                                                   "process_ps"):
             raise ValueError(
-                "elastic=True requires execution='host_ps' (the SPMD "
-                "engine is bulk-synchronous — a lost participant is a lost "
-                "collective; process_ps workers are whole OS processes the "
-                "job layer owns)")
+                "elastic=True requires a PS engine (execution='host_ps'/"
+                "'process_ps'); the SPMD engine is bulk-synchronous — a "
+                "lost participant is a lost collective")
+        if self.recovery and self.execution == "process_ps" \
+                and not self.elastic:
+            raise ValueError(
+                "recovery=True on execution='process_ps' requires "
+                "elastic=True (the supervised cross-process engine); the "
+                "static process engine keeps the fail-fast topology")
         self.lease_windows = (None if lease_windows is None
                               else int(lease_windows))
         if self.lease_windows is not None and self.lease_windows < 1:
@@ -848,12 +865,69 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                 "None (empty string is neither bindable nor dialable)")
         if (self.ps_bind_host is not None
                 or self.ps_advertise_host is not None) and \
-                self.execution != "host_ps":
+                self.execution not in ("host_ps", "process_ps"):
             raise ValueError(
                 "ps_bind_host/ps_advertise_host configure the socket PS "
-                "address (execution='host_ps'); the SPMD engine has no "
-                "socket server and process_ps renders addresses through "
-                "the job layer")
+                "address (execution='host_ps'/'process_ps'); the SPMD "
+                "engine has no socket server")
+        # cross-process supervision knobs (execution='process_ps' with
+        # elastic=True — parameter_servers._run_process_elastic):
+        #   ps_placement   "driver" hosts the (possibly sharded) PS inside
+        #                  the driver; "process" runs each shard as its own
+        #                  ps_shard_main OS process, journaled to the shared
+        #                  scratch dir and respawned same-address on death.
+        #   partition_windows  >0 lets a network-partitioned worker keep
+        #                  computing into a pending-commit buffer of that
+        #                  many windows, reconciling on heal (workers.py);
+        #                  0 keeps the blocking reconnect-resume behavior.
+        #   freeze_deadline    seconds of wire-heartbeat silence after which
+        #                  a live-by-waitpid worker process is declared
+        #                  frozen (SIGSTOP, swap death) and its leases
+        #                  revoked for survivors to steal; None disables.
+        #   scratch_dir    the shared scratch directory (NFS path for real
+        #                  multi-host runs); None uses a driver-local
+        #                  tempdir, correct for same-host processes.
+        self.ps_placement = str(ps_placement)
+        if self.ps_placement not in ("driver", "process"):
+            raise ValueError(
+                f"ps_placement must be 'driver' or 'process', got "
+                f"{ps_placement!r}")
+        self.partition_windows = int(partition_windows)
+        if self.partition_windows < 0:
+            raise ValueError("partition_windows must be >= 0")
+        self.freeze_deadline = (None if freeze_deadline is None
+                                else float(freeze_deadline))
+        if self.freeze_deadline is not None and self.freeze_deadline <= 0:
+            raise ValueError("freeze_deadline must be > 0")
+        self.scratch_dir = None if scratch_dir is None else str(scratch_dir)
+        _proc_elastic_only = {
+            "ps_placement='process'": self.ps_placement == "process",
+            "freeze_deadline": self.freeze_deadline is not None,
+            "scratch_dir": self.scratch_dir is not None,
+        }
+        for knob, is_set in _proc_elastic_only.items():
+            if is_set and not (self.execution == "process_ps"
+                               and self.elastic):
+                raise ValueError(
+                    f"{knob} applies to the supervised cross-process "
+                    "engine — execution='process_ps' with elastic=True")
+        if self.partition_windows and self.execution not in (
+                "host_ps", "process_ps"):
+            raise ValueError(
+                "partition_windows applies to the PS transports "
+                "(execution='host_ps'/'process_ps'); the SPMD engine has "
+                "no wire to partition")
+        if self.partition_windows and self.ps_shards > 1:
+            raise ValueError(
+                "partition_windows requires ps_shards=1 — sharded workers "
+                "heal by blocking reconnect-resume (lease stealing already "
+                "guarantees zero lost examples)")
+        if (self.partition_windows and self.recovery
+                and self.execution == "host_ps"):
+            raise ValueError(
+                "partition_windows with recovery is a process_ps feature — "
+                "host_ps recovery routes workers through the sharded client, "
+                "which heals by reconnect-resume")
         #: per-run streaming observability: horizons, rows ingested,
         #: examples/sec, buffer counters (run_stream_training)
         self.stream_stats: dict = {}

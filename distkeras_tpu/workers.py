@@ -41,7 +41,7 @@ from .core.train import batch_epoch_data, make_masked_step
 from . import networking
 from .ps_sharding import ShardedPSClient
 from .resilience import (DEFAULT_CONNECT_POLICY, DEFAULT_RECOVERY_POLICY,
-                         RETRYABLE_CONNECT, RetryPolicy, dial)
+                         RETRYABLE_CONNECT, Partitioned, RetryPolicy, dial)
 
 
 #: injectable worker fault kinds (fault_injection): 'raise' = thread raises
@@ -292,7 +292,8 @@ class PSWorker(Worker):
                  shard_plan=None, shard_addrs=None,
                  recovery: bool = False,
                  retry_policy: Optional[RetryPolicy] = None,
-                 row_sparse_tables=None, **kw):
+                 row_sparse_tables=None,
+                 partition_windows: int = 0, **kw):
         super().__init__(model_blob, worker_optimizer, loss, **kw)
         self.ps_host = ps_host
         self.ps_port = ps_port
@@ -426,6 +427,46 @@ class PSWorker(Worker):
         self.clock_regressions = 0
         #: sparse commits whose gen-rejection re-credited the EF residual
         self.recredits = 0
+        # partition tolerance (partition_windows > 0 — resilience.py):
+        # instead of blocking in reconnect-resume the moment the PS link
+        # dies, the worker keeps computing for up to partition_windows
+        # windows, SUMMING each window's as-applied dense delta into a
+        # pending buffer, and serving pulls from the last good center.  One
+        # cheap heal probe per window ('h' round trip on a fresh dial);
+        # on heal the buffer flushes as ONE commit stamped with the
+        # generation seen at partition onset — a PS respawned during the
+        # partition gen-rejects it (the existing handshake), so the
+        # buffered mass is bounded loss, never corruption.  Budget
+        # exhausted → blocking resume (when recovery) and finally a typed
+        # resilience.Partitioned, distinct from PSShardDown: the PATH is
+        # gone, the endpoint is probably fine.  Serial single-socket
+        # transport only: the sharded client's reconnect-resume already
+        # covers its path (blocking), and the overlap/row-sparse loops
+        # have in-flight state a buffer cannot represent.
+        self.partition_windows = int(partition_windows or 0)
+        if self.partition_windows < 0:
+            raise ValueError("partition_windows must be >= 0")
+        if self.partition_windows:
+            if self.shard_addrs is not None:
+                raise ValueError(
+                    "partition_windows applies to the single-socket PS "
+                    "link; the sharded client heals by reconnect-resume "
+                    "(recovery=True) instead")
+            if self.comm_overlap:
+                raise ValueError(
+                    "partition_windows uses the serial per-window "
+                    "transport; comm_overlap must be off")
+            if self.row_sparse_tables:
+                raise ValueError(
+                    "partition_windows buffers dense as-applied deltas; "
+                    "row_sparse_tables commits cannot be buffered")
+        self._pending: Optional[List[np.ndarray]] = None
+        self._pending_windows = 0
+        self._pending_gen: Optional[int] = None
+        self._cached_center: Optional[List[np.ndarray]] = None
+        #: partition episodes entered / pending buffers reconciled on heal
+        self.partitions = 0
+        self.reconciliations = 0
 
     # -- wire ---------------------------------------------------------------
     def _connect_policy(self, attempts: Optional[int] = None,
@@ -560,11 +601,22 @@ class PSWorker(Worker):
         try:
             msg = do_pull()
         except (ConnectionError, OSError, ValueError) as e:
+            if self.partition_windows and self._cached_center is not None:
+                # partitioned: serve the last good center (copies — the
+                # cache must survive the next real receive); the window
+                # trains one partition staler, the same class of staleness
+                # the async rules already absorb
+                return [w.copy() for w in self._cached_center]
             if not self.recovery:
                 raise
             msg = self._with_resume(do_pull, e)
         self._sync_reply(msg)
         self.transport_ops += 1
+        if self.partition_windows:
+            # pool-backed views are only valid until the next receive;
+            # the partition cache needs owned copies
+            self._cached_center = [np.array(w, copy=True)
+                                   for w in msg["weights"]]
         return msg["weights"]
 
     # -- sparse top-k compression (wire_dtype="topk") ------------------------
@@ -950,7 +1002,25 @@ class PSWorker(Worker):
             self._shard_client.send_commit(msg)
             self.transport_ops += self._shard_client.num_shards
             return applied
-        self._send_request(b"c", msg)
+        if self._pending_windows:
+            # already partitioned: one cheap heal probe per window, then
+            # either reconcile or keep buffering (until the budget runs out)
+            if self._heal_probe():
+                try:
+                    self._flush_pending(worker_id)
+                except (ConnectionError, OSError):
+                    pass  # re-partitioned mid-flush: state still buffered
+            if self._pending_windows:
+                self._buffer_pending(applied, worker_id)
+                return applied
+        try:
+            self._send_request(b"c", msg)
+        except (ConnectionError, OSError):
+            if not self.partition_windows:
+                raise
+            self.partitions += 1
+            self._buffer_pending(applied, worker_id)
+            return applied
         self.transport_ops += 1
         return applied
 
@@ -958,7 +1028,10 @@ class PSWorker(Worker):
         """Opcode + frame on the single socket, with reconnect-resume: a
         send-side fault re-dials and re-issues the same message (still
         stamped with the old generation — a restarted PS drops it and the
-        next reply re-syncs us; bounded loss either way)."""
+        next reply re-syncs us; bounded loss either way).  With
+        ``partition_windows`` set the fault raises through instead — the
+        caller buffers into the pending-commit path rather than blocking
+        here."""
 
         def send():
             networking.send_opcode(self._sock, op)
@@ -972,9 +1045,96 @@ class PSWorker(Worker):
         try:
             send()
         except (ConnectionError, OSError) as e:
-            if not self.recovery:
+            if self.partition_windows or not self.recovery:
                 raise
             self._with_resume(send, e)
+
+    # -- partition tolerance (partition_windows > 0) -------------------------
+    def _heal_probe(self, timeout: float = 0.25) -> bool:
+        """One cheap liveness round trip on a FRESH dial: 'h' answered
+        within ``timeout`` means the path healed — the probe socket is
+        adopted as the live connection (its reply re-syncs gen + clock).
+        False means still partitioned; nothing changes."""
+        sock = None
+        try:
+            sock = networking.connect(self.ps_host, self.ps_port)
+            sock.settimeout(timeout)
+            networking.send_opcode(sock, b"h")
+            msg = networking.recv_data(sock)
+            if not isinstance(msg, dict) or "clock" not in msg:
+                raise ValueError("malformed heartbeat reply")
+            sock.settimeout(None)
+        except (ConnectionError, OSError, ValueError, socket.timeout):
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            return False
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = sock
+        self._pool = networking.BufferPool()
+        self._send_pool = networking.BufferPool()
+        self._conn_clock = None
+        self._sync_reply(msg)
+        return True
+
+    def _buffer_pending(self, applied: List[np.ndarray], worker_id: int):
+        """Sum one window's as-applied dense delta into the pending buffer;
+        escalate once the budget is spent.  ``applied`` is dense and
+        weight-shaped for every wire family (top-k densifies), so one
+        buffer shape serves them all."""
+        if self._pending is None:
+            # stamp the flush with the generation seen BEFORE the
+            # partition: a PS respawned while we were dark must gen-reject
+            # this mass (it was computed against the pre-respawn center)
+            self._pending_gen = self._gen
+            self._pending = [np.array(a, dtype=np.float32, copy=True)
+                             for a in applied]
+        else:
+            for p, a in zip(self._pending, applied):
+                p += np.asarray(a, dtype=np.float32)
+        self._pending_windows += 1
+        if self._pending_windows <= self.partition_windows:
+            return
+        # budget exhausted: block in reconnect-resume (when recovery is
+        # on) and surface a typed Partitioned once that fails too
+        if self.recovery:
+            try:
+                self._with_resume(
+                    lambda: self._flush_pending(worker_id),
+                    ConnectionError("partition budget exhausted"))
+                return
+            except ConnectionError as e:
+                raise Partitioned(
+                    (self.ps_host, self.ps_port),
+                    detail="recovery deadline exhausted after the "
+                           "pending-commit budget",
+                    pending_windows=self._pending_windows) from e
+        raise Partitioned((self.ps_host, self.ps_port),
+                          pending_windows=self._pending_windows)
+
+    def _flush_pending(self, worker_id: int):
+        """Reconcile: ship the summed pending mass as ONE dense commit on
+        the healed link, stamped with the partition-onset generation.
+        Raises on transport fault — the buffer survives for the next probe."""
+        if self._pending is None:
+            return
+        msg = {"delta": self._pending, "worker_id": worker_id,
+               "clock": self._last_clock}
+        if self._pending_gen is not None:
+            msg["gen"] = self._pending_gen
+        networking.send_opcode(self._sock, b"c")
+        networking.send_data(self._sock, msg)
+        self.transport_ops += 1
+        self.reconciliations += 1
+        self._pending = None
+        self._pending_windows = 0
+        self._pending_gen = None
 
     def update_begin(self, delta: List[np.ndarray], worker_id: int):
         """'u' part 1: ship the delta (same fault-injection + compression

@@ -38,6 +38,7 @@ _FILE_COST = {  # mean s/test on the CPU gate machine; unlisted -> 3.0
     "test_evaluators.py": 0.01, "test_update_rules.py": 0.02,
     "test_data.py": 0.02, "test_analysis.py": 0.11,
     "test_losses_keras1.py": 0.22, "test_ps_sharding.py": 0.30,
+    "test_dcn_chaos.py": 0.37,
     "test_event_ps.py": 0.30, "test_job_deployment.py": 0.34,
     "test_host_ps_overlap.py": 0.34, "test_host_ps.py": 0.41,
     "test_core.py": 0.42, "test_fault_tolerance.py": 0.56,
@@ -145,6 +146,13 @@ def pytest_configure(config):
         "condition-variable waits with deadlines, no fixed sleeps on "
         "the fast path; fleet-scaling timing comparisons are "
         "additionally marked slow)")
+    config.addinivalue_line(
+        "markers",
+        "dcn: cross-process/WAN-grade chaos and partition-tolerance tests "
+        "(tier-1 legs are sleep-free and at most two-process-local — "
+        "ChaosProxy/ProcessChaos schedules are seeded-deterministic; the "
+        "multi-process DCN soaks with SIGSTOP legs and journal respawns "
+        "are additionally marked slow)")
     config.addinivalue_line(
         "markers",
         "qos: multi-tenant QoS tests — quotas, weighted-fair admission, "

@@ -314,8 +314,8 @@ def test_elastic_knob_validation():
     assert ADAG(m, execution="host_ps", **kw).elastic is False  # default off
     with pytest.raises(ValueError, match="elastic"):
         ADAG(m, elastic=True, **kw)  # SPMD: no elastic membership
-    with pytest.raises(ValueError, match="elastic"):
-        ADAG(m, execution="process_ps", elastic=True, **kw)
+    # process_ps elastic is the supervised cross-process engine
+    assert ADAG(m, execution="process_ps", elastic=True, **kw).elastic
     with pytest.raises(ValueError, match="lease_windows"):
         ADAG(m, execution="host_ps", elastic=True, lease_windows=0, **kw)
     with pytest.raises(ValueError, match="lease_timeout"):

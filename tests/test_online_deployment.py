@@ -309,6 +309,65 @@ def test_ps_kill_between_commit_and_pull_keeps_old_generation():
         np.testing.assert_array_equal(np.asarray(a), b)  # untorn
 
 
+def test_attach_ps_sharded_pull_gathers_full_center():
+    """Sharded attach_ps (ps_shards>1): a pull gathers the center across
+    the whole ShardedServerGroup — never one shard's torn slice — the
+    clock sums the per-shard applies, and losing ANY shard keeps the
+    current weights wholesale."""
+    from distkeras_tpu.ps_sharding import ShardedServerGroup
+    f = make_lm(seed=3)
+    blob = serialize_model(f.model, f.params)
+    group = ShardedServerGroup("downpour", blob, 1, 2)
+    group.start()
+    try:
+        eng = make_engine(seed=4)  # different seed: weights differ
+        pol = RetryPolicy(attempts=1, backoff=0.01, jitter=0.0,
+                          deadline=0.25)
+        eng.attach_ps("127.0.0.1", group.ports[0], retry_policy=pol,
+                      shard_plan=group.plan,
+                      shard_addrs=[("127.0.0.1", p) for p in group.ports])
+        assert eng._ps_shard_addrs is not None
+        eng._pull_weights()
+        assert eng.stats["reloads"] == 1
+        assert eng.stats["center_generation"] == 0
+        center, _ = group.snapshot()
+        pulled = eng.model.get_weights(eng.params)
+        for a, b in zip(pulled, center):
+            np.testing.assert_allclose(np.asarray(a), b, rtol=1e-6)
+        # a restored center with per-shard clocks gathers back exactly,
+        # and the engine's generation is the summed shard clocks
+        bumped = [w + 1.0 for w in center]
+        group.restore_state(bumped, [5, 7])
+        eng._pull_weights()
+        assert eng.stats["reloads"] == 2
+        assert eng.stats["center_generation"] == 12
+        for a, b in zip(eng.model.get_weights(eng.params), bumped):
+            np.testing.assert_allclose(np.asarray(a), b, rtol=1e-6)
+        # one shard down → the WHOLE pull fails, weights keep wholesale
+        frozen = [np.array(w, copy=True)
+                  for w in eng.model.get_weights(eng.params)]
+        group.servers[1].stop()
+        eng._pull_weights()
+        assert eng.stats["reload_failures"] >= 1
+        assert eng._reload_client is None  # torn client torn down
+        for a, b in zip(eng.model.get_weights(eng.params), frozen):
+            np.testing.assert_array_equal(np.asarray(a), b)
+    finally:
+        group.stop()
+
+
+def test_attach_ps_shard_kwargs_validated():
+    from distkeras_tpu.ps_sharding import make_shard_plan
+    eng = make_engine()
+    with pytest.raises(ValueError, match="pair"):
+        eng.attach_ps("127.0.0.1", 1, shard_addrs=[("127.0.0.1", 1)])
+    # the N=1 plan degenerates to the plain single-socket attachment
+    plan = make_shard_plan([(2, 2)], [np.float32], 1)
+    eng.attach_ps("127.0.0.1", 1, shard_plan=plan,
+                  shard_addrs=[("127.0.0.1", 1)])
+    assert eng._ps_shard_addrs is None and eng._ps_shard_plan is None
+
+
 def test_respawn_clone_carries_reload_policy_and_listener():
     pol = RetryPolicy(attempts=2, backoff=0.01, jitter=0.0, deadline=0.2)
     seen = []
@@ -320,6 +379,15 @@ def test_respawn_clone_carries_reload_policy_and_listener():
     assert clone._reload_every == 3
     assert clone._reload_policy is pol
     assert clone._reload_listener is eng._reload_listener
+    # a SHARDED attachment carries over too (blue/green over ps_shards>1)
+    from distkeras_tpu.ps_sharding import make_shard_plan
+    plan = make_shard_plan([(4, 4)], [np.float32], 2)
+    eng2 = make_engine(seed=1)
+    eng2.attach_ps("127.0.0.1", 1, shard_plan=plan,
+                   shard_addrs=[("127.0.0.1", 1), ("127.0.0.1", 2)])
+    clone2 = eng2.respawn_clone()
+    assert clone2._ps_shard_plan is plan
+    assert clone2._ps_shard_addrs == eng2._ps_shard_addrs
 
 
 # ---------------------------------------------------------------------------
@@ -333,8 +401,11 @@ def test_online_deployment_validation():
         OnlineDeployment(
             DOWNPOUR(make_lm().model, num_workers=2, batch_size=8,
                      num_epoch=1, execution="host_ps"), src, eng)
-    with pytest.raises(ValueError, match="ps_shards=1"):
-        OnlineDeployment(make_stream_trainer(ps_shards=2), src, eng)
+    # ps_shards>1 is now a supported deployment shape (sharded attach_ps
+    # gathers the center across the group — test_online_sharded_ps)
+    dep = OnlineDeployment(make_stream_trainer(ps_shards=2), src,
+                           make_engine())
+    assert dep.trainer.ps_shards == 2
     with pytest.raises(ValueError, match="StreamSource"):
         OnlineDeployment(make_stream_trainer(), [1, 2], eng)
     with pytest.raises(ValueError, match="ServingEngine"):
@@ -419,6 +490,52 @@ def test_online_deployment_serves_during_horizons_and_tracks_freshness():
     eng = dep.engine
     assert eng.stats["freshness_p50_s"] == s["freshness_p50_s"]
     # the served model LEARNED the mapping on the served path
+    assert curve[-1] >= curve[0]
+    assert curve[-1] >= 0.5
+
+
+@pytest.mark.slow
+def test_online_sharded_ps_kill_mid_horizon_untorn():
+    """ISSUE 20 acceptance: the train-while-serve lifecycle over a SHARDED
+    PS (ps_shards=2, recovery=True) — the engine's hot reload gathers the
+    full center across the group, a PS shard killed mid-horizon respawns
+    same-address through the ShardSupervisor, and serving never observes a
+    torn center: every reload is all-shards-or-nothing, requests keep
+    completing, and the served model still learns the mapping."""
+    rng = np.random.default_rng(7)
+    mapping = rng.permutation(V).astype(np.int32)
+    trainer = make_stream_trainer(ps_shards=2, recovery=True)
+    dep = OnlineDeployment(
+        trainer, StreamSource(generator=mapping_chunks(mapping, 3,
+                                                       seed=7)),
+        make_engine(), reload_every=1)
+    curve = []
+
+    def on_horizon(h, fitted):
+        if h == 1:
+            dep.kill_ps_shard(0)  # mid-horizon chaos: shard 0 dies
+        acc, gens = served_accuracy(dep, mapping)
+        curve.append(acc)
+
+    trainer.on_horizon = on_horizon
+    dep.start()
+    assert dep.wait_ps_ready(timeout=60.0)
+    # the engine attached SHARDED: plan + one address per shard
+    assert dep.engine._ps_shard_addrs is not None
+    assert len(dep.engine._ps_shard_addrs) == 2
+    fitted = dep.join(timeout=300.0)
+    dep.stop()
+    assert fitted is not None
+    s = dep.stats()
+    # the shard kill recovered same-address (journal respawn)
+    recs = trainer._ps_supervisor.recoveries
+    assert any(r["shard"] == 0 for r in recs)
+    # zero lost base examples, serving never failed a request, and the
+    # gathered reloads kept the served model learning
+    assert s["stream_stats"]["rows"] == 3 * 128
+    assert s["engine_requests_failed"] == 0
+    assert s["engine_reloads"] > 0
+    assert s["engine_center_generation"] is not None
     assert curve[-1] >= curve[0]
     assert curve[-1] >= 0.5
 

@@ -336,8 +336,8 @@ def test_ps_shards_knob_validation():
         ADAG(m, execution="host_ps", ps_shards=0, **kw)
     with pytest.raises(ValueError, match="ps_shards"):
         ADAG(m, ps_shards=2, **kw)  # SPMD: no PS to shard
-    with pytest.raises(ValueError, match="ps_shards"):
-        ADAG(m, execution="process_ps", ps_shards=2, **kw)
+    # process_ps shards through the same wire protocol (driver-hosted group)
+    assert ADAG(m, execution="process_ps", ps_shards=2, **kw).ps_shards == 2
 
 
 # ---------------------------------------------------------------------------

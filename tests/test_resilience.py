@@ -434,8 +434,11 @@ def test_recovery_knob_validation():
     assert ADAG(m, execution="host_ps", **kw).recovery is False
     with pytest.raises(ValueError, match="recovery"):
         ADAG(m, recovery=True, **kw)  # SPMD: resume is the recovery story
+    # process_ps recovery rides the supervised (elastic) engine only
     with pytest.raises(ValueError, match="recovery"):
         ADAG(m, execution="process_ps", recovery=True, **kw)
+    t2 = ADAG(m, execution="process_ps", recovery=True, elastic=True, **kw)
+    assert t2.recovery and t2.elastic
 
 
 # ---------------------------------------------------------------------------

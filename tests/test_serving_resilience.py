@@ -1083,14 +1083,19 @@ def test_soak_killed_clients_and_engine_crash_zero_leaks(fitted, server_core):
         try:
             with ServingClient(*srv.addr) as c:
                 if req["kill"]:
-                    rid = c.submit(req["prompt"], req["num_steps"],
-                                   temperature=req["temperature"],
-                                   seed=req["seed"])
-                    gen = c.stream(rid)
+                    # the SUBMIT is inside the tolerant block too: a kill
+                    # client racing the supervised restart window gets the
+                    # typed EngineDead/Draining rejection at submit time —
+                    # it was about to RST anyway, so a rejected submission
+                    # is still just a kill, not a soak failure (this race
+                    # was the historical flake in this test)
                     try:
-                        next(gen)
+                        rid = c.submit(req["prompt"], req["num_steps"],
+                                       temperature=req["temperature"],
+                                       seed=req["seed"])
+                        next(c.stream(rid))
                     except (ConnectionError, OSError, ValueError,
-                            EngineDead):
+                            EngineDead, Draining, QueueFull):
                         pass  # engine death beat us to it — still a kill
                     _hard_close(c.sock)
                     return
@@ -1135,8 +1140,11 @@ def test_soak_killed_clients_and_engine_crash_zero_leaks(fitted, server_core):
         assert _wait_for(lambda: not final._active.any())
         _assert_slots_reclaimed(final)
         assert eng.dead is not None
-        with srv._hlock:
-            assert not srv._handles and not srv._owner
+        # handle reclamation for hard-closed clients is asynchronous: the
+        # server's stream poll has to notice the RST before _release_owned
+        # runs, so wait for it rather than asserting the instantaneous state
+        assert _wait_for(lambda: not srv._handles and not srv._owner), (
+            srv._handles, srv._owner)
     finally:
         sup.stop()
         srv.stop()
